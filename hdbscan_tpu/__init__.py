@@ -1,0 +1,12 @@
+"""hdbscan-tpu: TPU-native MR-HDBSCAN* (JAX / XLA / pjit / shard_map).
+
+A brand-new framework with the capabilities of the reference Spark/Java
+MR-HDBSCAN* reproduction (see SURVEY.md): exact single-block HDBSCAN*, the
+distributed recursive-sampling + data-bubble approximation, pluggable distance
+metrics, constraints, GLOSH outlier scores, and the canonical output files —
+re-architected for TPU hardware.
+"""
+
+__version__ = "0.1.0"
+
+from hdbscan_tpu.config import HDBSCANParams  # noqa: F401

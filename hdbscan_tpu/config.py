@@ -1,0 +1,96 @@
+"""Configuration: the reference's flag vocabulary as a dataclass.
+
+Mirrors ``Main.checkInputParameters`` / ``HDBSCANStarParameters``
+(``main/Main.java:417-528,620-638``): ``file=``, ``clusterName=``,
+``constraints=``, ``minPts=``, ``k=`` (sample fraction), ``processing_units=``
+(per-partition block capacity), ``minClSize=``, ``compact=``,
+``dist_function=`` in {euclidean, cosine, pearson, manhattan, supremum}.
+Defaults match the reference (Euclidean, non-compact, ``main/Main.java:419-420``).
+The reference shadows argv with hard-coded args (``main/Main.java:71``) —
+treated as a bug; ``from_args`` really parses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass
+
+from hdbscan_tpu.core.distances import METRICS
+
+
+@dataclass
+class HDBSCANParams:
+    input_file: str = ""
+    min_points: int = 4
+    min_cluster_size: int = 4
+    processing_units: int = 50  # per-block capacity ("subset fits one worker")
+    k: float = 0.2  # stratified sample fraction per oversized subset
+    dist_function: str = "euclidean"
+    compact_hierarchy: bool = False
+    constraints_file: str | None = None
+    cluster_name: str = "local"  # Spark master analog; kept for CLI parity
+    out_dir: str | None = None
+    self_edges: bool = True
+    seed: int = 0
+    # Output file names derived from the input path (main/Main.java:516-526):
+
+    def __post_init__(self):
+        if self.dist_function not in METRICS:
+            raise ValueError(
+                f"dist_function must be one of {METRICS}, got {self.dist_function!r}"
+            )
+        if self.min_points < 1 or self.min_cluster_size < 1:
+            raise ValueError("minPts and minClSize must be >= 1")
+        if not (0.0 < self.k <= 1.0):
+            raise ValueError("k (sample fraction) must be in (0, 1]")
+        if self.processing_units < 1:
+            raise ValueError("processing_units must be >= 1")
+
+    @property
+    def base_name(self) -> str:
+        stem = os.path.basename(self.input_file) or "output"
+        return os.path.splitext(stem)[0]
+
+    def output_path(self, kind: str) -> str:
+        """The 5 canonical outputs (main/Main.java:534-614): hierarchy, tree,
+        partition, outlier_scores, visualization."""
+        suffix = {
+            "hierarchy": "_hierarchy.csv",
+            "tree": "_tree.csv",
+            "partition": "_partition.csv",
+            "outlier_scores": "_outlier_scores.csv",
+            "visualization": "_visualization.vis",
+        }[kind]
+        out_dir = self.out_dir or os.path.dirname(self.input_file) or "."
+        return os.path.join(out_dir, self.base_name + suffix)
+
+    @classmethod
+    def from_args(cls, argv: list[str]) -> "HDBSCANParams":
+        """Parse the reference's ``key=value`` flag strings."""
+        mapping = {
+            "file": ("input_file", str),
+            "minPts": ("min_points", int),
+            "minClSize": ("min_cluster_size", int),
+            "processing_units": ("processing_units", int),
+            "k": ("k", float),
+            "dist_function": ("dist_function", str),
+            "compact": ("compact_hierarchy", lambda s: s.lower() == "true"),
+            "constraints": ("constraints_file", str),
+            "clusterName": ("cluster_name", str),
+            "out_dir": ("out_dir", str),
+            "seed": ("seed", int),
+        }
+        kwargs = {}
+        for arg in argv:
+            if "=" not in arg:
+                raise ValueError(f"malformed flag {arg!r}; expected key=value")
+            key, _, value = arg.partition("=")
+            if key not in mapping:
+                raise ValueError(f"unknown flag {key!r}")
+            field, conv = mapping[key]
+            kwargs[field] = conv(value)
+        return cls(**kwargs)
+
+    def replace(self, **kw) -> "HDBSCANParams":
+        return dataclasses.replace(self, **kw)

@@ -1,0 +1,21 @@
+from hdbscan_tpu.core.distances import (  # noqa: F401
+    METRICS,
+    pairwise_distance,
+    self_distance_matrix,
+)
+from hdbscan_tpu.core.knn import (  # noqa: F401
+    core_distances,
+    core_distances_from_matrix,
+    mutual_reachability,
+    mutual_reachability_block,
+)
+from hdbscan_tpu.core.mst import boruvka_mst, mst_edges_with_self_edges  # noqa: F401
+from hdbscan_tpu.core.tree import (  # noqa: F401
+    CondensedTree,
+    build_merge_forest,
+    condense_forest,
+    extract_clusters,
+    flat_labels,
+    outlier_scores,
+    propagate_tree,
+)

@@ -1,0 +1,125 @@
+"""Exact single-block HDBSCAN* — the sequential-core capability (L3).
+
+The reference runs this inside one Spark task per small subset
+(``mappers/FirstStep.java:104-120`` -> ``HDBSCANStar.calculateCoreDistances`` /
+``constructMST``), then post-processes on the driver. Here the O(n^2) work
+(distances, core distances, mutual reachability, Borůvka MST) is one jitted
+XLA program on the TPU; the irregular condensed-tree extraction runs on host
+over the O(n) edge list (SURVEY.md §7 design stance).
+
+Scales to blocks whose dense n x n matrix fits HBM (~30k points in f32 on one
+v5e core); larger datasets go through the distributed recursive-sampling
+pipeline or the blocked exact path (see ``hdbscan_tpu.models``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hdbscan_tpu.config import HDBSCANParams
+from hdbscan_tpu.core import tree as tree_mod
+from hdbscan_tpu.core.knn import mutual_reachability_block
+from hdbscan_tpu.core.mst import boruvka_mst
+
+
+@dataclass
+class HDBSCANResult:
+    labels: np.ndarray  # flat partition, 0 = noise
+    tree: tree_mod.CondensedTree
+    core_distances: np.ndarray
+    mst: tuple[np.ndarray, np.ndarray, np.ndarray]  # (u, v, w) without self edges
+    outlier_scores: np.ndarray
+    infinite_stability: bool
+
+
+@partial(jax.jit, static_argnames=("min_pts", "metric"))
+def _device_block(x: jax.Array, min_pts: int, metric: str):
+    """Fused device program: distances -> core -> MRD -> Borůvka MST."""
+    mrd, core = mutual_reachability_block(x, min_pts, metric)
+    u, v, w, mask, labels = boruvka_mst(mrd)
+    return u, v, w, mask, core
+
+
+def hdbscan_block_edges(
+    x: np.ndarray, min_pts: int, metric: str = "euclidean"
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Device pass: returns (u, v, w) MST edges and core distances (host arrays)."""
+    u, v, w, mask, core = _device_block(jnp.asarray(x), min_pts, metric)
+    mask = np.asarray(mask)
+    return (
+        np.asarray(u)[mask],
+        np.asarray(v)[mask],
+        np.asarray(w, np.float64)[mask],
+        np.asarray(core, np.float64),
+    )
+
+
+def fit(
+    data: np.ndarray,
+    params: HDBSCANParams | None = None,
+    *,
+    num_constraints_satisfied: np.ndarray | None = None,
+) -> HDBSCANResult:
+    """Run exact HDBSCAN* on one block.
+
+    Equivalent capability to the canonical single-node pipeline the reference
+    documents (``main/Main.java:534-614``; call stack SURVEY.md §3.4).
+    """
+    params = params or HDBSCANParams()
+    if params.constraints_file and num_constraints_satisfied is None:
+        raise NotImplementedError(
+            "constraint files are not wired into the exact model yet; pass "
+            "num_constraints_satisfied explicitly or drop constraints="
+        )
+    data = np.asarray(data, np.float64)
+    n = len(data)
+    if n == 0:
+        raise ValueError("empty dataset")
+    u, v, w, core = hdbscan_block_edges(data, params.min_points, params.dist_function)
+    forest = tree_mod.build_merge_forest(n, u, v, w)
+    tree = tree_mod.condense_forest(
+        forest,
+        params.min_cluster_size,
+        self_levels=core if params.self_edges else None,
+    )
+    infinite = tree_mod.propagate_tree(tree, num_constraints_satisfied)
+    labels = tree_mod.flat_labels(tree)
+    scores = tree_mod.outlier_scores(tree, core)
+    return HDBSCANResult(
+        labels=labels,
+        tree=tree,
+        core_distances=core,
+        mst=(u, v, w),
+        outlier_scores=scores,
+        infinite_stability=infinite,
+    )
+
+
+def write_outputs(result: HDBSCANResult, params: HDBSCANParams) -> dict[str, str]:
+    """Emit the five canonical output files; returns {kind: path}."""
+    from hdbscan_tpu.utils import io as io_mod
+
+    paths = {}
+    hierarchy_path = params.output_path("hierarchy")
+    offsets = io_mod.write_hierarchy_file(
+        hierarchy_path, result.tree, params.compact_hierarchy
+    )
+    paths["hierarchy"] = hierarchy_path
+    tree_path = params.output_path("tree")
+    io_mod.write_tree_file(tree_path, result.tree, offsets)
+    paths["tree"] = tree_path
+    part_path = params.output_path("partition")
+    io_mod.write_partition_file(part_path, result.labels)
+    paths["partition"] = part_path
+    out_path = params.output_path("outlier_scores")
+    io_mod.write_outlier_scores_file(out_path, result.outlier_scores, result.core_distances)
+    paths["outlier_scores"] = out_path
+    vis_path = params.output_path("visualization")
+    io_mod.write_visualization_file(vis_path, result.tree, result.labels)
+    paths["visualization"] = vis_path
+    return paths

@@ -1,0 +1,54 @@
+"""Evaluation metrics: Adjusted Rand Index with noise-as-singletons.
+
+The reference validates with ARI treating each noise object as its own
+singleton cluster (ResearchReport.pdf §5.2); there is no code for it in the
+reference repo, so this fills the gap (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _noise_to_singletons(labels: np.ndarray, noise_label: int = 0) -> np.ndarray:
+    labels = np.asarray(labels).copy()
+    noise = labels == noise_label
+    if noise.any():
+        base = labels.max() + 1
+        labels[noise] = base + np.arange(noise.sum())
+    return labels
+
+
+def adjusted_rand_index(
+    a: np.ndarray,
+    b: np.ndarray,
+    noise_as_singletons: bool = True,
+    noise_label: int = 0,
+) -> float:
+    """ARI between two labelings; permutation-invariant, 1.0 = identical."""
+    a = np.asarray(a).ravel()
+    b = np.asarray(b).ravel()
+    if a.shape != b.shape:
+        raise ValueError("label arrays must have the same shape")
+    if noise_as_singletons:
+        a = _noise_to_singletons(a, noise_label)
+        b = _noise_to_singletons(b, noise_label)
+    n = a.size
+    _, ai = np.unique(a, return_inverse=True)
+    _, bi = np.unique(b, return_inverse=True)
+    na, nb = ai.max() + 1, bi.max() + 1
+    cont = np.zeros((na, nb), np.int64)
+    np.add.at(cont, (ai, bi), 1)
+
+    def comb2(x):
+        return x * (x - 1) / 2.0
+
+    sum_ij = comb2(cont).sum()
+    sum_a = comb2(cont.sum(1)).sum()
+    sum_b = comb2(cont.sum(0)).sum()
+    total = comb2(n)
+    expected = sum_a * sum_b / total if total else 0.0
+    max_index = (sum_a + sum_b) / 2.0
+    if max_index == expected:
+        return 1.0
+    return float((sum_ij - expected) / (max_index - expected))

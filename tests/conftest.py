@@ -1,0 +1,41 @@
+"""Test env: 8 virtual CPU devices (the tpu-native analog of Spark local[*],
+SURVEY.md §4) and float64 for parity with the reference's Java doubles."""
+
+import os
+
+# Force CPU even when the session environment pins JAX_PLATFORMS to a TPU
+# plugin: tests validate semantics on an 8-device virtual mesh in float64.
+# The environment's sitecustomize imports jax at interpreter start, so env
+# vars alone are too late — use jax.config before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def iris():
+    """The bundled 149x4 dataset (reference 数据集/dataset.txt)."""
+    path = "/root/reference/数据集/dataset.txt"
+    return np.loadtxt(path)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def make_blobs(rng, n=120, d=3, centers=3, spread=0.15):
+    """Tiny gaussian blobs helper shared by unit tests."""
+    centers_xy = rng.uniform(-3, 3, size=(centers, d))
+    assign = rng.integers(0, centers, size=n)
+    return centers_xy[assign] + rng.normal(0, spread, size=(n, d)), assign

@@ -1,0 +1,91 @@
+"""Golden tests: exact single-block path on the bundled 149x4 Iris file,
+validated against the NumPy oracle (reference params minPts=4, minClSize=4 —
+the hard-coded demo configuration, main/Main.java:71)."""
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.config import HDBSCANParams
+from hdbscan_tpu.models import hdbscan as model
+from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+from tests.oracle import oracle_hdbscan as O
+
+
+@pytest.fixture(scope="module")
+def iris_result(iris):
+    params = HDBSCANParams(min_points=4, min_cluster_size=4)
+    return model.fit(iris, params)
+
+
+@pytest.fixture(scope="module")
+def iris_oracle(iris):
+    return O.hdbscan_oracle(iris, 4, 4)
+
+
+def test_core_distances(iris_result, iris_oracle):
+    np.testing.assert_allclose(
+        iris_result.core_distances, iris_oracle["core"], rtol=1e-9
+    )
+
+
+def test_mst_total_weight(iris_result, iris_oracle):
+    got = iris_result.mst[2].sum()
+    u, v, w = iris_oracle["mst"]
+    want = w[: len(iris_result.mst[2])].sum()  # oracle includes self edges at tail
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+def test_flat_partition_matches_oracle(iris_result, iris_oracle):
+    assert adjusted_rand_index(iris_result.labels, iris_oracle["labels"]) == 1.0
+    # Iris has 2 natural density clusters at minPts=4 (setosa vs rest)
+    n_clusters = len(set(iris_result.labels) - {0})
+    assert n_clusters >= 2
+
+
+def test_glosh_matches_oracle(iris_result, iris_oracle):
+    # Device (dot-trick) and oracle (diff-based) euclidean distances agree to
+    # ~1e-11 relative; tie grouping makes the tree structure identical, the
+    # level values keep that float noise.
+    np.testing.assert_allclose(
+        iris_result.outlier_scores, iris_oracle["glosh"], rtol=1e-6, atol=1e-8
+    )
+
+
+def test_exit_levels_match_oracle(iris_result, iris_oracle):
+    np.testing.assert_allclose(
+        iris_result.tree.point_exit_level, iris_oracle["exit_level"], rtol=1e-6
+    )
+
+
+def test_output_files(tmp_path, iris, iris_result):
+    params = HDBSCANParams(
+        input_file="/root/reference/数据集/dataset.txt",
+        min_points=4,
+        min_cluster_size=4,
+        out_dir=str(tmp_path),
+    )
+    paths = model.write_outputs(iris_result, params)
+    assert set(paths) == {"hierarchy", "tree", "partition", "outlier_scores", "visualization"}
+    # partition file round-trips
+    flat = np.loadtxt(paths["partition"], delimiter=",")
+    np.testing.assert_array_equal(flat, iris_result.labels)
+    # hierarchy: first column descending epsilon, labels in range
+    rows = [l.split(",") for l in open(paths["hierarchy"]).read().splitlines()]
+    eps = [float(r[0]) for r in rows]
+    assert eps == sorted(eps, reverse=True)
+    assert all(len(r) == 1 + len(iris) for r in rows)
+    # tree file parses, has root with parent 0
+    tree_rows = [l.split(",") for l in open(paths["tree"]).read().splitlines()]
+    assert tree_rows[0][0] == "1" and tree_rows[0][-1] == "0"
+    # outlier scores sorted ascending
+    scores = [float(l.split(",")[0]) for l in open(paths["outlier_scores"])]
+    assert scores == sorted(scores)
+
+
+def test_alternate_metrics_run(iris):
+    """Distance plug-in configs (BASELINE.json config 4)."""
+    for metric in ("manhattan", "cosine"):
+        params = HDBSCANParams(min_points=4, min_cluster_size=4, dist_function=metric)
+        res = model.fit(iris, params)
+        oracle = O.hdbscan_oracle(iris, 4, 4, metric=metric)
+        assert adjusted_rand_index(res.labels, oracle["labels"]) == 1.0

@@ -1,0 +1,290 @@
+"""Independent NumPy oracle for HDBSCAN* semantics.
+
+A deliberately literal, slow re-implementation of the reference algorithms,
+following the Java control flow (Prim MST, top-down tie-grouped edge removal
+with BFS component discovery) rather than the TPU-friendly design of
+``hdbscan_tpu`` (Borůvka, bottom-up union-find + contraction). The two paths
+share no code, so agreement is a real check.
+
+Mirrors:
+- ``HDBSCANStar.calculateCoreDistances`` (HDBSCANStar.java:71-106), with the
+  per-point kNN buffer reset the reference accidentally hoisted.
+- ``HDBSCANStar.constructMST`` (HDBSCANStar.java:124-205) incl. self edges.
+- ``HdbscanDataBubbles.constructClusterTree`` (HdbscanDataBubbles.java:256-374)
+  with each post-removal component processed once.
+- ``Cluster.detachPoints`` / ``Cluster.propagate`` (Cluster.java:80-142).
+- ``HDBSCANStar.calculateOutlierScores`` (HDBSCANStar.java:653-686).
+
+Root birth level is +inf (the correct-math default documented in
+hdbscan_tpu/core/tree.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+INF = np.inf
+
+
+def pairwise(x, y, metric="euclidean"):
+    x = np.asarray(x, np.float64)
+    y = np.asarray(y, np.float64)
+    diff = x[:, None, :] - y[None, :, :]
+    if metric == "euclidean":
+        return np.sqrt((diff**2).sum(-1))
+    if metric == "manhattan":
+        return np.abs(diff).sum(-1)
+    if metric == "supremum":
+        return np.abs(diff).max(-1)
+    if metric == "cosine":
+        num = x @ y.T
+        den = np.linalg.norm(x, axis=1)[:, None] * np.linalg.norm(y, axis=1)[None, :]
+        return 1.0 - num / den
+    if metric == "pearson":
+        xc = x - x.mean(1, keepdims=True)
+        yc = y - y.mean(1, keepdims=True)
+        return pairwise(xc, yc, "cosine")
+    raise ValueError(metric)
+
+
+def core_distances(data, k, metric="euclidean"):
+    """Largest of the k-1 smallest distances including self-distance 0."""
+    n = len(data)
+    if k <= 1:
+        return np.zeros(n)
+    d = pairwise(data, data, metric)
+    srt = np.sort(d, axis=1)
+    kk = min(k - 1, n)
+    return srt[:, kk - 1]
+
+
+def prim_mst(data, core, self_edges=True, metric="euclidean"):
+    """Literal translation of HDBSCANStar.constructMST. Returns (u, v, w)."""
+    n = len(data)
+    d = pairwise(data, data, metric)
+    mrd = np.maximum(d, np.maximum(core[:, None], core[None, :]))
+    attached = np.zeros(n, bool)
+    nearest_nb = np.zeros(n, np.int64)
+    nearest_d = np.full(n, INF)
+    current = n - 1
+    attached[current] = True
+    us, vs, ws = [], [], []
+    for _ in range(n - 1):
+        unatt = ~attached
+        cand = np.where(unatt)[0]
+        upd = mrd[current, cand] < nearest_d[cand]
+        nearest_d[cand[upd]] = mrd[current, cand[upd]]
+        nearest_nb[cand[upd]] = current
+        # reference takes <=, scanning in index order -> last min wins
+        bi = cand[0]
+        bd = nearest_d[bi]
+        for j in cand[1:]:
+            if nearest_d[j] <= bd:
+                bd = nearest_d[j]
+                bi = j
+        attached[bi] = True
+        us.append(bi)
+        vs.append(nearest_nb[bi])
+        ws.append(nearest_d[bi])
+        current = bi
+    if self_edges:
+        for i in range(n):
+            us.append(i)
+            vs.append(i)
+            ws.append(core[i])
+    return np.array(us), np.array(vs), np.array(ws, np.float64)
+
+
+class OracleCluster:
+    def __init__(self, label, parent, birth, num_points):
+        self.label = label
+        self.parent = parent
+        self.birth = birth
+        self.death = 0.0
+        self.num_points = num_points
+        self.alive = num_points
+        self.stability = 0.0
+        self.members_at_birth = None  # snapshot
+        self.has_children = False
+        self.prop_stability = 0.0
+        self.prop_cons = 0
+        self.cons = 0
+        self.lowest_child_death = INF
+        self.prop_descendants = []
+
+    def detach(self, count, level):
+        inv_birth = 0.0 if np.isinf(self.birth) else 1.0 / self.birth
+        inv_level = INF if level == 0 else 1.0 / level
+        self.stability += count * (inv_level - inv_birth)
+        self.alive -= count
+        if self.alive <= 0:
+            self.death = level
+
+
+TIE_RTOL = 1e-9  # same tie-grouping contract as hdbscan_tpu.core.tree
+
+
+def condensed_tree(n, u, v, w, mcs, point_weights=None, tie_rtol=TIE_RTOL):
+    """Top-down tie-grouped edge removal; returns (clusters dict label->OracleCluster,
+    point_exit_level, point_last_cluster)."""
+    if point_weights is None:
+        point_weights = np.ones(n)
+    adj = [set() for _ in range(n)]
+    real = [(float(w[i]), int(u[i]), int(v[i])) for i in range(len(w))]
+    self_alive = np.zeros(n, bool)  # un-removed self edges (HDBSCANStar.java:196-203)
+    for wt, a, b in real:
+        if a != b:
+            adj[a].add(b)
+            adj[b].add(a)
+        else:
+            self_alive[a] = True
+    has_self_edges = bool(self_alive.any())
+    # sort descending by weight
+    order = sorted(range(len(real)), key=lambda i: (-real[i][0], real[i][1], real[i][2]))
+    labels = np.ones(n, np.int64)
+    clusters = {1: OracleCluster(1, -1, INF, float(point_weights.sum()))}
+    clusters[1].members_at_birth = set(range(n))
+    next_label = 2
+    exit_level = np.zeros(n)
+    last_cluster = np.ones(n, np.int64)
+    i = 0
+    while i < len(order):
+        wt = real[order[i]][0]
+        affected = {}
+        while i < len(order) and abs(real[order[i]][0] - wt) <= tie_rtol * max(abs(wt), 1e-300):
+            _, a, b = real[order[i]]
+            if a == b:
+                self_alive[a] = False
+            else:
+                adj[a].discard(b)
+                adj[b].discard(a)
+            i += 1
+            if labels[a] == 0:
+                continue
+            affected.setdefault(labels[a], set()).update((a, b))
+        for plabel, verts in affected.items():
+            seen = set()
+            comps = []
+            for r in sorted(verts):
+                if r in seen:
+                    continue
+                comp = {r}
+                queue = [r]
+                while queue:
+                    x = queue.pop()
+                    for y in adj[x]:
+                        if y not in comp:
+                            comp.add(y)
+                            queue.append(y)
+                seen |= comp
+                comps.append(comp)
+            parent = clusters[plabel]
+
+            def is_big(c):
+                # Noise when size < minClusterSize OR no edges remain
+                # (a lone vertex whose self edge is gone): the reference's
+                # "!anyEdges" rule, HDBSCANStar.java:361. Connected multi-
+                # vertex components always have edges; the rule only exists
+                # in the self-edge (points) tree — the live bubble tree has
+                # no self edges and lets a heavy singleton live on
+                # (HdbscanDataBubbles.java:330-352).
+                if point_weights[list(c)].sum() < mcs:
+                    return False
+                if len(c) == 1 and has_self_edges:
+                    (p,) = c
+                    return bool(adj[p]) or bool(self_alive[p])
+                return True
+
+            big = [c for c in comps if is_big(c)]
+            small = [c for c in comps if not is_big(c)]
+            if len(big) >= 2:
+                parent.has_children = True
+                for c in big:
+                    cl = OracleCluster(next_label, plabel, wt, point_weights[list(c)].sum())
+                    cl.members_at_birth = set(c)
+                    clusters[next_label] = cl
+                    for p in c:
+                        labels[p] = next_label
+                    parent.detach(point_weights[list(c)].sum(), wt)
+                    next_label += 1
+                for c in small:
+                    for p in c:
+                        labels[p] = 0
+                        exit_level[p] = wt
+                        last_cluster[p] = plabel
+                    parent.detach(point_weights[list(c)].sum(), wt)
+            else:
+                for c in small:
+                    for p in c:
+                        labels[p] = 0
+                        exit_level[p] = wt
+                        last_cluster[p] = plabel
+                    parent.detach(point_weights[list(c)].sum(), wt)
+    # points never detached keep exit 0, last = current label
+    for p in range(n):
+        if labels[p] != 0:
+            last_cluster[p] = labels[p]
+    return clusters, exit_level, last_cluster
+
+
+def propagate(clusters):
+    for label in sorted(clusters, reverse=True):
+        cl = clusters[label]
+        if cl.lowest_child_death == INF:
+            cl.lowest_child_death = cl.death
+        if cl.parent == -1 or cl.parent not in clusters:
+            continue
+        par = clusters[cl.parent]
+        par.lowest_child_death = min(par.lowest_child_death, cl.lowest_child_death)
+        if (not cl.has_children) or cl.cons > cl.prop_cons or (
+            cl.cons == cl.prop_cons and cl.stability >= cl.prop_stability
+        ):
+            par.prop_cons += cl.cons
+            par.prop_stability += cl.stability
+            par.prop_descendants.append(label)
+        else:
+            par.prop_cons += cl.prop_cons
+            par.prop_stability += cl.prop_stability
+            par.prop_descendants.extend(cl.prop_descendants)
+    return clusters[1].prop_descendants if 1 in clusters else []
+
+
+def flat_from_solution(n, clusters, solution):
+    out = np.zeros(n, np.int64)
+    for label in solution:
+        for p in clusters[label].members_at_birth:
+            out[p] = label
+    return out
+
+
+def glosh(clusters, exit_level, last_cluster):
+    n = len(exit_level)
+    scores = np.zeros(n)
+    for p in range(n):
+        cl = clusters[last_cluster[p]]
+        eps_max = cl.lowest_child_death
+        eps = exit_level[p]
+        scores[p] = 0.0 if eps == 0 else 1.0 - eps_max / eps
+    return scores
+
+
+def hdbscan_oracle(data, min_pts, min_cluster_size, metric="euclidean"):
+    """Full single-block pipeline: returns dict of everything."""
+    core = core_distances(data, min_pts, metric)
+    u, v, w = prim_mst(data, core, self_edges=True, metric=metric)
+    clusters, exit_level, last_cluster = condensed_tree(
+        len(data), u, v, w, min_cluster_size
+    )
+    solution = propagate(clusters)
+    labels = flat_from_solution(len(data), clusters, solution)
+    scores = glosh(clusters, exit_level, last_cluster)
+    return dict(
+        core=core,
+        mst=(u, v, w),
+        clusters=clusters,
+        solution=solution,
+        labels=labels,
+        exit_level=exit_level,
+        last_cluster=last_cluster,
+        glosh=scores,
+    )

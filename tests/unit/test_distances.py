@@ -1,0 +1,27 @@
+import numpy as np
+import pytest
+
+from hdbscan_tpu.core import distances as D
+from tests.oracle import oracle_hdbscan as O
+
+
+@pytest.mark.parametrize("metric", D.METRICS)
+def test_pairwise_matches_oracle(rng, metric):
+    x = rng.normal(size=(17, 5))
+    y = rng.normal(size=(11, 5))
+    got = np.asarray(D.pairwise_distance(x, y, metric))
+    want = O.pairwise(x, y, metric)
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-9)
+
+
+@pytest.mark.parametrize("metric", D.METRICS)
+def test_self_matrix_symmetric_zero_diag(rng, metric):
+    x = rng.normal(size=(13, 4))
+    d = np.asarray(D.self_distance_matrix(x, metric))
+    np.testing.assert_allclose(d, d.T, atol=1e-9)
+    np.testing.assert_allclose(np.diag(d), 0.0, atol=1e-12)
+
+
+def test_unknown_metric_raises(rng):
+    with pytest.raises(ValueError):
+        D.pairwise_distance(np.zeros((2, 2)), np.zeros((2, 2)), "hamming")

@@ -1,0 +1,41 @@
+import numpy as np
+import pytest
+
+from hdbscan_tpu.core import knn as K
+from tests.oracle import oracle_hdbscan as O
+
+
+@pytest.mark.parametrize("min_pts", [1, 2, 4, 16])
+def test_core_distances_match_oracle(rng, min_pts):
+    x = rng.normal(size=(40, 3))
+    got = np.asarray(K.core_distances(x, min_pts))
+    want = O.core_distances(x, min_pts)
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-9)
+
+
+def test_core_distance_min_pts_larger_than_block(rng):
+    x = rng.normal(size=(5, 2))
+    got = np.asarray(K.core_distances(x, 10))
+    # k-1 smallest of only 5 distances -> largest row distance
+    d = O.pairwise(x, x)
+    np.testing.assert_allclose(got, d.max(axis=1), rtol=1e-9)
+
+
+def test_mutual_reachability(rng):
+    x = rng.normal(size=(20, 3))
+    mrd, core = K.mutual_reachability_block(x, 4)
+    mrd, core = np.asarray(mrd), np.asarray(core)
+    d = O.pairwise(x, x)
+    want = np.maximum(d, np.maximum(core[:, None], core[None, :]))
+    np.testing.assert_allclose(mrd, want, rtol=1e-9, atol=1e-9)
+
+
+def test_padded_block_masks_invalid(rng):
+    x = rng.normal(size=(16, 3))
+    pad = np.zeros((8, 3))
+    xp = np.vstack([x, pad])
+    valid = np.arange(24) < 16
+    mrd, core = K.mutual_reachability_block(xp, 4, valid=valid)
+    core = np.asarray(core)
+    np.testing.assert_allclose(core[:16], O.core_distances(x, 4), rtol=1e-9)
+    assert np.all(np.isinf(core[16:]))

@@ -1,0 +1,103 @@
+import jax
+import numpy as np
+import pytest
+
+from hdbscan_tpu.core import knn as K
+from hdbscan_tpu.core import mst as M
+from tests.oracle import oracle_hdbscan as O
+
+
+def mst_total_weight_prim(mrd):
+    """Reference Prim on a dense matrix (independent check)."""
+    n = len(mrd)
+    in_tree = np.zeros(n, bool)
+    in_tree[0] = True
+    dist = mrd[0].copy()
+    total = 0.0
+    for _ in range(n - 1):
+        dist_masked = np.where(in_tree, np.inf, dist)
+        j = int(np.argmin(dist_masked))
+        total += dist_masked[j]
+        in_tree[j] = True
+        dist = np.minimum(dist, mrd[j])
+    return total
+
+
+@pytest.mark.parametrize("n", [2, 3, 17, 64])
+def test_boruvka_weight_matches_prim(rng, n):
+    x = rng.normal(size=(n, 3))
+    mrd, _ = K.mutual_reachability_block(x, min(4, n), )
+    mrd = np.asarray(mrd)
+    u, v, w, mask, labels = (np.asarray(a) for a in M.boruvka_mst(mrd))
+    assert mask.sum() == n - 1
+    assert len(np.unique(np.asarray(labels))) == 1  # fully connected
+    np.testing.assert_allclose(w[mask].sum(), mst_total_weight_prim(mrd), rtol=1e-9)
+    # edges form a spanning tree: union-find check
+    parent = np.arange(n)
+
+    def find(a):
+        while parent[a] != a:
+            parent[a] = parent[parent[a]]
+            a = parent[a]
+        return a
+
+    for a, b in zip(u[mask], v[mask]):
+        ra, rb = find(a), find(b)
+        assert ra != rb, "cycle in MST"
+        parent[ra] = rb
+
+
+def test_boruvka_with_tied_weights(rng):
+    # grid points -> many exactly-tied distances
+    xs, ys = np.meshgrid(np.arange(5.0), np.arange(5.0))
+    x = np.stack([xs.ravel(), ys.ravel()], axis=1)
+    mrd, _ = K.mutual_reachability_block(x, 4)
+    mrd = np.asarray(mrd)
+    u, v, w, mask, labels = (np.asarray(a) for a in M.boruvka_mst(mrd))
+    assert mask.sum() == len(x) - 1
+    np.testing.assert_allclose(w[mask].sum(), mst_total_weight_prim(mrd), rtol=1e-12)
+
+
+def test_boruvka_deterministic(rng):
+    x = rng.normal(size=(30, 2))
+    mrd, _ = K.mutual_reachability_block(x, 4)
+    r1 = [np.asarray(a) for a in M.boruvka_mst(mrd)]
+    r2 = [np.asarray(a) for a in M.boruvka_mst(mrd)]
+    for a, b in zip(r1, r2):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_boruvka_padded(rng):
+    n, pad = 20, 12
+    x = rng.normal(size=(n, 3))
+    xp = np.vstack([x, np.zeros((pad, 3))])
+    valid = np.arange(n + pad) < n
+    mrd_p, _ = K.mutual_reachability_block(xp, 4, valid=valid)
+    u, v, w, mask, _ = (np.asarray(a) for a in M.boruvka_mst(np.asarray(mrd_p), n))
+    mrd, _ = K.mutual_reachability_block(x, 4)
+    assert mask.sum() == n - 1
+    np.testing.assert_allclose(w[mask].sum(), mst_total_weight_prim(np.asarray(mrd)), rtol=1e-9)
+    assert u[mask].max() < n and v[mask].max() < n
+
+
+def test_boruvka_vmap_batch(rng):
+    b, n = 4, 32
+    xs = rng.normal(size=(b, n, 3))
+    mrds = np.stack([np.asarray(K.mutual_reachability_block(x, 4)[0]) for x in xs])
+    nv = np.array([n, n - 5, n - 1, 8])
+    batched = jax.vmap(M.boruvka_mst)(mrds, nv)
+    u, v, w, mask, labels = (np.asarray(a) for a in batched)
+    for i in range(b):
+        k = nv[i]
+        assert mask[i].sum() == k - 1
+        sub = mrds[i][:k, :k]
+        np.testing.assert_allclose(w[i][mask[i]].sum(), mst_total_weight_prim(sub), rtol=1e-9)
+
+
+def test_self_edges_append(rng):
+    x = rng.normal(size=(10, 2))
+    mrd, core = K.mutual_reachability_block(x, 3)
+    u, v, w, mask, _ = M.boruvka_mst(mrd)
+    uu, vv, ww, mm = (np.asarray(a) for a in M.mst_edges_with_self_edges(u, v, w, mask, core))
+    assert mm.sum() == 9 + 10
+    np.testing.assert_allclose(ww[-10:], np.asarray(core))

@@ -1,0 +1,147 @@
+import numpy as np
+import pytest
+
+from hdbscan_tpu.core import tree as T
+from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+from tests.conftest import make_blobs
+from tests.oracle import oracle_hdbscan as O
+
+
+def _cluster_signature(tree: T.CondensedTree):
+    """Multiset of (birth, death, stability, members) over non-root clusters."""
+    rows = [
+        (tree.birth[c], tree.death[c], round(tree.stability[c], 9), tree.num_members[c])
+        for c in range(2, tree.n_clusters + 1)
+    ]
+    return sorted(rows)
+
+
+def _oracle_signature(clusters):
+    rows = [
+        (c.birth, c.death, round(c.stability, 9), c.num_points)
+        for lbl, c in clusters.items()
+        if lbl != 1
+    ]
+    return sorted(rows)
+
+
+def test_hand_computed_two_blobs():
+    # Two tight pairs far apart + 1 straggler; minPts=2, mcs=2.
+    x = np.array([[0.0], [0.1], [10.0], [10.1], [5.0]])
+    res = O.hdbscan_oracle(x, 2, 2)
+    core = O.core_distances(x, 2)
+    u, v, w = O.prim_mst(x, core, self_edges=True)
+    forest = T.build_merge_forest(5, u, v, w)
+    tree = T.condense_forest(forest, 2, self_levels=core)
+    T.propagate_tree(tree)
+    labels = T.flat_labels(tree)
+    # Two clusters; the straggler exits as noise inside the left cluster but
+    # keeps its birth-membership label (reference findProminentClusters
+    # assigns via the hierarchy row at the cluster's first appearance,
+    # HDBSCANStar.java:567-625).
+    assert labels[0] == labels[1] != 0
+    assert labels[2] == labels[3] != 0
+    assert labels[0] != labels[2]
+    assert labels[4] == labels[0]
+    assert tree.point_exit_level[4] > 0  # it did become noise inside
+    assert adjusted_rand_index(labels, res["labels"]) == 1.0
+
+
+@pytest.mark.parametrize("seed,mcs,min_pts", [(0, 4, 4), (1, 4, 4), (2, 6, 3), (3, 2, 2)])
+def test_condensed_tree_matches_oracle(seed, mcs, min_pts):
+    rng = np.random.default_rng(seed)
+    x, _ = make_blobs(rng, n=90, d=2, centers=4, spread=0.2)
+    core = O.core_distances(x, min_pts)
+    u, v, w = O.prim_mst(x, core, self_edges=True)
+
+    oracle_clusters, oracle_exit, oracle_last = O.condensed_tree(len(x), u, v, w, mcs)
+    solution = O.propagate(oracle_clusters)
+    oracle_flat = O.flat_from_solution(len(x), oracle_clusters, solution)
+    oracle_scores = O.glosh(oracle_clusters, oracle_exit, oracle_last)
+
+    forest = T.build_merge_forest(len(x), u, v, w)
+    tree = T.condense_forest(forest, mcs, self_levels=core)
+    T.propagate_tree(tree)
+    flat = T.flat_labels(tree)
+    scores = T.outlier_scores(tree, core)
+
+    assert _cluster_signature(tree) == pytest.approx(_oracle_signature(oracle_clusters))
+    np.testing.assert_allclose(
+        np.sort(tree.point_exit_level), np.sort(oracle_exit), rtol=1e-12
+    )
+    np.testing.assert_allclose(tree.point_exit_level, oracle_exit, rtol=1e-12)
+    assert adjusted_rand_index(flat, oracle_flat) == 1.0
+    np.testing.assert_allclose(scores, oracle_scores, rtol=1e-9, atol=1e-12)
+
+
+def test_member_weighted_counts():
+    # 4 vertices: two "heavy bubbles" on each side; mcs=5 so only weighted
+    # counts reach cluster size.
+    x = np.array([[0.0], [0.2], [10.0], [10.2]])
+    weights = np.array([4, 3, 5, 2], np.float64)
+    core = O.core_distances(x, 2)
+    u, v, w = O.prim_mst(x, core, self_edges=False)
+    forest = T.build_merge_forest(4, u, v, w, point_weights=weights)
+    tree = T.condense_forest(forest, 5, point_weights=weights)
+    T.propagate_tree(tree)
+    labels = T.flat_labels(tree)
+    assert labels[0] == labels[1] != 0
+    assert labels[2] == labels[3] != 0
+    assert labels[0] != labels[2]
+
+
+def test_disconnected_edge_pool():
+    # Two separate components (no connecting edge): both become clusters.
+    u = np.array([0, 1, 3, 4])
+    v = np.array([1, 2, 4, 5])
+    w = np.array([1.0, 1.0, 1.0, 1.0])
+    tree, labels = T.extract_clusters(6, u, v, w, min_cluster_size=2)
+    assert labels[0] == labels[1] == labels[2] != 0
+    assert labels[3] == labels[4] == labels[5] != 0
+    assert labels[0] != labels[3]
+
+
+def test_tie_group_invariance():
+    # A 6-point chain with all-equal weights shatters into noise in one level:
+    # ties must be processed as one group (no intermediate clusters).
+    u = np.array([0, 1, 2, 3, 4])
+    v = np.array([1, 2, 3, 4, 5])
+    w = np.ones(5)
+    tree, labels = T.extract_clusters(6, u, v, w, min_cluster_size=4)
+    # single root cluster, no children, death at 1.0
+    assert tree.n_clusters == 1
+    assert tree.death[1] == 1.0
+    assert np.all(labels == 0)
+
+
+def test_min_cluster_size_one_matches_oracle():
+    """mcs=1: singleton clusters live until their self edge (core distance)
+    is removed — the reference's '!anyEdges' rule (HDBSCANStar.java:361)."""
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(14, 2))
+    core = O.core_distances(x, 2)
+    u, v, w = O.prim_mst(x, core, self_edges=True)
+    oracle_clusters, oracle_exit, oracle_last = O.condensed_tree(len(x), u, v, w, 1)
+    solution = O.propagate(oracle_clusters)
+    oracle_flat = O.flat_from_solution(len(x), oracle_clusters, solution)
+
+    forest = T.build_merge_forest(len(x), u, v, w)
+    tree = T.condense_forest(forest, 1, self_levels=core)
+    T.propagate_tree(tree)
+    flat = T.flat_labels(tree)
+    np.testing.assert_allclose(
+        np.sort(tree.point_exit_level), np.sort(oracle_exit), rtol=1e-12
+    )
+    assert adjusted_rand_index(flat, oracle_flat) == 1.0
+
+
+def test_tie_group_anchor_no_drift():
+    """Near-tied chain weights group against the FIRST weight of the group,
+    not pairwise: [w, w(1+0.9e-9), w(1+1.8e-9)] -> two levels, not one."""
+    w0 = 1.0
+    u = np.array([0, 1, 2])
+    v = np.array([1, 2, 3])
+    w = np.array([w0, w0 * (1 + 0.9e-9), w0 * (1 + 1.8e-9)])
+    forest = T.build_merge_forest(4, u, v, w)
+    dists = sorted(forest.dist[[i for i, c in enumerate(forest.children) if c is not None]])
+    assert len(dists) == 2  # first two contracted, third outside anchor tol
