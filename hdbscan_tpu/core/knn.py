@@ -9,10 +9,11 @@ Reference semantics (intent, with the buffer-reset bug at
 ``HDBSCANStar.java:79-81`` fixed — the reference hoists the kNN buffer out of
 the per-point loop, which leaks state across points; the original HDBSCAN*
 release resets per point, and we follow that): the core distance of a point is
-the largest of its ``minPts - 1`` smallest distances *including* the
-self-distance 0, i.e. the distance to its (minPts-1)-th nearest neighbour when
-the point itself counts as the 0-th. ``minPts == 1`` yields all zeros
-(``HDBSCANStar.java:75-77``).
+the largest of its ``minPts - 1`` smallest distances over the whole row of the
+self-distance matrix, whose diagonal (self-distance 0) participates — so for
+``minPts == 2`` every core distance is 0 (self + 1 slot), matching
+``kNNDistances[numNeighbors - 1]`` with self included in the reference scan.
+``minPts == 1`` yields all zeros (``HDBSCANStar.java:75-77``).
 """
 
 from __future__ import annotations
@@ -42,6 +43,12 @@ def core_distances_from_matrix(
         k = min(min_pts - 1, n)
         neg_topk, _ = jax.lax.top_k(-dist, k)
         core = -neg_topk[:, -1]
+        if valid is not None:
+            # Padded block with fewer valid columns than k: top_k picked a
+            # masked +inf column. Clamp to the farthest valid distance, the
+            # same behavior the static min(k, n) clamp gives unpadded blocks.
+            row_max = jnp.max(jnp.where(valid[None, :], dist, -inf), axis=1)
+            core = jnp.where(jnp.isinf(core), row_max, core)
     if valid is not None:
         core = jnp.where(valid, core, inf)
     return core

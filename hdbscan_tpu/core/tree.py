@@ -258,6 +258,19 @@ def condense_forest(
             point_last_cluster[p] = label
         detach(label, float(point_weights[pts].sum()), level)
 
+    def new_cluster(parent_label: int, birth_level: float, size: float) -> int:
+        label = len(parent_l)
+        parent_l.append(parent_label)
+        birth.append(birth_level)
+        death.append(0.0)
+        stability.append(0.0)
+        has_children.append(False)
+        num_members.append(float(size))
+        n_alive_points[label] = float(size)
+        has_children[parent_label] = True
+        detach(parent_label, float(size), birth_level)
+        return label
+
     # Work stack of (node, cluster_label).
     if len(forest.roots) == 1:
         stack = [(forest.roots[0], ROOT_LABEL)]
@@ -273,17 +286,7 @@ def condense_forest(
             stack.append((big[0], ROOT_LABEL))
         else:
             for r in big:
-                label = len(parent_l)
-                parent_l.append(ROOT_LABEL)
-                birth.append(np.inf)
-                death.append(0.0)
-                stability.append(0.0)
-                has_children.append(False)
-                num_members.append(float(sizes[r]))
-                n_alive_points[label] = float(sizes[r])
-                has_children[ROOT_LABEL] = True
-                detach(ROOT_LABEL, float(sizes[r]), np.inf)
-                stack.append((r, label))
+                stack.append((r, new_cluster(ROOT_LABEL, np.inf, float(sizes[r]))))
 
     while stack:
         node, label = stack.pop()
@@ -304,18 +307,8 @@ def condense_forest(
         if len(big) >= 2:
             # True split (newClusters.size() >= 2, HdbscanDataBubbles.java:353):
             # each big component becomes a new cluster born at delta.
-            has_children[label] = True
             for c in big:
-                child_label = len(parent_l)
-                parent_l.append(label)
-                birth.append(delta)
-                death.append(0.0)
-                stability.append(0.0)
-                has_children.append(False)
-                num_members.append(float(sizes[c]))
-                n_alive_points[child_label] = float(sizes[c])
-                detach(label, float(sizes[c]), delta)
-                stack.append((c, child_label))
+                stack.append((c, new_cluster(label, delta, float(sizes[c]))))
             for c in small:
                 exit_points(c, label, delta)
         elif len(big) == 1:

@@ -1,0 +1,125 @@
+"""Bubble-level HDBSCAN* — the local-model step of the MR pipeline.
+
+Re-design of ``main/LocalModelReduceByKey.call``
+(``main/LocalModelReduceByKey.java:29-108``), which per oversized subset runs:
+bubble core distances -> bubble MST -> edge sort -> simplified cluster tree ->
+prominent clusters + noise reassignment -> inter-cluster edges. Here the dense
+math (corrected distances, core distances, MRD, Borůvka MST) is one jitted XLA
+program; the condensed tree + excess-of-mass extraction reuse the L3 host code
+with member weights (``countMembers += nB[v]``, ``HdbscanDataBubbles.java:330-338``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hdbscan_tpu.core import tree as tree_mod
+from hdbscan_tpu.core.bubbles import (
+    bubble_core_distances,
+    bubble_distance_matrix,
+    bubble_mutual_reachability,
+    inter_cluster_edge_mask,
+    reassign_noise_bubbles,
+)
+from hdbscan_tpu.core.mst import boruvka_mst
+
+
+@dataclass
+class BubbleModel:
+    """Result of clustering one subset's bubbles.
+
+    ``labels``: flat cluster per bubble (0 only if the whole subset is noise —
+    noise bubbles are reassigned to their nearest cluster, mirroring
+    ``HdbscanDataBubbles.java:485-502``).
+    ``inter_edges``: (u, v, w) bubble-index MST edges crossing flat clusters —
+    the candidate inter-partition MST edges (``findInterClusterEdges``).
+    """
+
+    labels: np.ndarray
+    tree: tree_mod.CondensedTree
+    core: np.ndarray
+    mst: tuple[np.ndarray, np.ndarray, np.ndarray]
+    inter_edges: tuple[np.ndarray, np.ndarray, np.ndarray]
+
+
+@partial(jax.jit, static_argnames=("min_pts", "dims", "metric"))
+def _bubble_device_block(rep, extent, nn_dist, n_b, min_pts: int, dims: int, metric: str):
+    """Fused device program: corrected distances -> core -> MRD -> Borůvka."""
+    dist = bubble_distance_matrix(rep, extent, nn_dist, metric)
+    core = bubble_core_distances(dist, n_b, extent, min_pts, dims)
+    mrd = bubble_mutual_reachability(dist, core)
+    u, v, w, mask, _ = boruvka_mst(mrd)
+    return dist, core, u, v, w, mask
+
+
+def fit_bubbles(
+    rep: np.ndarray,
+    extent: np.ndarray,
+    nn_dist: np.ndarray,
+    n_b: np.ndarray,
+    min_pts: int,
+    min_cluster_size: int,
+    metric: str = "euclidean",
+) -> BubbleModel:
+    """Cluster one subset's bubbles; returns flat labels + inter-cluster edges."""
+    rep = jnp.asarray(rep)
+    m, dims = rep.shape
+    if m == 0:
+        raise ValueError("empty bubble set")
+    if m == 1:
+        # Degenerate subset: single bubble, trivially one (root) cluster —
+        # built through the standard tree path so the contract holds.
+        empty = np.zeros(0, np.int64)
+        forest = tree_mod.build_merge_forest(
+            1, empty, empty, np.zeros(0), point_weights=np.asarray(n_b, np.float64)
+        )
+        tree = tree_mod.condense_forest(
+            forest, min_cluster_size, point_weights=np.asarray(n_b, np.float64)
+        )
+        tree_mod.propagate_tree(tree)
+        return BubbleModel(
+            labels=np.ones(1, np.int64),
+            tree=tree,
+            core=np.zeros(1),
+            mst=(empty, empty, np.zeros(0)),
+            inter_edges=(empty, empty, np.zeros(0)),
+        )
+    dist, core, u, v, w, mask = _bubble_device_block(
+        rep,
+        jnp.asarray(extent),
+        jnp.asarray(nn_dist),
+        jnp.asarray(n_b, rep.dtype),
+        min_pts,
+        dims,
+        metric,
+    )
+    mask = np.asarray(mask)
+    u = np.asarray(u)[mask]
+    v = np.asarray(v)[mask]
+    w = np.asarray(w, np.float64)[mask]
+    core_h = np.asarray(core, np.float64)
+    weights = np.asarray(n_b, np.float64)
+
+    forest = tree_mod.build_merge_forest(m, u, v, w, point_weights=weights)
+    tree = tree_mod.condense_forest(
+        forest, min_cluster_size, point_weights=weights, self_levels=core_h
+    )
+    tree_mod.propagate_tree(tree)
+    labels = tree_mod.flat_labels(tree)
+
+    labels = np.asarray(
+        reassign_noise_bubbles(dist, jnp.asarray(labels)), np.int64
+    )
+    cross = np.asarray(inter_cluster_edge_mask(jnp.asarray(u), jnp.asarray(v), jnp.asarray(labels)))
+    return BubbleModel(
+        labels=labels,
+        tree=tree,
+        core=core_h,
+        mst=(u, v, w),
+        inter_edges=(u[cross], v[cross], w[cross]),
+    )

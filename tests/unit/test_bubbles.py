@@ -1,0 +1,204 @@
+"""L4 data-bubble unit tests: CF stats, corrected distance, bubble core
+distances, bubble clustering, noise reassignment, inter-cluster edges."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hdbscan_tpu.core.bubbles import (
+    bubble_core_distances,
+    bubble_distance_matrix,
+    bubble_stats,
+    inter_cluster_edge_mask,
+    reassign_noise_bubbles,
+)
+from hdbscan_tpu.models.bubble_hdbscan import fit_bubbles
+from tests.conftest import make_blobs
+
+
+class TestBubbleStats:
+    def test_rep_is_mean(self, rng):
+        pts = rng.normal(size=(30, 4))
+        assign = rng.integers(0, 3, size=30)
+        rep, extent, nn_dist, n = bubble_stats(jnp.asarray(pts), jnp.asarray(assign), 3)
+        for b in range(3):
+            sel = pts[assign == b]
+            np.testing.assert_allclose(np.asarray(rep)[b], sel.mean(0), rtol=1e-12)
+            assert n[b] == len(sel)
+
+    def test_extent_matches_pairwise_rms(self, rng):
+        # extent^2 = sum_dims (2n*SS - 2*LS^2)/(n(n-1)) equals the mean squared
+        # pairwise distance within the bubble (the data-bubble definition).
+        pts = rng.normal(size=(40, 3))
+        assign = np.zeros(40, np.int64)
+        _, extent, _, _ = bubble_stats(jnp.asarray(pts), jnp.asarray(assign), 1)
+        diffs = pts[:, None, :] - pts[None, :, :]
+        sq = (diffs**2).sum(-1)
+        expected = np.sqrt(sq.sum() / (40 * 39))
+        np.testing.assert_allclose(float(extent[0]), expected, rtol=1e-10)
+
+    def test_nn_dist_formula(self, rng):
+        pts = rng.normal(size=(25, 5))
+        assign = np.zeros(25, np.int64)
+        _, extent, nn_dist, _ = bubble_stats(jnp.asarray(pts), jnp.asarray(assign), 1)
+        np.testing.assert_allclose(
+            float(nn_dist[0]), (1 / 25) ** (1 / 5) * float(extent[0]), rtol=1e-12
+        )
+
+    def test_singleton_bubble_zero_extent(self):
+        pts = jnp.asarray([[1.0, 2.0], [5.0, 5.0]])
+        rep, extent, nn_dist, n = bubble_stats(pts, jnp.asarray([0, 1]), 2)
+        assert float(extent[0]) == 0.0 and float(nn_dist[0]) == 0.0
+        np.testing.assert_allclose(np.asarray(rep), np.asarray(pts))
+
+    def test_empty_bubble(self):
+        pts = jnp.asarray([[1.0, 2.0]])
+        rep, extent, nn_dist, n = bubble_stats(pts, jnp.asarray([0]), 3)
+        assert float(n[1]) == 0.0 and float(n[2]) == 0.0
+        assert np.all(np.isfinite(np.asarray(rep)))
+
+    def test_padding_rows_dropped(self):
+        pts = jnp.asarray([[1.0], [2.0], [99.0]])
+        # padding row assigned id == num_bubbles -> dropped by segment ops
+        rep, _, _, n = bubble_stats(pts, jnp.asarray([0, 0, 1]), 1)
+        np.testing.assert_allclose(float(rep[0, 0]), 1.5)
+        assert float(n[0]) == 2.0
+
+
+class TestBubbleDistance:
+    def test_non_overlapping_correction(self):
+        rep = jnp.asarray([[0.0], [10.0]])
+        extent = jnp.asarray([1.0, 2.0])
+        nn = jnp.asarray([0.5, 0.25])
+        d = bubble_distance_matrix(rep, extent, nn)
+        # 10 - (1+2) + (0.5+0.25)
+        np.testing.assert_allclose(float(d[0, 1]), 7.75)
+        np.testing.assert_allclose(float(d[1, 0]), 7.75)
+        assert float(d[0, 0]) == 0.0
+
+    def test_overlapping_collapses_to_max_nn(self):
+        rep = jnp.asarray([[0.0], [1.0]])
+        extent = jnp.asarray([2.0, 2.0])
+        nn = jnp.asarray([0.3, 0.7])
+        d = bubble_distance_matrix(rep, extent, nn)
+        np.testing.assert_allclose(float(d[0, 1]), 0.7)
+
+
+class TestBubbleCoreDistances:
+    def test_self_contained(self):
+        # Bubble 0 has plenty of members: core from its own extent.
+        rep = jnp.asarray([[0.0, 0.0], [10.0, 0.0]])
+        extent = jnp.asarray([2.0, 0.1])
+        nn = jnp.asarray([0.2, 0.05])
+        n_b = jnp.asarray([100.0, 100.0])
+        dist = bubble_distance_matrix(rep, extent, nn)
+        core = bubble_core_distances(dist, n_b, extent, min_pts=5, d=2)
+        np.testing.assert_allclose(float(core[0]), (4 / 100) ** 0.5 * 2.0, rtol=1e-12)
+
+    def test_needs_neighbor(self):
+        # Bubble 0 has 2 members, needs 4 neighbors -> extrapolates into
+        # nearest bubble.
+        rep = jnp.asarray([[0.0], [3.0], [50.0]])
+        extent = jnp.asarray([0.5, 1.0, 1.0])
+        nn = jnp.asarray([0.1, 0.2, 0.2])
+        n_b = jnp.asarray([2.0, 10.0, 10.0])
+        dist = bubble_distance_matrix(rep, extent, nn)
+        core = bubble_core_distances(dist, n_b, extent, min_pts=5, d=1)
+        # needs k'=4; covers 2 itself, aux=2 into bubble 1 (n=10, e=1)
+        expected = float(dist[0, 1]) + (2 / 10) ** 1.0 * 1.0
+        np.testing.assert_allclose(float(core[0]), expected, rtol=1e-12)
+
+    def test_min_pts_one_zeros(self):
+        dist = jnp.zeros((3, 3))
+        core = bubble_core_distances(
+            dist, jnp.ones(3), jnp.zeros(3), min_pts=1, d=2
+        )
+        assert np.all(np.asarray(core) == 0)
+
+    def test_valid_mask_inf(self):
+        rep = jnp.asarray([[0.0], [1.0], [0.0]])
+        extent = jnp.zeros(3)
+        nn = jnp.zeros(3)
+        n_b = jnp.asarray([5.0, 5.0, 0.0])
+        dist = bubble_distance_matrix(rep, extent, nn)
+        core = bubble_core_distances(
+            dist, n_b, extent, min_pts=3, d=1, valid=jnp.asarray([True, True, False])
+        )
+        assert np.isinf(float(core[2]))
+        assert np.isfinite(float(core[0]))
+
+
+class TestNoiseReassignment:
+    def test_noise_takes_nearest_label(self):
+        dist = jnp.asarray(
+            [[0.0, 1.0, 5.0], [1.0, 0.0, 5.0], [5.0, 4.0, 0.0]]
+        )
+        labels = jnp.asarray([2, 0, 3])
+        new = np.asarray(reassign_noise_bubbles(dist, labels))
+        assert new[1] == 2  # nearest donor of bubble 1 is bubble 0
+        assert new[0] == 2 and new[2] == 3
+
+    def test_all_noise_unchanged(self):
+        dist = jnp.ones((2, 2))
+        labels = jnp.asarray([0, 0])
+        new = np.asarray(reassign_noise_bubbles(dist, labels))
+        assert np.all(new == 0)
+
+    def test_donor_snapshot_not_chained(self):
+        # bubble 2's nearest overall is noise bubble 1; nearest DONOR is 0.
+        dist = jnp.asarray(
+            [[0.0, 9.0, 3.0], [9.0, 0.0, 1.0], [3.0, 1.0, 0.0]]
+        )
+        labels = jnp.asarray([7, 0, 0])
+        new = np.asarray(reassign_noise_bubbles(dist, labels))
+        assert new[1] == 7 and new[2] == 7
+
+
+class TestInterEdges:
+    def test_mask(self):
+        u = jnp.asarray([0, 1, 2])
+        v = jnp.asarray([1, 2, 3])
+        labels = jnp.asarray([1, 1, 2, 2])
+        mask = np.asarray(inter_cluster_edge_mask(u, v, labels))
+        np.testing.assert_array_equal(mask, [False, True, False])
+
+
+class TestFitBubbles:
+    def test_two_blob_bubbles(self, rng):
+        pts, truth = make_blobs(rng, n=200, d=2, centers=2, spread=0.1)
+        # Build bubbles from a 20-sample stratified assignment.
+        samples = np.concatenate(
+            [rng.choice(np.nonzero(truth == c)[0], 10, replace=False) for c in range(2)]
+        )
+        d2 = ((pts[:, None, :] - pts[samples][None, :, :]) ** 2).sum(-1)
+        assign = d2.argmin(1)
+        rep, extent, nn, n_b = bubble_stats(jnp.asarray(pts), jnp.asarray(assign), 20)
+        model = fit_bubbles(
+            np.asarray(rep), np.asarray(extent), np.asarray(nn), np.asarray(n_b),
+            min_pts=4, min_cluster_size=2,
+        )
+        # All bubbles labeled, and bubble labels separate the two blobs.
+        assert np.all(model.labels > 0)
+        lbl_per_truth = [set(model.labels[truth[samples] == c]) for c in range(2)]
+        assert lbl_per_truth[0].isdisjoint(lbl_per_truth[1])
+
+    def test_single_bubble_degenerate(self):
+        model = fit_bubbles(
+            np.zeros((1, 2)), np.zeros(1), np.zeros(1), np.ones(1),
+            min_pts=4, min_cluster_size=2,
+        )
+        assert model.labels.tolist() == [1]
+
+    def test_inter_edges_cross_labels(self, rng):
+        pts, truth = make_blobs(rng, n=200, d=2, centers=3, spread=0.05)
+        samples = rng.choice(200, 30, replace=False)
+        d2 = ((pts[:, None, :] - pts[samples][None, :, :]) ** 2).sum(-1)
+        assign = d2.argmin(1)
+        rep, extent, nn, n_b = bubble_stats(jnp.asarray(pts), jnp.asarray(assign), 30)
+        model = fit_bubbles(
+            np.asarray(rep), np.asarray(extent), np.asarray(nn), np.asarray(n_b),
+            min_pts=3, min_cluster_size=2,
+        )
+        u, v, w = model.inter_edges
+        for a, b in zip(u, v):
+            assert model.labels[a] != model.labels[b]
