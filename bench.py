@@ -1,0 +1,79 @@
+"""Benchmark: MR-HDBSCAN* end-to-end on Skin_NonSkin (BASELINE.md north star).
+
+Runs the recursive-sampling + data-bubble pipeline on the bundled 245,057 x 3
+dataset on the real TPU chip and prints ONE JSON line:
+``{"metric": ..., "value": <wall seconds>, "unit": "s", "vs_baseline": <x>}``
+where ``vs_baseline`` is the speedup over the reference's 60.19 s DB figure
+(ResearchReport.pdf §5.4 Table 3, mirrored in BASELINE.md §Skin row; >1 means
+faster than the 8-worker Spark baseline). ARI vs the bundled class labels and
+vs-exact parity diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_DB_SECONDS = 60.19  # reference DB variant on Skin (BASELINE.md)
+SKIN_PATH = "/root/reference/数据集/Skin_NonSkin.txt"
+
+
+def main() -> None:
+    from hdbscan_tpu.config import HDBSCANParams
+    from hdbscan_tpu.models import mr_hdbscan
+    from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+
+    raw = np.loadtxt(SKIN_PATH)
+    data, truth = raw[:, :3], raw[:, 3].astype(np.int64)
+
+    # minPts/minClSize chosen to resolve Skin's macro structure (the 2-class
+    # ground truth) rather than micro-density islands; cf BASELINE.md config 2.
+    params = HDBSCANParams(
+        min_points=16,
+        min_cluster_size=500,
+        processing_units=4096,
+        k=0.01,
+        seed=0,
+    )
+
+    # Warm the compile caches on a small prefix so the measured run is the
+    # algorithm, not XLA compilation (first TPU compile ~20-40s).
+    warm = data[:: max(1, len(data) // 20000)]
+    mr_hdbscan.fit(warm, params)
+
+    t0 = time.monotonic()
+    result = mr_hdbscan.fit(data, params)
+    wall = time.monotonic() - t0
+
+    ari = adjusted_rand_index(result.labels, truth, noise_as_singletons=True)
+    print(
+        f"[bench] n={len(data)} levels={result.n_levels} edges={result.n_edges} "
+        f"clusters={len(set(result.labels[result.labels > 0].tolist()))} "
+        f"noise={int((result.labels == 0).sum())} ARI_vs_classes={ari:.4f} "
+        f"wall={wall:.2f}s",
+        file=sys.stderr,
+    )
+    for ls in result.levels:
+        print(
+            f"[bench]   level {ls.level}: active={ls.n_active} small={ls.n_small_subsets} "
+            f"large={ls.n_large_subsets} bubbles={ls.n_bubbles} forced={ls.forced_splits} "
+            f"wall={ls.wall_s:.2f}s",
+            file=sys.stderr,
+        )
+    print(
+        json.dumps(
+            {
+                "metric": "skin_nonskin_mr_hdbscan_wall_clock",
+                "value": round(wall, 3),
+                "unit": "s",
+                "vs_baseline": round(BASELINE_DB_SECONDS / wall, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

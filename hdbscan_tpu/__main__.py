@@ -1,0 +1,3 @@
+from hdbscan_tpu.cli import main
+
+raise SystemExit(main())

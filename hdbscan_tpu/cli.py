@@ -1,0 +1,79 @@
+"""Command-line driver — the ``Main.main`` capability (L6) without its bugs.
+
+Usage mirrors the reference's documented contract (``main/Main.java:534-614``)::
+
+    python -m hdbscan_tpu file=<input> minPts=4 minClSize=4 \
+        [processing_units=N] [k=0.2] [constraints=<csv>] [compact={true,false}] \
+        [dist_function={euclidean,cosine,pearson,manhattan,supremum}] \
+        [out_dir=DIR] [seed=N]
+
+Unlike the reference, argv is actually honored (the reference shadows it with
+hard-coded args, ``main/Main.java:71`` — treated as a bug, SURVEY.md §7), and
+the dataset is routed automatically: inputs that fit ``processing_units`` run
+the exact single-block path; larger inputs run the full recursive-sampling +
+data-bubble pipeline. Outputs are the five canonical files either way.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from hdbscan_tpu.config import HDBSCANParams
+
+HELP = __doc__
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or any(a in ("-h", "--help", "help") for a in argv):
+        print(HELP)
+        return 0
+    try:
+        params = HDBSCANParams.from_args(argv)
+    except ValueError as e:
+        print(f"error: {e}\n{HELP}", file=sys.stderr)
+        return 2
+    if not params.input_file:
+        print("error: file=<input> is required", file=sys.stderr)
+        return 2
+
+    import numpy as np
+
+    from hdbscan_tpu.models import hdbscan, mr_hdbscan
+    from hdbscan_tpu.utils.io import load_points
+
+    data = load_points(params.input_file)
+    if data.ndim == 1:
+        data = data[:, None]
+    n = len(data)
+    t0 = time.monotonic()
+    if n <= params.processing_units:
+        result = hdbscan.fit(data, params)
+        mode = "exact"
+    else:
+        result = mr_hdbscan.fit(data, params)
+        mode = f"mr ({result.n_levels} levels)"
+    wall = time.monotonic() - t0
+
+    paths = hdbscan.write_outputs(result, params)
+    n_clusters = len(set(result.labels[result.labels > 0].tolist()))
+    n_noise = int(np.sum(result.labels == 0))
+    print(
+        f"hdbscan-tpu: {n} points, {mode}, {n_clusters} clusters, "
+        f"{n_noise} noise, {wall:.2f}s"
+    )
+    if result.infinite_stability:
+        # The reference's canonical warning (HDBSCANStar.java:40-47 intent).
+        print(
+            "WARNING: some clusters have infinite stability (duplicate points "
+            "denser than minPts); results may be unreliable at those clusters.",
+            file=sys.stderr,
+        )
+    for kind, path in paths.items():
+        print(f"  {kind}: {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

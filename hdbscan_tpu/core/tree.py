@@ -242,10 +242,13 @@ def condense_forest(
         return out
 
     def detach(label: int, count: float, level: float) -> None:
-        # Cluster.detachPoints (hdbscanstar/Cluster.java:80-88)
-        with np.errstate(divide="ignore"):
-            inv_level = np.divide(1.0, level) if level != 0 else np.inf
-            inv_birth = 0.0 if np.isinf(birth[label]) else 1.0 / birth[label]
+        # Cluster.detachPoints (hdbscanstar/Cluster.java:80-88). Zero levels
+        # (duplicate points) follow Java's IEEE semantics: 1/0 = +inf, which
+        # surfaces as the reference's infinite-stability warning
+        # (HDBSCANStar.java:40-47) rather than an error.
+        inv_level = np.inf if level == 0 else 1.0 / level
+        b = birth[label]
+        inv_birth = 0.0 if np.isinf(b) else (np.inf if b == 0 else 1.0 / b)
         stability[label] += count * (inv_level - inv_birth)
         n_alive_points[label] -= count
         if n_alive_points[label] <= 0:

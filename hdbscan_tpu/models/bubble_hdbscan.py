@@ -48,12 +48,18 @@ class BubbleModel:
 
 
 @partial(jax.jit, static_argnames=("min_pts", "dims", "metric"))
-def _bubble_device_block(rep, extent, nn_dist, n_b, min_pts: int, dims: int, metric: str):
-    """Fused device program: corrected distances -> core -> MRD -> Borůvka."""
+def _bubble_device_block(rep, extent, nn_dist, n_b, num_valid, min_pts: int, dims: int, metric: str):
+    """Fused device program: corrected distances -> core -> MRD -> Borůvka.
+
+    ``num_valid``: leading count of real bubbles (rest is shape padding so
+    level-to-level calls of similar size reuse the compiled program).
+    """
+    m = rep.shape[0]
+    valid = jnp.arange(m, dtype=jnp.int32) < num_valid
     dist = bubble_distance_matrix(rep, extent, nn_dist, metric)
-    core = bubble_core_distances(dist, n_b, extent, min_pts, dims)
+    core = bubble_core_distances(dist, n_b, extent, min_pts, dims, valid=valid)
     mrd = bubble_mutual_reachability(dist, core)
-    u, v, w, mask, _ = boruvka_mst(mrd)
+    u, v, w, mask, _ = boruvka_mst(mrd, num_valid)
     return dist, core, u, v, w, mask
 
 
@@ -65,22 +71,27 @@ def fit_bubbles(
     min_pts: int,
     min_cluster_size: int,
     metric: str = "euclidean",
+    num_valid: int | None = None,
 ) -> BubbleModel:
-    """Cluster one subset's bubbles; returns flat labels + inter-cluster edges."""
+    """Cluster one subset's bubbles; returns flat labels + inter-cluster edges.
+
+    ``num_valid``: real bubble count when the inputs are shape-padded; all
+    returned arrays are sliced back to it.
+    """
     rep = jnp.asarray(rep)
-    m, dims = rep.shape
+    m_pad, dims = rep.shape
+    m = m_pad if num_valid is None else int(num_valid)
     if m == 0:
         raise ValueError("empty bubble set")
     if m == 1:
         # Degenerate subset: single bubble, trivially one (root) cluster —
         # built through the standard tree path so the contract holds.
         empty = np.zeros(0, np.int64)
+        w1 = np.asarray(n_b, np.float64)[:1]
         forest = tree_mod.build_merge_forest(
-            1, empty, empty, np.zeros(0), point_weights=np.asarray(n_b, np.float64)
+            1, empty, empty, np.zeros(0), point_weights=w1
         )
-        tree = tree_mod.condense_forest(
-            forest, min_cluster_size, point_weights=np.asarray(n_b, np.float64)
-        )
+        tree = tree_mod.condense_forest(forest, min_cluster_size, point_weights=w1)
         tree_mod.propagate_tree(tree)
         return BubbleModel(
             labels=np.ones(1, np.int64),
@@ -94,6 +105,7 @@ def fit_bubbles(
         jnp.asarray(extent),
         jnp.asarray(nn_dist),
         jnp.asarray(n_b, rep.dtype),
+        jnp.int32(m),
         min_pts,
         dims,
         metric,
@@ -102,15 +114,13 @@ def fit_bubbles(
     u = np.asarray(u)[mask]
     v = np.asarray(v)[mask]
     w = np.asarray(w, np.float64)[mask]
-    core_h = np.asarray(core, np.float64)
-    weights = np.asarray(n_b, np.float64)
+    core_h = np.asarray(core, np.float64)[:m]
+    dist = dist[:m, :m]
+    weights = np.asarray(n_b, np.float64)[:m]
 
-    forest = tree_mod.build_merge_forest(m, u, v, w, point_weights=weights)
-    tree = tree_mod.condense_forest(
-        forest, min_cluster_size, point_weights=weights, self_levels=core_h
+    tree, labels = tree_mod.extract_clusters(
+        m, u, v, w, min_cluster_size, point_weights=weights, self_levels=core_h
     )
-    tree_mod.propagate_tree(tree)
-    labels = tree_mod.flat_labels(tree)
 
     labels = np.asarray(
         reassign_noise_bubbles(dist, jnp.asarray(labels)), np.int64

@@ -71,11 +71,6 @@ def fit(
     documents (``main/Main.java:534-614``; call stack SURVEY.md §3.4).
     """
     params = params or HDBSCANParams()
-    if params.constraints_file and num_constraints_satisfied is None:
-        raise NotImplementedError(
-            "constraint files are not wired into the exact model yet; pass "
-            "num_constraints_satisfied explicitly or drop constraints="
-        )
     data = np.asarray(data, np.float64)
     n = len(data)
     if n == 0:
@@ -87,6 +82,15 @@ def fit(
         params.min_cluster_size,
         self_levels=core if params.self_edges else None,
     )
+    if params.constraints_file and num_constraints_satisfied is None:
+        from hdbscan_tpu.core.constraints import (
+            count_constraints_satisfied,
+            load_constraints,
+        )
+
+        num_constraints_satisfied, _ = count_constraints_satisfied(
+            tree, load_constraints(params.constraints_file)
+        )
     infinite = tree_mod.propagate_tree(tree, num_constraints_satisfied)
     labels = tree_mod.flat_labels(tree)
     scores = tree_mod.outlier_scores(tree, core)

@@ -1,0 +1,297 @@
+"""MR-HDBSCAN* — recursive sampling + data bubbles, TPU-orchestrated (L6).
+
+Re-design of the reference driver's phase-1/2/3 structure
+(``main/Main.java:107-411``; call stack SURVEY.md §3.1-3.3) without the Spark
+shuffle/HDFS-file dataflow:
+
+- Per level (``while processedPointsCounter < datasetSize``,
+  ``main/Main.java:107``): subsets that fit ``processing_units`` run the exact
+  batched block kernel (one vmapped device launch for ALL small subsets, vs one
+  Spark task each — ``mappers/FirstStep.java:104-120``); oversized subsets are
+  stratified-sampled (``sampleByKeyExact``, ``main/Main.java:132-141``),
+  summarized into data bubbles keyed by nearest sample
+  (``FirstStep.java:74-102`` + ``CombineStep``), the bubbles are clustered
+  (``main/LocalModelReduceByKey.java:29-108``), and each point's next-level
+  subset is its bubble's flat cluster (``main/LabelClassification.java:21-37``
+  + driver renumbering ``main/Main.java:272-289``).
+- Bubble-MST edges crossing flat clusters become inter-partition candidate
+  edges mapped to the sample points' global ids (``main/Main.java:248-265``).
+- Global hierarchy: instead of the reference's aborted top-down
+  connected-components loop (``System.exit(1)`` at ``main/Main.java:408``),
+  the bottom-up union-find dendrogram its report recommends
+  (ResearchReport.pdf §3.3.3): Kruskal over the pooled local-MST + inter-
+  cluster edges, condensed tree, EOM extraction, GLOSH (SURVEY.md §7 step 5).
+
+Deviation (guarded non-termination): the reference loops forever if a subset's
+bubble model yields a single flat cluster (the subset re-enters whole). Here
+such a subset is force-split into capacity-sized groups of *spatially ordered*
+bubbles (order = bubble MST traversal), and the bubble-MST edges crossing
+groups join the edge pool, so the hierarchy stays connected.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+from hdbscan_tpu.config import HDBSCANParams
+from hdbscan_tpu.core import tree as tree_mod
+from hdbscan_tpu.core.bubbles import bubble_stats
+from hdbscan_tpu.models.bubble_hdbscan import fit_bubbles
+from hdbscan_tpu.parallel.blocks import (
+    _next_pow2,
+    nearest_sample_assign,
+    pack_blocks,
+    run_packed_blocks,
+)
+
+
+@dataclass
+class LevelStats:
+    """Per-level trace record (the structured replacement for the reference's
+    println progress, SURVEY.md §5.1)."""
+
+    level: int
+    n_active: int
+    n_small_subsets: int
+    n_large_subsets: int
+    n_processed: int
+    n_bubbles: int
+    n_inter_edges: int
+    forced_splits: int
+    wall_s: float = 0.0
+
+
+@dataclass
+class MRHDBSCANResult:
+    labels: np.ndarray
+    tree: tree_mod.CondensedTree
+    core_distances: np.ndarray
+    outlier_scores: np.ndarray
+    infinite_stability: bool
+    n_levels: int
+    n_edges: int
+    levels: list = field(default_factory=list)
+
+
+def _group_by_subset(subset_ids: np.ndarray, active: np.ndarray) -> list[np.ndarray]:
+    """Active point ids grouped by subset id (sorted once, no per-key scans)."""
+    ids = np.nonzero(active)[0]
+    if len(ids) == 0:
+        return []
+    keys = subset_ids[ids]
+    order = np.argsort(keys, kind="stable")
+    ids = ids[order]
+    keys = keys[order]
+    cuts = np.nonzero(np.diff(keys))[0] + 1
+    return np.split(ids, cuts)
+
+
+def _bubble_groups_from_labels(labels: np.ndarray) -> np.ndarray:
+    """Renumber flat bubble labels to dense 0..g-1 group ids."""
+    _, groups = np.unique(labels, return_inverse=True)
+    return groups
+
+
+def _forced_split_groups(
+    n_b: np.ndarray, u: np.ndarray, v: np.ndarray, capacity: int
+) -> np.ndarray:
+    """Capacity-bounded bubble groups along a bubble-MST traversal order.
+
+    Used only when the bubble model refuses to split a subset (single flat
+    cluster). DFS over the MST gives a spatial ordering; greedy cuts at
+    ``capacity`` member-count boundaries bound each group by the block size
+    (single bubbles heavier than capacity become their own group and recurse
+    at the next level with fresh samples).
+    """
+    m = len(n_b)
+    adj: list[list[int]] = [[] for _ in range(m)]
+    for a, b in zip(u, v):
+        adj[int(a)].append(int(b))
+        adj[int(b)].append(int(a))
+    order = []
+    seen = np.zeros(m, bool)
+    for start in range(m):
+        if seen[start]:
+            continue
+        stack = [start]
+        seen[start] = True
+        while stack:
+            x = stack.pop()
+            order.append(x)
+            for y in adj[x]:
+                if not seen[y]:
+                    seen[y] = True
+                    stack.append(y)
+    groups = np.zeros(m, np.int64)
+    g, acc = 0, 0.0
+    for x in order:
+        if acc > 0 and acc + n_b[x] > capacity:
+            g += 1
+            acc = 0.0
+        groups[x] = g
+        acc += float(n_b[x])
+    return groups
+
+
+def fit(
+    data: np.ndarray,
+    params: HDBSCANParams | None = None,
+    mesh=None,
+    max_levels: int = 64,
+) -> MRHDBSCANResult:
+    """Run the full MR-HDBSCAN* pipeline on one host.
+
+    ``mesh``: optional device mesh; small-subset blocks shard across it.
+    """
+    import time
+
+    params = params or HDBSCANParams()
+    data = np.ascontiguousarray(np.asarray(data, np.float64))
+    n, d = data.shape
+    if n == 0:
+        raise ValueError("empty dataset")
+    rng = np.random.default_rng(params.seed)
+    cap = params.processing_units
+    metric = params.dist_function
+
+    subset = np.zeros(n, np.int64)
+    processed = np.zeros(n, bool)
+    core = np.full(n, np.inf)
+    pool_u: list[np.ndarray] = []
+    pool_v: list[np.ndarray] = []
+    pool_w: list[np.ndarray] = []
+    level_stats: list[LevelStats] = []
+    n_dev = 1
+    if mesh is not None:
+        n_dev = math.prod(mesh.devices.shape)
+
+    for level in range(max_levels):
+        if processed.all():
+            break
+        t0 = time.monotonic()
+        groups = _group_by_subset(subset, ~processed)
+        small = [g for g in groups if len(g) <= cap]
+        large = [g for g in groups if len(g) > cap]
+        n_active = int((~processed).sum())
+        n_proc = 0
+        n_bub = 0
+        n_inter = 0
+        forced = 0
+
+        if small:
+            # Bucket subsets by pow2 size class (SURVEY.md §7 "hard parts"):
+            # a 100-point subset must not pay for a capacity-sized matrix, and
+            # buckets keep the compiled-shape count logarithmic.
+            min_bucket = 128
+            buckets: dict[int, list[np.ndarray]] = {}
+            for g in small:
+                buckets.setdefault(max(min_bucket, _next_pow2(len(g))), []).append(g)
+            for cap_b in sorted(buckets):
+                group = buckets[cap_b]
+                packed = pack_blocks(data, group, cap_b)
+                u, v, w, core_b = run_packed_blocks(
+                    packed, params.min_points, metric, mesh=mesh, batch_pad=n_dev
+                )
+                pool_u.append(u)
+                pool_v.append(v)
+                pool_w.append(w)
+                for i, ids in enumerate(group):
+                    core[ids] = core_b[i, : len(ids)]
+            done = np.concatenate(small)
+            processed[done] = True
+            n_proc = len(done)
+
+        next_id = 0
+        for ids in large:
+            size = len(ids)
+            s_count = min(size, max(2, math.ceil(params.k * size)))
+            samp_local = rng.choice(size, s_count, replace=False)
+            samples_global = ids[samp_local]
+            assign = nearest_sample_assign(data[ids], data[samples_global], metric)
+
+            # Pad bubble slots to pow2 so similar subset sizes share compiles.
+            s_pad = _next_pow2(s_count)
+            rep, extent, nn_dist, n_b = bubble_stats(
+                jnp.asarray(data[ids]), jnp.asarray(assign), s_pad
+            )
+            model = fit_bubbles(
+                np.asarray(rep),
+                np.asarray(extent),
+                np.asarray(nn_dist),
+                np.asarray(n_b),
+                params.min_points,
+                params.min_cluster_size,
+                metric,
+                num_valid=s_count,
+            )
+            n_bub += s_count
+
+            bubble_groups = _bubble_groups_from_labels(model.labels)
+            if bubble_groups.max() == 0:
+                # Single flat cluster: the subset would re-enter unchanged.
+                mu, mv, _ = model.mst
+                bubble_groups = _forced_split_groups(
+                    np.asarray(n_b)[:s_count], mu, mv, cap
+                )
+                forced += 1
+
+            # Inter-group bubble MST edges -> global candidate edges between
+            # the groups' sample points (main/Main.java:248-265 analog).
+            mu, mv, mw = model.mst
+            cross = bubble_groups[mu] != bubble_groups[mv]
+            pool_u.append(samples_global[mu[cross]])
+            pool_v.append(samples_global[mv[cross]])
+            pool_w.append(mw[cross])
+            n_inter += int(cross.sum())
+
+            # Next-level subset = renumbered bubble group (LabelClassification
+            # + driver renumbering analog).
+            subset[ids] = next_id + bubble_groups[assign]
+            next_id += int(bubble_groups.max()) + 1
+
+        level_stats.append(
+            LevelStats(
+                level=level,
+                n_active=n_active,
+                n_small_subsets=len(small),
+                n_large_subsets=len(large),
+                n_processed=n_proc,
+                n_bubbles=n_bub,
+                n_inter_edges=n_inter,
+                forced_splits=forced,
+                wall_s=time.monotonic() - t0,
+            )
+        )
+    else:
+        if not processed.all():
+            raise RuntimeError(
+                f"recursive sampling did not converge in {max_levels} levels; "
+                f"{int((~processed).sum())} points unprocessed"
+            )
+
+    u = np.concatenate(pool_u) if pool_u else np.zeros(0, np.int64)
+    v = np.concatenate(pool_v) if pool_v else np.zeros(0, np.int64)
+    w = np.concatenate(pool_w) if pool_w else np.zeros(0, np.float64)
+
+    forest = tree_mod.build_merge_forest(n, u, v, w)
+    tree = tree_mod.condense_forest(
+        forest, params.min_cluster_size,
+        self_levels=core if params.self_edges else None,
+    )
+    infinite = tree_mod.propagate_tree(tree)
+    labels = tree_mod.flat_labels(tree)
+    scores = tree_mod.outlier_scores(tree, core)
+    return MRHDBSCANResult(
+        labels=labels,
+        tree=tree,
+        core_distances=core,
+        outlier_scores=scores,
+        infinite_stability=infinite,
+        n_levels=len(level_stats),
+        n_edges=len(u),
+        levels=level_stats,
+    )

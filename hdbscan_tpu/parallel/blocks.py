@@ -1,0 +1,213 @@
+"""Batched per-partition kernels over padded blocks (L5 distributed ops).
+
+The reference's per-partition operators (``mappers/FirstStep.java:44-120``)
+run one subset per Spark task. The TPU-native form: stack many subsets into a
+(B, capacity, d) padded block tensor, ``vmap`` the fused exact-HDBSCAN* device
+program over the batch axis, and shard that axis over the device mesh — B
+subset-MSTs per launch instead of B JVM tasks (SURVEY.md §2.C row P1).
+
+Also here: the nearest-sample assignment kernel (``FirstStep.java:74-102``'s
+O(n·|S|·d) loop as tiled matmul argmin) and host-side block packing (the
+``HashPartitioner`` re-binning analog, row P6).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hdbscan_tpu.core.distances import pairwise_distance, self_distance_matrix
+from hdbscan_tpu.core.knn import core_distances_from_matrix, mutual_reachability
+from hdbscan_tpu.core.mst import boruvka_mst
+
+
+@partial(jax.jit, static_argnames=("min_pts", "metric"))
+def block_mst_batch(x: jax.Array, num_valid: jax.Array, min_pts: int, metric: str):
+    """Fused exact pipeline per padded block, vmapped over the batch axis.
+
+    Args:
+      x: (B, cap, d) point blocks, rows >= num_valid[b] are padding.
+      num_valid: (B,) int32 valid-point counts.
+
+    Returns:
+      (u, v, w, mask, core): per-block MST edge arrays (B, cap-1) in local
+      indices, validity mask, and (B, cap) core distances (+inf on padding).
+    """
+
+    def one(xb, nv):
+        cap = xb.shape[0]
+        valid = jnp.arange(cap, dtype=jnp.int32) < nv
+        dist = self_distance_matrix(xb, metric)
+        core = core_distances_from_matrix(dist, min_pts, valid)
+        mrd = mutual_reachability(dist, core)
+        u, v, w, mask, _ = boruvka_mst(mrd, nv)
+        return u, v, w, mask, core
+
+    return jax.vmap(one)(x, num_valid)
+
+
+@partial(jax.jit, static_argnames=("metric",))
+def nearest_sample_tile(points: jax.Array, samples: jax.Array, sample_valid: jax.Array, metric: str):
+    """Per-point nearest sample over one tile: returns (argmin idx, min dist).
+
+    The device form of the reference's per-point scan over the collected
+    sample list (``FirstStep.java:77-85``) — one (T, S) distance matrix per
+    tile, masked argmin over padded sample slots.
+    """
+    d = pairwise_distance(points, samples, metric)
+    d = jnp.where(sample_valid[None, :], d, jnp.inf)
+    idx = jnp.argmin(d, axis=1).astype(jnp.int32)
+    return idx, jnp.take_along_axis(d, idx[:, None], axis=1)[:, 0]
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(0, (x - 1).bit_length())
+
+
+def nearest_sample_assign(
+    points: np.ndarray,
+    samples: np.ndarray,
+    metric: str = "euclidean",
+    tile: int = 8192,
+) -> np.ndarray:
+    """Host-driven tiled nearest-sample assignment (padding-stable compiles).
+
+    Sample count is padded to the next power of two so level-to-level sample
+    matrices of similar size reuse the compiled kernel.
+    """
+    n = len(points)
+    s = len(samples)
+    s_pad = _next_pow2(max(s, 1))
+    samples_p = np.zeros((s_pad, samples.shape[1]), samples.dtype)
+    samples_p[:s] = samples
+    sample_valid = np.arange(s_pad) < s
+    samples_j = jnp.asarray(samples_p)
+    valid_j = jnp.asarray(sample_valid)
+
+    out = np.empty(n, np.int32)
+    for start in range(0, n, tile):
+        chunk = points[start : start + tile]
+        pad = tile - len(chunk)
+        if pad:
+            chunk = np.concatenate([chunk, np.zeros((pad, chunk.shape[1]), chunk.dtype)])
+        idx, _ = nearest_sample_tile(jnp.asarray(chunk), samples_j, valid_j, metric)
+        out[start : start + tile] = np.asarray(idx)[: tile - pad if pad else tile]
+    return out
+
+
+@dataclass
+class PackedBlocks:
+    """Subsets packed into a padded (B, cap, d) tensor plus index maps."""
+
+    x: np.ndarray  # (B, cap, d)
+    num_valid: np.ndarray  # (B,) int32
+    point_index: np.ndarray  # (B, cap) global point id per slot (-1 padding)
+    subset_ids: np.ndarray  # (B,) the subset each block came from
+
+
+def pack_blocks(
+    data: np.ndarray, point_ids_per_subset: list[np.ndarray], capacity: int
+) -> PackedBlocks:
+    """Pack per-subset point-id lists into padded device blocks.
+
+    Every subset must fit ``capacity`` (the driver routes only small subsets
+    here — ``processing_units`` semantics, ``mappers/FirstStep.java:68``).
+    """
+    b = len(point_ids_per_subset)
+    d = data.shape[1]
+    x = np.zeros((b, capacity, d), data.dtype)
+    num_valid = np.zeros(b, np.int32)
+    point_index = np.full((b, capacity), -1, np.int64)
+    for i, ids in enumerate(point_ids_per_subset):
+        k = len(ids)
+        if k > capacity:
+            raise ValueError(f"subset {i} has {k} points > capacity {capacity}")
+        x[i, :k] = data[ids]
+        num_valid[i] = k
+        point_index[i, :k] = ids
+    return PackedBlocks(
+        x=x,
+        num_valid=num_valid,
+        point_index=point_index,
+        subset_ids=np.arange(b),
+    )
+
+
+#: Rough per-block working-set multiplier for the fused MST kernel: the
+#: Borůvka loop holds the weight matrix plus the per-round component mask and
+#: XLA temporaries — ~8 copies of the (cap, cap) matrix in practice.
+_BLOCK_TEMPS = 8
+
+
+def run_packed_blocks(
+    packed: PackedBlocks,
+    min_pts: int,
+    metric: str = "euclidean",
+    mesh=None,
+    batch_pad: int = 1,
+    hbm_budget_bytes: int = 2 << 30,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Execute the batched MST kernel; returns global-id edges + core distances.
+
+    ``mesh``: optional device mesh — block batch axis is sharded across it
+    (each device computes its shard of blocks; results gather to host).
+    ``batch_pad``: round each launch's batch up to a multiple (mesh size)
+    with empty blocks so the shard axis divides evenly.
+    ``hbm_budget_bytes``: cap on the per-launch working set; large batches
+    split into fixed-size launches (all identical shape -> one compile).
+
+    Returns:
+      (u, v, w) concatenated global-id MST edges over all blocks and a
+      (B, cap) core-distance array aligned with ``packed.point_index``.
+    """
+    b = len(packed.x)
+    cap = packed.x.shape[1]
+    itemsize = 8 if jax.config.jax_enable_x64 else 4
+    per_block = cap * cap * itemsize * _BLOCK_TEMPS
+    chunk = max(1, hbm_budget_bytes // per_block)
+    chunk = max(batch_pad, chunk // batch_pad * batch_pad)
+    chunk = min(chunk, -(-b // batch_pad) * batch_pad)
+
+    sh = None
+    if mesh is not None:
+        from hdbscan_tpu.parallel.mesh import block_sharding
+
+        sh = block_sharding(mesh)
+
+    core = np.empty((b, cap), np.float64)
+    gu, gv, gw = [], [], []
+    for start in range(0, b, chunk):
+        x = packed.x[start : start + chunk]
+        nv = packed.num_valid[start : start + chunk]
+        real = len(x)
+        if real != chunk:  # pad every launch to the same shape: one compile
+            x = np.concatenate([x, np.zeros((chunk - real, *x.shape[1:]), x.dtype)])
+            nv = np.concatenate([nv, np.zeros(chunk - real, nv.dtype)])
+        xj, nvj = jnp.asarray(x), jnp.asarray(nv)
+        if sh is not None:
+            xj = jax.device_put(xj, sh)
+            nvj = jax.device_put(nvj, sh)
+        u, v, w, mask, core_c = block_mst_batch(xj, nvj, min_pts, metric)
+        u, v, w, mask = (
+            np.asarray(u),
+            np.asarray(v),
+            np.asarray(w, np.float64),
+            np.asarray(mask),
+        )
+        core[start : start + real] = np.asarray(core_c, np.float64)[:real]
+        for i in range(real):
+            m = mask[i]
+            ids = packed.point_index[start + i]
+            gu.append(ids[u[i][m]])
+            gv.append(ids[v[i][m]])
+            gw.append(w[i][m])
+    return (
+        np.concatenate(gu) if gu else np.zeros(0, np.int64),
+        np.concatenate(gv) if gv else np.zeros(0, np.int64),
+        np.concatenate(gw) if gw else np.zeros(0, np.float64),
+        core,
+    )

@@ -1,0 +1,41 @@
+"""Device mesh helpers — the TPU-native replacement for the Spark runtime (L0).
+
+The reference scales by handing each worker a whole subset
+(``mapPartitionsToPair``, ``main/Main.java:166-169``; one worker ≈ one
+"processing unit"). Here the analog is a 1-D ``jax.sharding.Mesh`` over all
+local devices with per-partition blocks sharded along the batch axis: one TPU
+core processes a stream of padded blocks, XLA/ICI handle the data movement
+(SURVEY.md §2.C rows P1/P4/P6). Multi-host extends the same mesh over DCN via
+``jax.distributed`` without code changes — the mesh axis is the only
+parallelism vocabulary.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+BATCH_AXIS = "blocks"
+
+
+def get_mesh(devices: list | None = None) -> Mesh:
+    """1-D data-parallel mesh over the given (default: all) devices."""
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (BATCH_AXIS,))
+
+
+def block_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch-axis sharding for (B, ...) block stacks."""
+    return NamedSharding(mesh, P(BATCH_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Replicated sharding — broadcast arrays (sample matrices, models),
+    the ``Broadcast``/driver-closure analog (SURVEY.md §2.C row P4)."""
+    return NamedSharding(mesh, P())
+
+
+def pad_batch(batch_size: int, num_devices: int) -> int:
+    """Blocks are padded so the batch axis divides the mesh evenly."""
+    return -(-batch_size // num_devices) * num_devices

@@ -1,0 +1,89 @@
+"""End-to-end MR-HDBSCAN* pipeline tests: recursive sampling + bubbles vs the
+exact single-block result (the reference's validation protocol, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.config import HDBSCANParams
+from hdbscan_tpu.models import hdbscan, mr_hdbscan
+from hdbscan_tpu.parallel.mesh import get_mesh
+from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+from tests.conftest import make_blobs
+
+
+class TestSmallDatasetExactPath:
+    def test_single_level_matches_exact(self, iris):
+        """Dataset fits processing_units: the MR pipeline IS the exact path."""
+        params = HDBSCANParams(min_points=4, min_cluster_size=4, processing_units=200)
+        exact = hdbscan.fit(iris, params)
+        mr = mr_hdbscan.fit(iris, params)
+        assert mr.n_levels == 1
+        assert adjusted_rand_index(mr.labels, exact.labels) == 1.0
+
+    def test_iris_recursive(self, iris):
+        """Force recursion on Iris (capacity 50 < 150) and compare to exact."""
+        params = HDBSCANParams(
+            min_points=4, min_cluster_size=4, processing_units=50, k=0.2, seed=1
+        )
+        exact = hdbscan.fit(iris, params.replace(processing_units=200))
+        mr = mr_hdbscan.fit(iris, params)
+        assert mr.n_levels >= 2
+        assert np.all(mr.labels >= 0)
+        ari = adjusted_rand_index(mr.labels, exact.labels)
+        assert ari > 0.55, f"ARI vs exact too low: {ari}"
+
+
+class TestBlobsRecursive:
+    def test_blobs_high_ari(self, rng):
+        pts, truth = make_blobs(rng, n=1200, d=3, centers=4, spread=0.08)
+        params = HDBSCANParams(
+            min_points=5, min_cluster_size=10, processing_units=200, k=0.15, seed=0
+        )
+        mr = mr_hdbscan.fit(pts, params)
+        assert mr.n_levels >= 2
+        ari = adjusted_rand_index(mr.labels, truth, noise_as_singletons=False)
+        assert ari > 0.9, f"ARI vs ground truth too low: {ari}"
+
+    def test_deterministic_given_seed(self, rng):
+        pts, _ = make_blobs(rng, n=400, d=2, centers=3)
+        params = HDBSCANParams(min_points=4, min_cluster_size=5, processing_units=100, seed=7)
+        a = mr_hdbscan.fit(pts, params)
+        b = mr_hdbscan.fit(pts, params)
+        np.testing.assert_array_equal(a.labels, b.labels)
+
+    def test_all_points_labeled_and_core_finite(self, rng):
+        pts, _ = make_blobs(rng, n=600, d=3, centers=3)
+        params = HDBSCANParams(min_points=4, min_cluster_size=8, processing_units=150)
+        mr = mr_hdbscan.fit(pts, params)
+        assert np.all(np.isfinite(mr.core_distances))
+        assert len(mr.labels) == len(pts)
+
+    def test_runs_on_mesh(self, rng):
+        pts, truth = make_blobs(rng, n=800, d=3, centers=4, spread=0.08)
+        params = HDBSCANParams(min_points=4, min_cluster_size=8, processing_units=120, seed=3)
+        mr = mr_hdbscan.fit(pts, params, mesh=get_mesh())
+        ari = adjusted_rand_index(mr.labels, truth, noise_as_singletons=False)
+        assert ari > 0.85
+
+
+class TestLevelTrace:
+    def test_level_stats_recorded(self, rng):
+        pts, _ = make_blobs(rng, n=500, d=2, centers=2)
+        params = HDBSCANParams(min_points=4, min_cluster_size=5, processing_units=100)
+        mr = mr_hdbscan.fit(pts, params)
+        assert len(mr.levels) == mr.n_levels
+        assert mr.levels[0].n_active == 500
+        assert sum(l.n_processed for l in mr.levels) == 500
+
+
+class TestForcedSplit:
+    def test_single_gaussian_terminates(self, rng):
+        """One dense blob: bubble model finds a single cluster every level —
+        the forced-split guard must terminate the recursion."""
+        pts = rng.normal(size=(700, 2))
+        params = HDBSCANParams(min_points=4, min_cluster_size=10, processing_units=100, k=0.1)
+        mr = mr_hdbscan.fit(pts, params)
+        assert len(mr.labels) == 700
+        # most of one gaussian should stay one cluster
+        vals, counts = np.unique(mr.labels[mr.labels > 0], return_counts=True)
+        assert counts.max() > 350

@@ -1,0 +1,79 @@
+"""L5 batched-block and nearest-sample kernel tests (8-device CPU mesh)."""
+
+import jax
+import numpy as np
+import pytest
+
+from hdbscan_tpu.models.hdbscan import hdbscan_block_edges
+from hdbscan_tpu.parallel.blocks import (
+    nearest_sample_assign,
+    pack_blocks,
+    run_packed_blocks,
+)
+from hdbscan_tpu.parallel.mesh import get_mesh
+from tests.conftest import make_blobs
+
+
+class TestNearestSample:
+    def test_matches_bruteforce(self, rng):
+        pts = rng.normal(size=(500, 4))
+        samples = pts[rng.choice(500, 37, replace=False)]
+        got = nearest_sample_assign(pts, samples, tile=128)
+        d2 = ((pts[:, None, :] - samples[None, :, :]) ** 2).sum(-1)
+        np.testing.assert_array_equal(got, d2.argmin(1))
+
+    def test_samples_map_to_themselves(self, rng):
+        pts = rng.normal(size=(100, 3))
+        idx = rng.choice(100, 10, replace=False)
+        got = nearest_sample_assign(pts, pts[idx])
+        np.testing.assert_array_equal(got[idx], np.arange(10))
+
+
+class TestPackedBlocks:
+    def test_pack_shapes(self, rng):
+        data = rng.normal(size=(60, 3))
+        subsets = [np.arange(0, 20), np.arange(20, 45), np.arange(45, 60)]
+        packed = pack_blocks(data, subsets, capacity=30)
+        assert packed.x.shape == (3, 30, 3)
+        np.testing.assert_array_equal(packed.num_valid, [20, 25, 15])
+        assert packed.point_index[0, 19] == 19 and packed.point_index[0, 20] == -1
+
+    def test_overflow_raises(self, rng):
+        data = rng.normal(size=(10, 2))
+        with pytest.raises(ValueError):
+            pack_blocks(data, [np.arange(10)], capacity=5)
+
+    def test_batched_mst_matches_single(self, rng):
+        """Each block's MST from the batched kernel == the single-block path."""
+        data, _ = make_blobs(rng, n=90, d=3, centers=3)
+        subsets = [np.arange(0, 30), np.arange(30, 60), np.arange(60, 90)]
+        packed = pack_blocks(data, subsets, capacity=40)
+        u, v, w, core = run_packed_blocks(packed, min_pts=4)
+        for ids in subsets:
+            su, sv, sw, score = hdbscan_block_edges(data[ids], min_pts=4)
+            sel = np.isin(u, ids) & np.isin(v, ids)
+            got_w = np.sort(w[sel])
+            np.testing.assert_allclose(got_w, np.sort(sw), rtol=1e-9)
+        # core distances scattered back per block
+        for i, ids in enumerate(subsets):
+            _, _, _, score = hdbscan_block_edges(data[ids], min_pts=4)
+            np.testing.assert_allclose(core[i, : len(ids)], score, rtol=1e-9)
+
+    def test_batch_padding_adds_no_edges(self, rng):
+        data = rng.normal(size=(20, 2))
+        packed = pack_blocks(data, [np.arange(20)], capacity=25)
+        u1, v1, w1, _ = run_packed_blocks(packed, min_pts=3, batch_pad=1)
+        u8, v8, w8, _ = run_packed_blocks(packed, min_pts=3, batch_pad=8)
+        np.testing.assert_allclose(np.sort(w1), np.sort(w8), rtol=1e-12)
+
+    def test_runs_on_mesh(self, rng):
+        """Blocks shard over the 8-device CPU mesh and produce identical edges."""
+        assert len(jax.devices()) == 8
+        data, _ = make_blobs(rng, n=160, d=3, centers=4)
+        subsets = [np.arange(i * 20, (i + 1) * 20) for i in range(8)]
+        packed = pack_blocks(data, subsets, capacity=20)
+        mesh = get_mesh()
+        u_m, v_m, w_m, core_m = run_packed_blocks(packed, min_pts=4, mesh=mesh, batch_pad=8)
+        u_s, v_s, w_s, core_s = run_packed_blocks(packed, min_pts=4)
+        np.testing.assert_allclose(np.sort(w_m), np.sort(w_s), rtol=1e-12)
+        np.testing.assert_allclose(core_m, core_s, rtol=1e-12)

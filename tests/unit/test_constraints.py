@@ -1,0 +1,109 @@
+"""Constraint subsystem tests: parsing, per-cluster credits, EOM influence."""
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.config import HDBSCANParams
+from hdbscan_tpu.core.constraints import (
+    Constraint,
+    count_constraints_satisfied,
+    load_constraints,
+)
+from hdbscan_tpu.core import tree as tree_mod
+from hdbscan_tpu.models import hdbscan
+from tests.conftest import make_blobs
+
+
+def two_cluster_tree(rng):
+    pts, truth = make_blobs(rng, n=80, d=2, centers=2, spread=0.05)
+    res = hdbscan.fit(pts, HDBSCANParams(min_points=4, min_cluster_size=5))
+    return pts, truth, res
+
+
+class TestLoad:
+    def test_parse(self, tmp_path):
+        p = tmp_path / "c.csv"
+        p.write_text("0,5,ml\n2,7,cl\n\n 3 , 4 , ML \n")
+        cons = load_constraints(str(p))
+        assert cons == [
+            Constraint(0, 5, "ml"),
+            Constraint(2, 7, "cl"),
+            Constraint(3, 4, "ml"),
+        ]
+
+    def test_bad_type(self, tmp_path):
+        p = tmp_path / "c.csv"
+        p.write_text("0,1,xx\n")
+        with pytest.raises(ValueError):
+            load_constraints(str(p))
+
+    def test_bad_arity(self, tmp_path):
+        p = tmp_path / "c.csv"
+        p.write_text("0,1\n")
+        with pytest.raises(ValueError):
+            load_constraints(str(p))
+
+
+class TestCounts:
+    def test_must_link_same_cluster(self, rng):
+        pts, truth, res = two_cluster_tree(rng)
+        same = np.nonzero(res.labels == res.labels[np.argmax(res.labels > 0)])[0]
+        a, b = int(same[0]), int(same[1])
+        num, vnum = count_constraints_satisfied(res.tree, [Constraint(a, b, "ml")])
+        # Their shared (selected) cluster earned the +2.
+        assert num[res.labels[a]] == 2
+        assert num.sum() >= 2  # deeper shared ancestors may earn too
+
+    def test_must_link_across_clusters_no_credit(self, rng):
+        pts, truth, res = two_cluster_tree(rng)
+        labels_present = [l for l in np.unique(res.labels) if l > 0]
+        a = int(np.nonzero(res.labels == labels_present[0])[0][0])
+        b = int(np.nonzero(res.labels == labels_present[1])[0][0])
+        num, _ = count_constraints_satisfied(res.tree, [Constraint(a, b, "ml")])
+        # Only non-root common ancestors could earn; the two flat clusters
+        # themselves earn nothing.
+        assert num[res.labels[a]] == 0
+        assert num[res.labels[b]] == 0
+
+    def test_cannot_link_across_clusters(self, rng):
+        pts, truth, res = two_cluster_tree(rng)
+        labels_present = [l for l in np.unique(res.labels) if l > 0]
+        a = int(np.nonzero(res.labels == labels_present[0])[0][0])
+        b = int(np.nonzero(res.labels == labels_present[1])[0][0])
+        num, _ = count_constraints_satisfied(res.tree, [Constraint(a, b, "cl")])
+        assert num[res.labels[a]] >= 1
+        assert num[res.labels[b]] >= 1
+
+    def test_root_never_credited(self, rng):
+        pts, truth, res = two_cluster_tree(rng)
+        num, vnum = count_constraints_satisfied(
+            res.tree, [Constraint(0, 1, "ml"), Constraint(0, 2, "cl")]
+        )
+        assert num[tree_mod.ROOT_LABEL] == 0
+        assert vnum[tree_mod.ROOT_LABEL] == 0
+
+    def test_noise_endpoint_virtual_credit(self, rng):
+        pts, truth, res = two_cluster_tree(rng)
+        noise = np.nonzero(res.labels == 0)[0]
+        if len(noise) == 0:
+            pytest.skip("no noise points in this draw")
+        a = int(noise[0])
+        b = int(np.nonzero(res.labels > 0)[0][0])
+        num, vnum = count_constraints_satisfied(res.tree, [Constraint(a, b, "cl")])
+        assert vnum.sum() >= 1
+
+
+class TestEndToEnd:
+    def test_constraints_file_steers_extraction(self, rng, tmp_path):
+        """A heavily-weighted must-link pair in one blob forces selection of a
+        cluster containing both points' subtree."""
+        pts, truth, res = two_cluster_tree(rng)
+        same = np.nonzero(res.labels == res.labels[np.argmax(res.labels > 0)])[0]
+        cons_file = tmp_path / "cons.csv"
+        cons_file.write_text(f"{same[0]},{same[1]},ml\n")
+        params = HDBSCANParams(
+            min_points=4, min_cluster_size=5, constraints_file=str(cons_file)
+        )
+        res2 = hdbscan.fit(pts, params)
+        # Constrained extraction still labels both endpoints together.
+        assert res2.labels[same[0]] == res2.labels[same[1]] != 0

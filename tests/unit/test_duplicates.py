@@ -1,0 +1,29 @@
+"""Duplicate-point regression: zero-weight levels must follow Java IEEE
+semantics (infinite stability + warning flag, HDBSCANStar.java:40-47), not
+raise (Skin_NonSkin has heavy integer-RGB duplication)."""
+
+import numpy as np
+
+from hdbscan_tpu.config import HDBSCANParams
+from hdbscan_tpu.models import hdbscan, mr_hdbscan
+
+
+def test_exact_duplicates_infinite_stability(rng):
+    base = rng.normal(size=(30, 3))
+    pts = np.concatenate([np.repeat(base[:5], 10, axis=0), base])
+    res = hdbscan.fit(pts, HDBSCANParams(min_points=4, min_cluster_size=4))
+    assert res.infinite_stability
+    assert len(res.labels) == 80
+    # duplicate groups land in one cluster together
+    for g in range(5):
+        grp = res.labels[g * 10 : (g + 1) * 10]
+        assert len(set(grp.tolist())) == 1
+
+
+def test_mr_duplicates_terminates(rng):
+    base = rng.normal(size=(30, 3))
+    pts = np.concatenate([np.repeat(base[:5], 10, axis=0), base])
+    res = mr_hdbscan.fit(
+        pts, HDBSCANParams(min_points=4, min_cluster_size=4, processing_units=20, k=0.3)
+    )
+    assert len(res.labels) == 80
