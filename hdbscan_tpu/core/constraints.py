@@ -17,10 +17,18 @@ credited exactly once, at its creation level —
 - cannot-link with a noise endpoint: the credit goes to the *virtual child*
   of the cluster the point went noise from (``Cluster.java:145-171``) — kept
   in a separate per-cluster array (the ``vGamma`` column of the tree file),
-  matching the reference's separate bookkeeping.
+  matching the reference's separate bookkeeping. The reference counts a
+  virtual child only when its owner appears among the "parents of new
+  clusters" (``HDBSCANStar.java:744-750``) — i.e. only clusters that
+  actually *split* are credited; a cluster that shattered or narrowed away
+  never is. A point whose last cluster split necessarily went noise at or
+  before the split, so membership in the virtual child reduces to
+  ``point_last_cluster == C and has_children[C]``.
 
-The root cluster pre-exists the hierarchy loop in the reference and is never
-in ``newClusterLabels``, so it earns no credit — mirrored here.
+The root cluster is pre-credited before the hierarchy loop in the reference
+(``HDBSCANStar.java:241-244``, all points labeled 1): must-links earn root +2
+each, cannot-links nothing. Root is also a parent of new clusters, so its
+virtual child can be credited.
 
 File format (``main/Main.java:590-597``): CSV lines
 ``<idx_a>,<idx_b>,<ml|cl>``, zero-indexed.
@@ -32,7 +40,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from hdbscan_tpu.core.tree import ROOT_LABEL, CondensedTree
+from hdbscan_tpu.core.tree import CondensedTree
 
 MUST_LINK = "ml"
 CANNOT_LINK = "cl"
@@ -90,27 +98,31 @@ def count_constraints_satisfied(
         return num, vnum
     chains = _ancestor_chains(tree)
     last = tree.point_last_cluster
-    exited = tree.point_exit_level > 0
 
     for con in constraints:
         pa, pb = int(con.point_a), int(con.point_b)
         chain_a = chains[int(last[pa])]
         chain_b = chains[int(last[pb])]
         if con.kind == MUST_LINK:
+            # Root included: the reference pre-credits cluster 1 before the
+            # hierarchy loop (HDBSCANStar.java:241-244) — every must-link
+            # earns root +2 while all points are labeled 1.
             for lbl in chain_a & chain_b:
-                if lbl != ROOT_LABEL:
-                    num[lbl] += 2
+                num[lbl] += 2
         else:
+            # Root never appears in a chain difference (it is in every
+            # chain), matching the reference: labelA == labelB == 1 at the
+            # pre-loop call, so cannot-links earn root nothing.
             for lbl in chain_a - chain_b:
-                if lbl != ROOT_LABEL:
-                    num[lbl] += 1
+                num[lbl] += 1
             for lbl in chain_b - chain_a:
-                if lbl != ROOT_LABEL:
-                    num[lbl] += 1
+                num[lbl] += 1
             # Noise endpoints credit the virtual child of the cluster the
-            # point went noise from (its deepest cluster).
+            # point went noise from (its deepest cluster) — but only if that
+            # cluster split, mirroring the reference's parents-of-new-clusters
+            # scoping (HDBSCANStar.java:744-750,765-781).
             for p in (pa, pb):
                 lbl = int(last[p])
-                if exited[p] and lbl != ROOT_LABEL:
+                if tree.has_children[lbl]:
                     vnum[lbl] += 1
     return num, vnum

@@ -185,6 +185,7 @@ class CondensedTree:
     propagated_stability: np.ndarray | None = None
     lowest_child_death: np.ndarray | None = None
     num_constraints_satisfied: np.ndarray | None = None
+    virtual_child_constraints: np.ndarray | None = None  # vGamma column
     selected: np.ndarray | None = field(default=None)  # (C+1,) bool after propagate
 
     @property
@@ -343,7 +344,9 @@ def condense_forest(
 
 
 def propagate_tree(
-    tree: CondensedTree, num_constraints_satisfied: np.ndarray | None = None
+    tree: CondensedTree,
+    num_constraints_satisfied: np.ndarray | None = None,
+    virtual_child_constraints: np.ndarray | None = None,
 ) -> bool:
     """``HDBSCANStar.propagateTree`` (``HDBSCANStar.java:505-540``).
 
@@ -352,12 +355,21 @@ def propagate_tree(
     (``Cluster.java:98-142``): constraint satisfaction dominates; stability
     breaks ties with the parent winning equality; the lowest descendant death
     level is propagated for GLOSH. Returns the infinite-stability flag.
+
+    ``virtual_child_constraints``: per-cluster credits earned by the virtual
+    (noise) child — the reference adds these straight into
+    ``propagatedNumConstraintsSatisfied`` (``Cluster.java:157-159``), so they
+    compete against the cluster's own count and flow upward only when the
+    descendants win.
     """
     c = tree.n_clusters
     if num_constraints_satisfied is None:
         num_constraints_satisfied = np.zeros(c + 1, np.int64)
     prop_stab = np.zeros(c + 1, np.float64)
-    prop_cons = np.zeros(c + 1, np.int64)
+    if virtual_child_constraints is None:
+        prop_cons = np.zeros(c + 1, np.int64)
+    else:
+        prop_cons = np.asarray(virtual_child_constraints, np.int64).copy()
     lowest_death = np.full(c + 1, np.inf)  # Double.MAX_VALUE analog
     descendants: list = [[] for _ in range(c + 1)]
 
@@ -391,6 +403,7 @@ def propagate_tree(
     tree.propagated_stability = prop_stab
     tree.lowest_child_death = lowest_death
     tree.num_constraints_satisfied = num_constraints_satisfied
+    tree.virtual_child_constraints = virtual_child_constraints
     tree.selected = selected
     return tree.infinite_stability
 
@@ -444,9 +457,10 @@ def extract_clusters(
     point_weights: np.ndarray | None = None,
     self_levels: np.ndarray | None = None,
     num_constraints_satisfied: np.ndarray | None = None,
+    virtual_child_constraints: np.ndarray | None = None,
 ) -> tuple[CondensedTree, np.ndarray]:
     """Edge pool -> (propagated condensed tree, flat labels). One-call helper."""
     forest = build_merge_forest(n, u, v, w, point_weights)
     tree = condense_forest(forest, min_cluster_size, point_weights, self_levels)
-    propagate_tree(tree, num_constraints_satisfied)
+    propagate_tree(tree, num_constraints_satisfied, virtual_child_constraints)
     return tree, flat_labels(tree)

@@ -1,0 +1,55 @@
+"""Shared post-processing tail of every fit(): edge pool -> clustering.
+
+All three models (single-block exact ``hdbscan``, blocked exact ``exact``,
+distributed ``mr_hdbscan``) end in the same host-side sequence — merge forest,
+condensed tree, constraint counting, EOM propagation, flat labels, GLOSH —
+mirroring the reference's canonical per-node pipeline tail
+(SURVEY.md §3.4; ``HDBSCANStar.propagateTree``/``findProminentClusters``/
+``calculateOutlierScores``, ``hdbscanstar/HDBSCANStar.java:505,567,653``).
+Kept in one place so constraint/propagation fixes apply to every path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from hdbscan_tpu.config import HDBSCANParams
+from hdbscan_tpu.core import tree as tree_mod
+
+
+def finalize_clustering(
+    n: int,
+    u: np.ndarray,
+    v: np.ndarray,
+    w: np.ndarray,
+    core: np.ndarray,
+    params: HDBSCANParams,
+    num_constraints_satisfied: np.ndarray | None = None,
+) -> tuple[tree_mod.CondensedTree, np.ndarray, np.ndarray, bool]:
+    """Edge pool + core distances -> (tree, labels, outlier_scores, infinite).
+
+    Constraint counts load from ``params.constraints_file`` when not supplied
+    (both gamma and virtual-child vGamma credits feed propagation).
+    """
+    forest = tree_mod.build_merge_forest(n, u, v, w)
+    tree = tree_mod.condense_forest(
+        forest,
+        params.min_cluster_size,
+        self_levels=core if params.self_edges else None,
+    )
+    virtual_child_constraints = None
+    if params.constraints_file and num_constraints_satisfied is None:
+        from hdbscan_tpu.core.constraints import (
+            count_constraints_satisfied,
+            load_constraints,
+        )
+
+        num_constraints_satisfied, virtual_child_constraints = (
+            count_constraints_satisfied(tree, load_constraints(params.constraints_file))
+        )
+    infinite = tree_mod.propagate_tree(
+        tree, num_constraints_satisfied, virtual_child_constraints
+    )
+    labels = tree_mod.flat_labels(tree)
+    scores = tree_mod.outlier_scores(tree, core)
+    return tree, labels, scores, infinite

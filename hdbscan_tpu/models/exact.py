@@ -1,0 +1,156 @@
+"""Exact HDBSCAN* at scale — the "Random Blocks" (RB) capability, TPU-blocked.
+
+The reference's exact distributed variant (BASELINE.md RB column; the
+``mappers/CoreDistanceMapper.java:57-112`` broadcast-everything design, and
+the paper's Random Blocks method quoted in ResearchReport.pdf §5) needs
+O(n^2) pairwise work and took 1,743.93 s on Skin (245,057 pts) on the
+reference's Spark cluster — with >1 month for the 8-11M-point sets.
+
+TPU-native re-design (SURVEY.md §7 "Scale target"): the dense n^2
+mutual-reachability matrix cannot exist in HBM at this n, so the MST is built
+with **host-orchestrated Borůvka over tiled on-the-fly distance recompute**
+(``ops/tiled.py``):
+
+1. one streaming pass for exact core distances (k-th smallest, self included);
+2. per Borůvka round, one tiled scan gives every point its minimum
+   mutual-reachability edge leaving its current component (distance tiles
+   recomputed on the MXU, never stored);
+3. the host reduces per-point candidates to per-component minima, merges
+   components union-find, and repeats — ceil(log2 n) rounds total, each a
+   single device program.
+
+The result is the same MST weight multiset an in-memory exact solver produces
+(deterministic (w, j)-lexicographic tie-break), feeding the shared condensed
+tree / EOM / GLOSH host layer (``core/tree.py``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from hdbscan_tpu.config import HDBSCANParams
+from hdbscan_tpu.models.hdbscan import HDBSCANResult
+from hdbscan_tpu.ops.tiled import BoruvkaScanner, knn_core_distances
+
+
+def _find(parent: np.ndarray, x: int) -> int:
+    while parent[x] != x:
+        parent[x] = parent[parent[x]]
+        x = parent[x]
+    return x
+
+
+def mst_edges(
+    data: np.ndarray,
+    min_pts: int,
+    metric: str = "euclidean",
+    row_tile: int = 1024,
+    col_tile: int = 8192,
+    dtype=np.float32,
+    max_rounds: int = 64,
+    trace=None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Blocked Borůvka: (u, v, w) exact mutual-reachability MST + core distances."""
+    n = len(data)
+    core, _ = knn_core_distances(
+        data, min_pts, metric, row_tile=row_tile, col_tile=col_tile, dtype=dtype
+    )
+    if trace is not None:
+        trace("core_distances", n=n)
+    scanner = BoruvkaScanner(
+        data, core, metric, row_tile=row_tile, col_tile=col_tile, dtype=dtype
+    )
+
+    parent = np.arange(n, dtype=np.int64)
+    comp = np.arange(n, dtype=np.int64)
+    eu, ev, ew = [], [], []
+    n_comp = n
+    for rnd in range(max_rounds):
+        if n_comp <= 1:
+            break
+        bw, bj = scanner.min_outgoing(comp)
+        has = bj >= 0
+        if not has.any():
+            break  # disconnected pool (cannot happen for a full metric space)
+        # Per-component minimum outgoing candidate, ties broken by (w, i, j)
+        # so the MST is reproducible across tilings and round orderings.
+        ids = np.nonzero(has)[0]
+        order = np.lexsort((bj[ids], ids, bw[ids]))
+        ids = ids[order]
+        _, first = np.unique(comp[ids], return_index=True)
+        added = 0
+        for i_ in ids[first]:
+            ra, rb = _find(parent, int(i_)), _find(parent, int(bj[i_]))
+            if ra == rb:
+                continue  # two components picked the same (tied) edge
+            parent[rb] = ra
+            eu.append(int(i_))
+            ev.append(int(bj[i_]))
+            ew.append(float(bw[i_]))
+            added += 1
+        n_comp -= added
+        # Relabel components for the next device round (vectorized pointer
+        # jumping — SURVEY.md §2.C row P9's min-label propagation, host side).
+        p = parent
+        while True:
+            q = p[p]
+            if np.array_equal(q, p):
+                break
+            p = q
+        parent = p
+        comp = p
+        if trace is not None:
+            trace("boruvka_round", round=rnd, components=n_comp, edges_added=added)
+        if added == 0:
+            break
+    return (
+        np.asarray(eu, np.int64),
+        np.asarray(ev, np.int64),
+        np.asarray(ew, np.float64),
+        core,
+    )
+
+
+def fit(
+    data: np.ndarray,
+    params: HDBSCANParams | None = None,
+    *,
+    row_tile: int = 1024,
+    col_tile: int = 8192,
+    dtype=np.float32,
+    num_constraints_satisfied: np.ndarray | None = None,
+    trace=None,
+) -> HDBSCANResult:
+    """Exact HDBSCAN* on a dataset far larger than one dense block.
+
+    Same output contract as ``models.hdbscan.fit`` (which this matches exactly
+    on small inputs), reaching the RB capability the reference could only
+    quote numbers for.
+    """
+    params = params or HDBSCANParams()
+    data = np.asarray(data, np.float64)
+    n = len(data)
+    if n == 0:
+        raise ValueError("empty dataset")
+    u, v, w, core = mst_edges(
+        data,
+        params.min_points,
+        params.dist_function,
+        row_tile=row_tile,
+        col_tile=col_tile,
+        dtype=dtype,
+        trace=trace,
+    )
+    from hdbscan_tpu.models._finalize import finalize_clustering
+
+    tree, labels, scores, infinite = finalize_clustering(
+        n, u, v, w, core, params, num_constraints_satisfied
+    )
+    return HDBSCANResult(
+        labels=labels,
+        tree=tree,
+        core_distances=core,
+        mst=(u, v, w),
+        outlier_scores=scores,
+        infinite_stability=infinite,
+    )

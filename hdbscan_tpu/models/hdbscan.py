@@ -76,24 +76,11 @@ def fit(
     if n == 0:
         raise ValueError("empty dataset")
     u, v, w, core = hdbscan_block_edges(data, params.min_points, params.dist_function)
-    forest = tree_mod.build_merge_forest(n, u, v, w)
-    tree = tree_mod.condense_forest(
-        forest,
-        params.min_cluster_size,
-        self_levels=core if params.self_edges else None,
-    )
-    if params.constraints_file and num_constraints_satisfied is None:
-        from hdbscan_tpu.core.constraints import (
-            count_constraints_satisfied,
-            load_constraints,
-        )
+    from hdbscan_tpu.models._finalize import finalize_clustering
 
-        num_constraints_satisfied, _ = count_constraints_satisfied(
-            tree, load_constraints(params.constraints_file)
-        )
-    infinite = tree_mod.propagate_tree(tree, num_constraints_satisfied)
-    labels = tree_mod.flat_labels(tree)
-    scores = tree_mod.outlier_scores(tree, core)
+    tree, labels, scores, infinite = finalize_clustering(
+        n, u, v, w, core, params, num_constraints_satisfied
+    )
     return HDBSCANResult(
         labels=labels,
         tree=tree,
@@ -106,10 +93,13 @@ def fit(
 
 def write_outputs(result: HDBSCANResult, params: HDBSCANParams) -> dict[str, str]:
     """Emit the five canonical output files; returns {kind: path}."""
+    import os
+
     from hdbscan_tpu.utils import io as io_mod
 
     paths = {}
     hierarchy_path = params.output_path("hierarchy")
+    os.makedirs(os.path.dirname(hierarchy_path) or ".", exist_ok=True)
     offsets = io_mod.write_hierarchy_file(
         hierarchy_path, result.tree, params.compact_hierarchy
     )

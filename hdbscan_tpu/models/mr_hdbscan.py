@@ -238,15 +238,22 @@ def fit(
                     np.asarray(n_b)[:s_count], mu, mv, cap
                 )
                 forced += 1
+                # Forced groups differ from flat clusters: recompute which
+                # bubble-MST edges cross groups.
+                mu, mv, mw = model.mst
+                cross = bubble_groups[mu] != bubble_groups[mv]
+                iu, iv, iw = mu[cross], mv[cross], mw[cross]
+            else:
+                # Normal path: the model already harvested the cross-cluster
+                # MST edges (findInterClusterEdges analog).
+                iu, iv, iw = model.inter_edges
 
             # Inter-group bubble MST edges -> global candidate edges between
             # the groups' sample points (main/Main.java:248-265 analog).
-            mu, mv, mw = model.mst
-            cross = bubble_groups[mu] != bubble_groups[mv]
-            pool_u.append(samples_global[mu[cross]])
-            pool_v.append(samples_global[mv[cross]])
-            pool_w.append(mw[cross])
-            n_inter += int(cross.sum())
+            pool_u.append(samples_global[iu])
+            pool_v.append(samples_global[iv])
+            pool_w.append(iw)
+            n_inter += len(iu)
 
             # Next-level subset = renumbered bubble group (LabelClassification
             # + driver renumbering analog).
@@ -277,14 +284,11 @@ def fit(
     v = np.concatenate(pool_v) if pool_v else np.zeros(0, np.int64)
     w = np.concatenate(pool_w) if pool_w else np.zeros(0, np.float64)
 
-    forest = tree_mod.build_merge_forest(n, u, v, w)
-    tree = tree_mod.condense_forest(
-        forest, params.min_cluster_size,
-        self_levels=core if params.self_edges else None,
-    )
-    infinite = tree_mod.propagate_tree(tree)
-    labels = tree_mod.flat_labels(tree)
-    scores = tree_mod.outlier_scores(tree, core)
+    # Semi-supervised selection (constraints= flag) applies to the GLOBAL
+    # condensed tree, exactly as in the single-block path.
+    from hdbscan_tpu.models._finalize import finalize_clustering
+
+    tree, labels, scores, infinite = finalize_clustering(n, u, v, w, core, params)
     return MRHDBSCANResult(
         labels=labels,
         tree=tree,

@@ -1,0 +1,223 @@
+"""Tiled large-N device ops: streaming k-NN core distances and Borůvka rounds.
+
+The reference's exact variant ("Random Blocks", BASELINE.md RB column) holds
+O(n^2) distances in worker memory (``mappers/CoreDistanceMapper.java:57-112``
+broadcasts the whole dataset to every task; ``hdbscanstar/HDBSCANStar.java:124-205``
+is an O(n^2) Prim over a materialized row loop). On TPU the n^2 matrix for the
+north-star dataset (245,057 points -> 480 GB in f64) cannot exist in HBM, so
+every exact-at-scale op here is *tiled*: distances are recomputed on the fly
+per (row_tile x col_tile) block via the MXU dot-product expansion and reduced
+immediately — HBM traffic is O(n) per pass, FLOPs O(n^2 d) on the MXU
+(SURVEY.md §7 "Scale target").
+
+Two ops:
+
+- :func:`knn_core_distances` — one streaming pass producing per-point core
+  distances (k-th smallest distance, self included, matching
+  ``HDBSCANStar.java:71-106`` semantics as fixed in ``core/knn.py``).
+- :func:`min_outgoing_round` — one Borůvka round: for every point, the
+  minimum mutual-reachability edge to a point in a *different* component,
+  recomputing distances tile-by-tile. The host merges components between
+  rounds (``models/exact.py``); this replaces Prim (inherently sequential,
+  ``HDBSCANStar.java:150-187``) with log2(n) fully-parallel rounds.
+
+Both ops run one device program per call: the row loop is ``lax.map`` over
+row-tile indices, the column loop a ``lax.fori_loop``, so XLA fuses the
+distance tile + mask + reduction into VMEM-resident compute without
+materializing any (row_tile, n) slab in HBM.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hdbscan_tpu.core.distances import pairwise_distance
+
+
+def _pad_rows(a: np.ndarray, n_pad: int) -> np.ndarray:
+    if len(a) == n_pad:
+        return a
+    pad = np.zeros((n_pad - len(a), *a.shape[1:]), a.dtype)
+    return np.concatenate([a, pad])
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(0, (x - 1).bit_length())
+
+
+def _tile_sizes(n: int, row_tile: int, col_tile: int) -> tuple[int, int, int]:
+    """Clamp tiles to pow2 (so row_tile | col_tile) and compute n_pad.
+
+    Keeping both tiles powers of two guarantees the row tile divides the
+    column tile, so padding to one column tile suffices — padding to
+    lcm(row, col) for arbitrary sizes can blow n_pad up by orders of
+    magnitude. Minimums respect TPU layout (8 sublanes x 128 lanes).
+    """
+    row_tile = _next_pow2(max(8, min(row_tile, n)))
+    col_tile = _next_pow2(max(128, min(col_tile, n)))
+    col_tile = max(col_tile, row_tile)
+    return row_tile, col_tile, _round_up(n, col_tile)
+
+
+@partial(jax.jit, static_argnames=("k", "metric", "row_tile", "col_tile"))
+def _knn_core_scan(data, valid, k: int, metric: str, row_tile: int, col_tile: int):
+    """Per-row k smallest distances (self included) over the padded dataset.
+
+    Returns (n_pad, k) ascending distances; invalid rows give +inf.
+    """
+    n_pad = data.shape[0]
+    n_col_tiles = n_pad // col_tile
+    inf = jnp.array(jnp.inf, data.dtype)
+
+    def row_step(r):
+        xr = jax.lax.dynamic_slice_in_dim(data, r * row_tile, row_tile)
+        vr = jax.lax.dynamic_slice_in_dim(valid, r * row_tile, row_tile)
+
+        def col_step(c, best):
+            xc = jax.lax.dynamic_slice_in_dim(data, c * col_tile, col_tile)
+            vc = jax.lax.dynamic_slice_in_dim(valid, c * col_tile, col_tile)
+            d = pairwise_distance(xr, xc, metric)
+            d = jnp.where(vc[None, :], d, inf)
+            # top_k keeps the k LARGEST; negate to keep the k smallest.
+            merged = jnp.concatenate([best, -d], axis=1)
+            best, _ = jax.lax.top_k(merged, k)
+            return best
+
+        best = jnp.full((row_tile, k), -jnp.inf, data.dtype)
+        best = jax.lax.fori_loop(0, n_col_tiles, col_step, best)
+        knn = -best  # top_k of -d is descending in -d => ascending in d
+        return jnp.where(vr[:, None], knn, inf)
+
+    n_row_tiles = n_pad // row_tile
+    out = jax.lax.map(row_step, jnp.arange(n_row_tiles))
+    return out.reshape(n_pad, k)
+
+
+def knn_core_distances(
+    data: np.ndarray,
+    min_pts: int,
+    metric: str = "euclidean",
+    k: int | None = None,
+    row_tile: int = 1024,
+    col_tile: int = 8192,
+    dtype=np.float32,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Streaming exact core distances (and the full k-NN distance list).
+
+    Returns ``(core, knn)``: ``core[i]`` is the ``min_pts``-th smallest
+    distance from i (self included — ``core/knn.py`` semantics), ``knn`` the
+    (n, k) ascending distance list backing it.
+    """
+    n = len(data)
+    # Reference semantics: core distance = largest of the (minPts - 1)
+    # smallest distances with self included (core/knn.py, HDBSCANStar.java:71-106).
+    k = max(k or 0, max(min_pts - 1, 1))
+    row_tile, col_tile, n_pad = _tile_sizes(n, row_tile, col_tile)
+    data_p = jnp.asarray(_pad_rows(np.asarray(data, dtype), n_pad))
+    valid_p = jnp.asarray(np.arange(n_pad) < n)
+    knn = np.asarray(
+        _knn_core_scan(data_p, valid_p, k, metric, row_tile, col_tile),
+        np.float64,
+    )[:n]
+    if min_pts <= 1:
+        core = np.zeros(n, np.float64)
+    else:
+        core = knn[:, min(min_pts - 1, n) - 1].copy()
+    return core, knn
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+@partial(jax.jit, static_argnames=("metric", "row_tile", "col_tile"))
+def _min_outgoing_scan(
+    data, core, comp, valid, metric: str, row_tile: int, col_tile: int
+):
+    """One full Borůvka scan: per-point min mutual-reachability outgoing edge.
+
+    ``comp``: (n_pad,) int32 component labels. Returns (best_w, best_j) with
+    ``best_j = -1`` / ``best_w = +inf`` where no outgoing edge exists.
+    Deterministic tie-break: smallest column index j wins (argmin first-hit
+    over ascending j), making round output independent of tiling.
+    """
+    n_pad = data.shape[0]
+    n_col_tiles = n_pad // col_tile
+    inf = jnp.array(jnp.inf, data.dtype)
+
+    def row_step(r):
+        xr = jax.lax.dynamic_slice_in_dim(data, r * row_tile, row_tile)
+        cr = jax.lax.dynamic_slice_in_dim(core, r * row_tile, row_tile)
+        kr = jax.lax.dynamic_slice_in_dim(comp, r * row_tile, row_tile)
+        vr = jax.lax.dynamic_slice_in_dim(valid, r * row_tile, row_tile)
+
+        def col_step(c, carry):
+            bw, bj = carry
+            xc = jax.lax.dynamic_slice_in_dim(data, c * col_tile, col_tile)
+            cc = jax.lax.dynamic_slice_in_dim(core, c * col_tile, col_tile)
+            kc = jax.lax.dynamic_slice_in_dim(comp, c * col_tile, col_tile)
+            vc = jax.lax.dynamic_slice_in_dim(valid, c * col_tile, col_tile)
+            d = pairwise_distance(xr, xc, metric)
+            w = jnp.maximum(d, jnp.maximum(cr[:, None], cc[None, :]))
+            out = (kr[:, None] != kc[None, :]) & vc[None, :] & vr[:, None]
+            w = jnp.where(out, w, inf)
+            tw = jnp.min(w, axis=1)
+            tj = jnp.argmin(w, axis=1).astype(jnp.int32) + c * col_tile
+            upd = tw < bw
+            return jnp.where(upd, tw, bw), jnp.where(upd, tj, bj)
+
+        bw0 = jnp.full((row_tile,), jnp.inf, data.dtype)
+        bj0 = jnp.full((row_tile,), -1, jnp.int32)
+        return jax.lax.fori_loop(0, n_col_tiles, col_step, (bw0, bj0))
+
+    n_row_tiles = n_pad // row_tile
+    bw, bj = jax.lax.map(row_step, jnp.arange(n_row_tiles))
+    return bw.reshape(n_pad), bj.reshape(n_pad)
+
+
+class BoruvkaScanner:
+    """Device-resident state for repeated Borůvka rounds over one dataset.
+
+    Keeps the padded point matrix + core distances on device across rounds;
+    only the (n,) component labels cross host<->device per round (the host
+    does union-find merging between rounds — ``models/exact.py``).
+    """
+
+    def __init__(
+        self,
+        data: np.ndarray,
+        core: np.ndarray,
+        metric: str = "euclidean",
+        row_tile: int = 1024,
+        col_tile: int = 8192,
+        dtype=np.float32,
+    ):
+        n = len(data)
+        self.n = n
+        self.metric = metric
+        self.row_tile, self.col_tile, n_pad = _tile_sizes(n, row_tile, col_tile)
+        self.n_pad = n_pad
+        self._data = jnp.asarray(_pad_rows(np.asarray(data, dtype), n_pad))
+        self._core = jnp.asarray(_pad_rows(np.asarray(core, dtype), n_pad))
+        self._valid = jnp.asarray(np.arange(n_pad) < n)
+
+    def min_outgoing(self, comp: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """(best_w, best_j) per point, edges leaving the point's component."""
+        comp_p = jnp.asarray(_pad_rows(np.asarray(comp, np.int32), self.n_pad))
+        bw, bj = _min_outgoing_scan(
+            self._data,
+            self._core,
+            comp_p,
+            self._valid,
+            self.metric,
+            self.row_tile,
+            self.col_tile,
+        )
+        return (
+            np.asarray(bw, np.float64)[: self.n],
+            np.asarray(bj, np.int64)[: self.n],
+        )

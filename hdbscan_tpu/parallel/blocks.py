@@ -167,10 +167,12 @@ def run_packed_blocks(
     b = len(packed.x)
     cap = packed.x.shape[1]
     itemsize = 8 if jax.config.jax_enable_x64 else 4
+    from hdbscan_tpu.parallel.mesh import pad_batch
+
     per_block = cap * cap * itemsize * _BLOCK_TEMPS
     chunk = max(1, hbm_budget_bytes // per_block)
     chunk = max(batch_pad, chunk // batch_pad * batch_pad)
-    chunk = min(chunk, -(-b // batch_pad) * batch_pad)
+    chunk = min(chunk, pad_batch(b, batch_pad))
 
     sh = None
     if mesh is not None:

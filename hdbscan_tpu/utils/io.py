@@ -106,10 +106,12 @@ def write_tree_file(
     delimiter: str = ",",
 ) -> None:
     offsets = offsets or {}
-    cons = (
-        tree.num_constraints_satisfied
-        if tree.num_constraints_satisfied is not None
-        else np.zeros(tree.n_clusters + 1, np.int64)
+    zeros = np.zeros(tree.n_clusters + 1, np.int64)
+    cons = tree.num_constraints_satisfied if tree.num_constraints_satisfied is not None else zeros
+    vcons = (
+        tree.virtual_child_constraints
+        if tree.virtual_child_constraints is not None
+        else zeros
     )
     with open(path, "w") as f:
         for c in range(1, tree.n_clusters + 1):
@@ -120,7 +122,7 @@ def write_tree_file(
                 f"{tree.death[c]:.9g}",
                 f"{tree.stability[c]:.9g}",
                 str(int(cons[c])),
-                "0",
+                str(int(vcons[c])),
                 str(offsets.get(c, 0)),
                 str(int(parent)),
             ]

@@ -74,13 +74,24 @@ class TestCounts:
         assert num[res.labels[a]] >= 1
         assert num[res.labels[b]] >= 1
 
-    def test_root_never_credited(self, rng):
+    def test_root_credits(self, rng):
+        """Root earns +2 per must-link (reference pre-loop credit,
+        HDBSCANStar.java:241-244) and nothing from cannot-links directly."""
         pts, truth, res = two_cluster_tree(rng)
         num, vnum = count_constraints_satisfied(
             res.tree, [Constraint(0, 1, "ml"), Constraint(0, 2, "cl")]
         )
-        assert num[tree_mod.ROOT_LABEL] == 0
-        assert vnum[tree_mod.ROOT_LABEL] == 0
+        assert num[tree_mod.ROOT_LABEL] == 2
+
+    def test_virtual_credit_requires_split(self, rng):
+        """vGamma goes only to clusters that split (parents-of-new-clusters
+        scoping); total virtual credit is bounded by cl endpoints."""
+        pts, truth, res = two_cluster_tree(rng)
+        cons = [Constraint(0, 1, "cl"), Constraint(2, 3, "cl")]
+        num, vnum = count_constraints_satisfied(res.tree, cons)
+        credited = np.nonzero(vnum)[0]
+        assert np.all(res.tree.has_children[credited])
+        assert vnum.sum() <= 2 * len(cons)
 
     def test_noise_endpoint_virtual_credit(self, rng):
         pts, truth, res = two_cluster_tree(rng)

@@ -1,0 +1,87 @@
+"""Blocked exact (Random Blocks) path vs the dense single-block path.
+
+The tiled Borůvka (``models/exact.py`` + ``ops/tiled.py``) must reproduce the
+dense in-memory result: same MST weight multiset, same condensed tree, same
+flat labels — with tiles much smaller than the dataset so every code path
+(row loop, column loop, padding, cross-tile argmin) is exercised.
+"""
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.config import HDBSCANParams
+from hdbscan_tpu.models import exact, hdbscan
+from hdbscan_tpu.ops.tiled import knn_core_distances
+from tests.conftest import make_blobs
+
+
+def _params(**kw):
+    base = dict(min_points=4, min_cluster_size=5)
+    base.update(kw)
+    return HDBSCANParams(**base)
+
+
+def test_knn_core_distances_match_dense(rng):
+    data, _ = make_blobs(rng, n=90, d=3)
+    from hdbscan_tpu.core.knn import core_distances
+
+    import jax.numpy as jnp
+
+    for min_pts in (1, 2, 4, 9):
+        core, knn = knn_core_distances(
+            data, min_pts, row_tile=16, col_tile=128, dtype=np.float64
+        )
+        dense = np.asarray(core_distances(jnp.asarray(data), min_pts))
+        np.testing.assert_allclose(core, dense, rtol=1e-9, atol=1e-12)
+        assert np.all(np.diff(knn, axis=1) >= -1e-12)  # ascending lists
+
+
+def test_exact_matches_dense_block(rng):
+    data, _ = make_blobs(rng, n=140, d=3, centers=3)
+    p = _params()
+    dense = hdbscan.fit(data, p)
+    blocked = exact.fit(data, p, row_tile=16, col_tile=128, dtype=np.float64)
+    # Same MST weight multiset (edge identities may differ under ties).
+    np.testing.assert_allclose(
+        np.sort(blocked.mst[2]), np.sort(dense.mst[2]), rtol=1e-9
+    )
+    np.testing.assert_allclose(blocked.core_distances, dense.core_distances, rtol=1e-9)
+    assert blocked.mst[0].shape == (len(data) - 1,)
+    # Identical clustering.
+    np.testing.assert_array_equal(blocked.labels, dense.labels)
+    np.testing.assert_allclose(
+        np.sort(blocked.tree.stability), np.sort(dense.tree.stability), rtol=1e-6
+    )
+
+
+@pytest.mark.parametrize("metric", ["manhattan", "cosine"])
+def test_exact_other_metrics(rng, metric):
+    data, _ = make_blobs(rng, n=80, d=4, centers=2)
+    data = np.abs(data) + 0.1  # keep cosine well-defined
+    p = _params(dist_function=metric)
+    dense = hdbscan.fit(data, p)
+    blocked = exact.fit(data, p, row_tile=16, col_tile=128, dtype=np.float64)
+    np.testing.assert_allclose(
+        np.sort(blocked.mst[2]), np.sort(dense.mst[2]), rtol=1e-9
+    )
+    np.testing.assert_array_equal(blocked.labels, dense.labels)
+
+
+def test_exact_iris_golden(iris):
+    """The bundled 149x4 dataset with the reference's hard-coded params
+    (minPts=4, minClSize=4, ``main/Main.java:71``)."""
+    p = HDBSCANParams(min_points=4, min_cluster_size=4)
+    dense = hdbscan.fit(iris, p)
+    blocked = exact.fit(iris, p, row_tile=32, col_tile=128, dtype=np.float64)
+    np.testing.assert_array_equal(blocked.labels, dense.labels)
+    np.testing.assert_allclose(
+        np.sort(blocked.mst[2]), np.sort(dense.mst[2]), rtol=1e-9
+    )
+
+
+def test_exact_single_cluster(rng):
+    data = rng.normal(size=(60, 2)) * 0.01
+    p = _params(min_cluster_size=10)
+    blocked = exact.fit(data, p, row_tile=8, col_tile=128, dtype=np.float64)
+    dense = hdbscan.fit(data, p)
+    np.testing.assert_array_equal(blocked.labels, dense.labels)
