@@ -9,4 +9,34 @@ re-architected for TPU hardware.
 
 __version__ = "0.1.0"
 
+import os as _os
+
+
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache (set HDBSCAN_TPU_CACHE_DIR to move it,
+    or to "" to disable). First TPU compiles are tens of seconds over remote
+    compile; the cache makes every later process start warm."""
+    cache = _os.environ.get("HDBSCAN_TPU_CACHE_DIR")
+    if cache == "":
+        return
+    if cache is None:
+        # Repo checkout: keep the cache next to the package so every process
+        # (tests, bench, driver) shares it. Unwritable parent (installed
+        # package): fall back to the user cache dir.
+        cache = _os.path.join(_os.path.dirname(_os.path.dirname(__file__)), ".jax_cache")
+        if not _os.access(_os.path.dirname(cache), _os.W_OK):
+            cache = _os.path.join(
+                _os.path.expanduser("~"), ".cache", "hdbscan_tpu", "jax_cache"
+            )
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # pragma: no cover - cache is an optimization only
+        pass
+
+
+_enable_compile_cache()
+
 from hdbscan_tpu.config import HDBSCANParams  # noqa: F401

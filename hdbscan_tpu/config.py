@@ -33,6 +33,11 @@ class HDBSCANParams:
     out_dir: str | None = None
     self_edges: bool = True
     seed: int = 0
+    #: Approximation variant for oversized subsets (BASELINE.md columns):
+    #: "db" = recursive sampling + data bubbles (the reference's live pipeline);
+    #: "rs" = simple recursive sampling (cluster the sample points directly,
+    #: the paper's RS baseline — quoted-numbers-only in the reference).
+    variant: str = "db"
     # Output file names derived from the input path (main/Main.java:516-526):
 
     def __post_init__(self):
@@ -46,6 +51,8 @@ class HDBSCANParams:
             raise ValueError("k (sample fraction) must be in (0, 1]")
         if self.processing_units < 1:
             raise ValueError("processing_units must be >= 1")
+        if self.variant not in ("db", "rs"):
+            raise ValueError(f"variant must be 'db' or 'rs', got {self.variant!r}")
 
     @property
     def base_name(self) -> str:
@@ -80,6 +87,7 @@ class HDBSCANParams:
             "clusterName": ("cluster_name", str),
             "out_dir": ("out_dir", str),
             "seed": ("seed", int),
+            "variant": ("variant", str),
         }
         kwargs = {}
         for arg in argv:

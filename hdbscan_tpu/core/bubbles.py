@@ -52,6 +52,7 @@ __all__ = [
 ]
 
 
+@partial(jax.jit, static_argnames=("num_bubbles",))
 def bubble_stats(
     points: jax.Array, assign: jax.Array, num_bubbles: int
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
@@ -157,8 +158,12 @@ def bubble_core_distances(
     knn_dist = jnp.where(ok[None, :] & ok[:, None], dist, inf)
     knn_dist = jnp.where(jnp.eye(m, dtype=bool), inf, knn_dist)
 
-    order = jnp.argsort(knn_dist, axis=1)
-    sorted_d = jnp.take_along_axis(knn_dist, order, axis=1)
+    # The covering walk needs at most k' = minPts - 1 neighbor bubbles (every
+    # valid bubble holds >= 1 member), so a bounded top_k replaces the full
+    # O(m^2 log m) row sort — the compile- and runtime-heavy op at large m.
+    kk = int(min(m, min_pts))
+    neg_d, order = jax.lax.top_k(-knn_dist, kk)
+    sorted_d = -neg_d
     nb_sorted = jnp.where(jnp.isfinite(sorted_d), n_b[order], 0.0)
     cover = n_b[:, None] + jnp.cumsum(nb_sorted, axis=1)
 

@@ -38,6 +38,7 @@ class BubbleModel:
     ``HdbscanDataBubbles.java:485-502``).
     ``inter_edges``: (u, v, w) bubble-index MST edges crossing flat clusters —
     the candidate inter-partition MST edges (``findInterClusterEdges``).
+    ``weights``: member count per bubble (already fetched to host).
     """
 
     labels: np.ndarray
@@ -45,6 +46,7 @@ class BubbleModel:
     core: np.ndarray
     mst: tuple[np.ndarray, np.ndarray, np.ndarray]
     inter_edges: tuple[np.ndarray, np.ndarray, np.ndarray]
+    weights: np.ndarray | None = None
 
 
 @partial(jax.jit, static_argnames=("min_pts", "dims", "metric"))
@@ -60,7 +62,43 @@ def _bubble_device_block(rep, extent, nn_dist, n_b, num_valid, min_pts: int, dim
     core = bubble_core_distances(dist, n_b, extent, min_pts, dims, valid=valid)
     mrd = bubble_mutual_reachability(dist, core)
     u, v, w, mask, _ = boruvka_mst(mrd, num_valid)
-    return dist, core, u, v, w, mask
+    # Pack everything the host fetches into ONE leaf (each fetched array pays
+    # a full tunnel round trip): [u, v, w, mask | core, n_b], in w's dtype.
+    # u/v/mask are ALSO returned as device arrays so the follow-up reassign
+    # call reuses them without a host->device upload.
+    dt = w.dtype
+    packed = jnp.concatenate(
+        [u.astype(dt), v.astype(dt), w, mask.astype(dt), core, n_b.astype(dt)]
+    )
+    return dist, u, v, mask, packed
+
+
+def _unpack_bubble_block(packed: np.ndarray, m_pad: int):
+    e = m_pad - 1
+    u = packed[:e].astype(np.int64)
+    v = packed[e : 2 * e].astype(np.int64)
+    w = packed[2 * e : 3 * e].astype(np.float64)
+    mask = packed[3 * e : 4 * e] != 0
+    core = packed[4 * e : 4 * e + m_pad].astype(np.float64)
+    n_b = packed[4 * e + m_pad :].astype(np.float64)
+    return u, v, w, mask, core, n_b
+
+
+@jax.jit
+def _bubble_reassign_block(dist, labels, u, v, mask, num_valid):
+    """Noise reassignment + inter-cluster edge mask as ONE padded device call.
+
+    ``labels`` is (m_pad,) with zeros on padding; padding bubbles are excluded
+    as donors via ``valid``. ``u``/``v``/``mask`` are the padded MST edge
+    arrays rebuilt on host from the packed fetch. Output is one packed leaf:
+    [labels | cross] in float.
+    """
+    m = dist.shape[0]
+    valid = jnp.arange(m, dtype=jnp.int32) < num_valid
+    new = reassign_noise_bubbles(dist, labels, valid=valid)
+    cross = mask & inter_cluster_edge_mask(u, v, new)
+    dt = dist.dtype
+    return jnp.concatenate([new.astype(dt), cross.astype(dt)])
 
 
 def fit_bubbles(
@@ -99,8 +137,9 @@ def fit_bubbles(
             core=np.zeros(1),
             mst=(empty, empty, np.zeros(0)),
             inter_edges=(empty, empty, np.zeros(0)),
+            weights=w1,
         )
-    dist, core, u, v, w, mask = _bubble_device_block(
+    dist, u_d, v_d, mask_d, packed_d = _bubble_device_block(
         rep,
         jnp.asarray(extent),
         jnp.asarray(nn_dist),
@@ -110,26 +149,34 @@ def fit_bubbles(
         dims,
         metric,
     )
-    mask = np.asarray(mask)
-    u = np.asarray(u)[mask]
-    v = np.asarray(v)[mask]
-    w = np.asarray(w, np.float64)[mask]
-    core_h = np.asarray(core, np.float64)[:m]
-    dist = dist[:m, :m]
-    weights = np.asarray(n_b, np.float64)[:m]
+    # One single-leaf fetch for everything the host tree extraction needs.
+    u_p, v_p, w_p, mask, core_p, n_b_h = _unpack_bubble_block(
+        jax.device_get(packed_d), m_pad
+    )
+    u = u_p[mask]
+    v = v_p[mask]
+    w = w_p[mask]
+    core_h = core_p[:m]
+    weights = n_b_h[:m]
 
     tree, labels = tree_mod.extract_clusters(
         m, u, v, w, min_cluster_size, point_weights=weights, self_levels=core_h
     )
 
-    labels = np.asarray(
-        reassign_noise_bubbles(dist, jnp.asarray(labels)), np.int64
+    labels_p = np.zeros(m_pad, np.int32)
+    labels_p[:m] = labels
+    out = jax.device_get(
+        _bubble_reassign_block(
+            dist, jnp.asarray(labels_p), u_d, v_d, mask_d, jnp.int32(m)
+        )
     )
-    cross = np.asarray(inter_cluster_edge_mask(jnp.asarray(u), jnp.asarray(v), jnp.asarray(labels)))
+    labels = np.asarray(out[:m_pad].round(), np.int64)[:m]
+    cross = (out[m_pad:] != 0)[mask]
     return BubbleModel(
         labels=labels,
         tree=tree,
         core=core_h,
         mst=(u, v, w),
         inter_edges=(u[cross], v[cross], w[cross]),
+        weights=weights,
     )

@@ -49,11 +49,10 @@ def hdbscan_block_edges(
     x: np.ndarray, min_pts: int, metric: str = "euclidean"
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Device pass: returns (u, v, w) MST edges and core distances (host arrays)."""
-    u, v, w, mask, core = _device_block(jnp.asarray(x), min_pts, metric)
-    mask = np.asarray(mask)
+    u, v, w, mask, core = jax.device_get(_device_block(jnp.asarray(x), min_pts, metric))
     return (
-        np.asarray(u)[mask],
-        np.asarray(v)[mask],
+        np.asarray(u, np.int64)[mask],
+        np.asarray(v, np.int64)[mask],
         np.asarray(w, np.float64)[mask],
         np.asarray(core, np.float64),
     )

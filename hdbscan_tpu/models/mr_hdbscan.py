@@ -1,4 +1,11 @@
-"""MR-HDBSCAN* — recursive sampling + data bubbles, TPU-orchestrated (L6).
+"""MR-HDBSCAN* — recursive sampling (+ data bubbles), TPU-orchestrated (L6).
+
+Two approximation variants (BASELINE.md columns, selected by
+``HDBSCANParams.variant``): **db** (default) summarizes each oversized
+subset's points into data bubbles around the sample and clusters the bubbles
+— the reference's live pipeline; **rs** clusters the sample points directly
+(the paper's simple recursive-sampling baseline, for which the reference only
+quotes numbers).
 
 Re-design of the reference driver's phase-1/2/3 structure
 (``main/Main.java:107-411``; call stack SURVEY.md §3.1-3.3) without the Spark
@@ -34,6 +41,7 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass, field
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -137,6 +145,56 @@ def _forced_split_groups(
     return groups
 
 
+def _fit_samples_rs(
+    samp_data: np.ndarray,
+    min_pts: int,
+    min_cluster_size: int,
+    metric: str,
+):
+    """RS local model: exact HDBSCAN* on the sample points themselves.
+
+    The paper's simple recursive-sampling baseline (BASELINE.md "RS" column;
+    quoted-numbers-only in the reference): no CF summarization — the sample is
+    clustered directly, noise samples are reassigned to their nearest
+    non-noise sample's cluster, and sample-MST edges crossing flat clusters
+    become the inter-partition candidate edges.
+
+    Returns (labels, (u, v, w), (iu, iv, iw)) in local sample indices: flat
+    labels, the sample MST edges, and the cross-cluster edge subset.
+    """
+    from hdbscan_tpu.core.bubbles import (
+        inter_cluster_edge_mask,
+        reassign_noise_bubbles,
+    )
+    from hdbscan_tpu.core.distances import self_distance_matrix
+    from hdbscan_tpu.parallel.blocks import block_mst_batch
+
+    s = len(samp_data)
+    s_pad = max(128, _next_pow2(s))
+    x = np.zeros((1, s_pad, samp_data.shape[1]), np.float64)
+    x[0, :s] = samp_data
+    u, v, w, mask, core = jax.device_get(
+        block_mst_batch(jnp.asarray(x), jnp.asarray([s], jnp.int32), min_pts, metric)
+    )
+    m = np.asarray(mask[0])
+    u = np.asarray(u[0], np.int64)[m]
+    v = np.asarray(v[0], np.int64)[m]
+    w = np.asarray(w[0], np.float64)[m]
+    core_h = np.asarray(core[0], np.float64)[:s]
+
+    _, labels = tree_mod.extract_clusters(
+        s, u, v, w, min_cluster_size, self_levels=core_h
+    )
+    dist = self_distance_matrix(jnp.asarray(samp_data), metric)
+    labels = np.asarray(
+        reassign_noise_bubbles(dist, jnp.asarray(labels)), np.int64
+    )
+    cross = np.asarray(
+        inter_cluster_edge_mask(jnp.asarray(u), jnp.asarray(v), jnp.asarray(labels))
+    )
+    return labels, (u, v, w), (u[cross], v[cross], w[cross])
+
+
 def fit(
     data: np.ndarray,
     params: HDBSCANParams | None = None,
@@ -213,40 +271,59 @@ def fit(
             samples_global = ids[samp_local]
             assign = nearest_sample_assign(data[ids], data[samples_global], metric)
 
-            # Pad bubble slots to pow2 so similar subset sizes share compiles.
-            s_pad = _next_pow2(s_count)
-            rep, extent, nn_dist, n_b = bubble_stats(
-                jnp.asarray(data[ids]), jnp.asarray(assign), s_pad
-            )
-            model = fit_bubbles(
-                np.asarray(rep),
-                np.asarray(extent),
-                np.asarray(nn_dist),
-                np.asarray(n_b),
-                params.min_points,
-                params.min_cluster_size,
-                metric,
-                num_valid=s_count,
-            )
+            if params.variant == "rs":
+                # RS: cluster the sample points directly (no summarization).
+                labels_s, (mu, mv, mw), inter = _fit_samples_rs(
+                    data[samples_global],
+                    params.min_points,
+                    params.min_cluster_size,
+                    metric,
+                )
+                weights_s = np.bincount(assign, minlength=s_count).astype(np.float64)
+            else:
+                # DB: summarize assigned points into data bubbles, cluster those.
+                # Pad bubble slots AND the point axis to pow2 so subsets of
+                # similar size share one compiled segment-op program (padding
+                # points carry segment id == s_pad, which the segment ops drop).
+                s_pad = _next_pow2(s_count)
+                n_pad = _next_pow2(size)
+                pts_p = np.zeros((n_pad, d), data.dtype)
+                pts_p[:size] = data[ids]
+                asg_p = np.full(n_pad, s_pad, np.int32)
+                asg_p[:size] = assign
+                pts_j, asg_j = jax.device_put((pts_p, asg_p))
+                rep, extent, nn_dist, n_b = bubble_stats(pts_j, asg_j, s_pad)
+                # Device arrays pass straight through — fit_bubbles batches the
+                # one device->host fetch the tree extraction needs.
+                model = fit_bubbles(
+                    rep,
+                    extent,
+                    nn_dist,
+                    n_b,
+                    params.min_points,
+                    params.min_cluster_size,
+                    metric,
+                    num_valid=s_count,
+                )
+                labels_s = model.labels
+                mu, mv, mw = model.mst
+                inter = model.inter_edges
+                weights_s = model.weights  # already fetched in the packed leaf
             n_bub += s_count
 
-            bubble_groups = _bubble_groups_from_labels(model.labels)
+            bubble_groups = _bubble_groups_from_labels(labels_s)
             if bubble_groups.max() == 0:
                 # Single flat cluster: the subset would re-enter unchanged.
-                mu, mv, _ = model.mst
-                bubble_groups = _forced_split_groups(
-                    np.asarray(n_b)[:s_count], mu, mv, cap
-                )
+                bubble_groups = _forced_split_groups(weights_s, mu, mv, cap)
                 forced += 1
                 # Forced groups differ from flat clusters: recompute which
-                # bubble-MST edges cross groups.
-                mu, mv, mw = model.mst
+                # sample/bubble-MST edges cross groups.
                 cross = bubble_groups[mu] != bubble_groups[mv]
                 iu, iv, iw = mu[cross], mv[cross], mw[cross]
             else:
                 # Normal path: the model already harvested the cross-cluster
                 # MST edges (findInterClusterEdges analog).
-                iu, iv, iw = model.inter_edges
+                iu, iv, iw = inter
 
             # Inter-group bubble MST edges -> global candidate edges between
             # the groups' sample points (main/Main.java:248-265 analog).

@@ -208,14 +208,16 @@ class BoruvkaScanner:
     def min_outgoing(self, comp: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """(best_w, best_j) per point, edges leaving the point's component."""
         comp_p = jnp.asarray(_pad_rows(np.asarray(comp, np.int32), self.n_pad))
-        bw, bj = _min_outgoing_scan(
-            self._data,
-            self._core,
-            comp_p,
-            self._valid,
-            self.metric,
-            self.row_tile,
-            self.col_tile,
+        bw, bj = jax.device_get(
+            _min_outgoing_scan(
+                self._data,
+                self._core,
+                comp_p,
+                self._valid,
+                self.metric,
+                self.row_tile,
+                self.col_tile,
+            )
         )
         return (
             np.asarray(bw, np.float64)[: self.n],

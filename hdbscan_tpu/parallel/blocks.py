@@ -50,6 +50,33 @@ def block_mst_batch(x: jax.Array, num_valid: jax.Array, min_pts: int, metric: st
     return jax.vmap(one)(x, num_valid)
 
 
+@partial(jax.jit, static_argnames=("min_pts", "metric"))
+def block_mst_batch_packed(x: jax.Array, num_valid: jax.Array, min_pts: int, metric: str):
+    """:func:`block_mst_batch` with outputs packed into ONE (B, 5*cap-4) array.
+
+    The tunnel between host and TPU pays a full round trip per fetched array
+    leaf, so the five result arrays are concatenated on device (in the weight
+    dtype; int32 ids are exact in f32 up to 2^24 >> any block capacity) and
+    split again on host — see :func:`unpack_block_mst`.
+    """
+    u, v, w, mask, core = block_mst_batch(x, num_valid, min_pts, metric)
+    dt = w.dtype
+    return jnp.concatenate(
+        [u.astype(dt), v.astype(dt), w, mask.astype(dt), core], axis=1
+    )
+
+
+def unpack_block_mst(packed: np.ndarray, cap: int):
+    """Host-side split of :func:`block_mst_batch_packed` output."""
+    e = cap - 1
+    u = packed[:, :e].astype(np.int64)
+    v = packed[:, e : 2 * e].astype(np.int64)
+    w = packed[:, 2 * e : 3 * e].astype(np.float64)
+    mask = packed[:, 3 * e : 4 * e] != 0
+    core = packed[:, 4 * e :].astype(np.float64)
+    return u, v, w, mask, core
+
+
 @partial(jax.jit, static_argnames=("metric",))
 def nearest_sample_tile(points: jax.Array, samples: jax.Array, sample_valid: jax.Array, metric: str):
     """Per-point nearest sample over one tile: returns (argmin idx, min dist).
@@ -68,35 +95,49 @@ def _next_pow2(x: int) -> int:
     return 1 << max(0, (x - 1).bit_length())
 
 
+@partial(jax.jit, static_argnames=("metric", "tile"))
+def _nearest_sample_scan(points, samples, sample_valid, metric: str, tile: int):
+    """Whole-dataset nearest-sample argmin as ONE device program.
+
+    Tiles the point axis with ``lax.map`` so the (tile, s_pad) distance matrix
+    stays VMEM-sized; a single dispatch + single fetch instead of one
+    host round trip per tile (the tunnel round trip dominates at ~100ms).
+    """
+    n_pad, d = points.shape
+    tiles = points.reshape(n_pad // tile, tile, d)
+
+    def one(pts):
+        dd = pairwise_distance(pts, samples, metric)
+        dd = jnp.where(sample_valid[None, :], dd, jnp.inf)
+        return jnp.argmin(dd, axis=1).astype(jnp.int32)
+
+    return jax.lax.map(one, tiles).reshape(n_pad)
+
+
 def nearest_sample_assign(
     points: np.ndarray,
     samples: np.ndarray,
     metric: str = "euclidean",
     tile: int = 8192,
 ) -> np.ndarray:
-    """Host-driven tiled nearest-sample assignment (padding-stable compiles).
+    """Nearest-sample assignment, one device call (padding-stable compiles).
 
-    Sample count is padded to the next power of two so level-to-level sample
-    matrices of similar size reuse the compiled kernel.
+    Sample count and point count are padded to powers of two so
+    level-to-level calls of similar size reuse the compiled kernel.
     """
     n = len(points)
     s = len(samples)
     s_pad = _next_pow2(max(s, 1))
     samples_p = np.zeros((s_pad, samples.shape[1]), samples.dtype)
     samples_p[:s] = samples
-    sample_valid = np.arange(s_pad) < s
-    samples_j = jnp.asarray(samples_p)
-    valid_j = jnp.asarray(sample_valid)
-
-    out = np.empty(n, np.int32)
-    for start in range(0, n, tile):
-        chunk = points[start : start + tile]
-        pad = tile - len(chunk)
-        if pad:
-            chunk = np.concatenate([chunk, np.zeros((pad, chunk.shape[1]), chunk.dtype)])
-        idx, _ = nearest_sample_tile(jnp.asarray(chunk), samples_j, valid_j, metric)
-        out[start : start + tile] = np.asarray(idx)[: tile - pad if pad else tile]
-    return out
+    # Both tile and n_pad are powers of two, so tile | n_pad always holds.
+    tile = min(_next_pow2(tile), _next_pow2(max(n, 8)))
+    n_pad = _next_pow2(max(n, tile))
+    points_p = np.zeros((n_pad, points.shape[1]), points.dtype)
+    points_p[:n] = points
+    pts_j, smp_j, val_j = jax.device_put((points_p, samples_p, np.arange(s_pad) < s))
+    idx = _nearest_sample_scan(pts_j, smp_j, val_j, metric, tile)
+    return np.asarray(idx, np.int32)[:n].copy()
 
 
 @dataclass
@@ -170,9 +211,12 @@ def run_packed_blocks(
     from hdbscan_tpu.parallel.mesh import pad_batch
 
     per_block = cap * cap * itemsize * _BLOCK_TEMPS
+    # All chunk sizes are powers of two: launches for 2, 3, or 4 blocks of one
+    # capacity share a single compiled shape instead of compiling per count.
     chunk = max(1, hbm_budget_bytes // per_block)
-    chunk = max(batch_pad, chunk // batch_pad * batch_pad)
-    chunk = min(chunk, pad_batch(b, batch_pad))
+    chunk = 1 << (chunk.bit_length() - 1)  # pow2 floor of the budget chunk
+    chunk = min(max(batch_pad, chunk), _next_pow2(pad_batch(b, batch_pad)))
+    chunk = pad_batch(chunk, batch_pad)  # keep the mesh axis dividing evenly
 
     sh = None
     if mesh is not None:
@@ -182,6 +226,26 @@ def run_packed_blocks(
 
     core = np.empty((b, cap), np.float64)
     gu, gv, gw = [], [], []
+
+    def drain(pending):
+        # One batched fetch of one packed leaf per launch (each fetched leaf
+        # pays a full host<->device round trip over the tunnel).
+        fetched = jax.device_get([p[2] for p in pending])
+        for (start, real, _), pk in zip(pending, fetched):
+            u, v, w, mask, core_c = unpack_block_mst(pk, cap)
+            core[start : start + real] = core_c[:real]
+            for i in range(real):
+                m = mask[i]
+                ids = packed.point_index[start + i]
+                gu.append(ids[u[i][m]])
+                gv.append(ids[v[i][m]])
+                gw.append(w[i][m])
+
+    # Dispatch launches (JAX async) ahead of fetching so the device pipelines
+    # while the host feeds — but drain in bounded windows so resident
+    # inputs+outputs stay within ~2x the per-launch HBM budget.
+    max_inflight = 8
+    pending = []
     for start in range(0, b, chunk):
         x = packed.x[start : start + chunk]
         nv = packed.num_valid[start : start + chunk]
@@ -189,24 +253,16 @@ def run_packed_blocks(
         if real != chunk:  # pad every launch to the same shape: one compile
             x = np.concatenate([x, np.zeros((chunk - real, *x.shape[1:]), x.dtype)])
             nv = np.concatenate([nv, np.zeros(chunk - real, nv.dtype)])
-        xj, nvj = jnp.asarray(x), jnp.asarray(nv)
         if sh is not None:
-            xj = jax.device_put(xj, sh)
-            nvj = jax.device_put(nvj, sh)
-        u, v, w, mask, core_c = block_mst_batch(xj, nvj, min_pts, metric)
-        u, v, w, mask = (
-            np.asarray(u),
-            np.asarray(v),
-            np.asarray(w, np.float64),
-            np.asarray(mask),
-        )
-        core[start : start + real] = np.asarray(core_c, np.float64)[:real]
-        for i in range(real):
-            m = mask[i]
-            ids = packed.point_index[start + i]
-            gu.append(ids[u[i][m]])
-            gv.append(ids[v[i][m]])
-            gw.append(w[i][m])
+            xj, nvj = jax.device_put((x, nv), (sh, sh))
+        else:
+            xj, nvj = jax.device_put((x, nv))
+        pending.append((start, real, block_mst_batch_packed(xj, nvj, min_pts, metric)))
+        if len(pending) >= max_inflight:
+            drain(pending)
+            pending = []
+    if pending:
+        drain(pending)
     return (
         np.concatenate(gu) if gu else np.zeros(0, np.int64),
         np.concatenate(gv) if gv else np.zeros(0, np.int64),

@@ -76,6 +76,47 @@ class TestLevelTrace:
         assert sum(l.n_processed for l in mr.levels) == 500
 
 
+class TestRSVariant:
+    def test_rs_blobs_high_ari(self, rng):
+        pts, truth = make_blobs(rng, n=1200, d=3, centers=4, spread=0.08)
+        params = HDBSCANParams(
+            min_points=5,
+            min_cluster_size=10,
+            processing_units=200,
+            k=0.15,
+            seed=0,
+            variant="rs",
+        )
+        mr = mr_hdbscan.fit(pts, params)
+        assert mr.n_levels >= 2
+        ari = adjusted_rand_index(mr.labels, truth, noise_as_singletons=False)
+        assert ari > 0.9, f"RS ARI vs ground truth too low: {ari}"
+
+    def test_rs_differs_from_db_but_both_converge(self, rng):
+        pts, _ = make_blobs(rng, n=900, d=2, centers=3, spread=0.1)
+        base = HDBSCANParams(min_points=4, min_cluster_size=8, processing_units=150, seed=2)
+        db = mr_hdbscan.fit(pts, base)
+        rs = mr_hdbscan.fit(pts, base.replace(variant="rs"))
+        assert len(db.labels) == len(rs.labels) == 900
+        # both variants should broadly agree on strong blob structure
+        assert adjusted_rand_index(db.labels, rs.labels) > 0.5
+
+    def test_rs_single_gaussian_terminates(self, rng):
+        """Forced-split guard must also work for the RS variant."""
+        pts = rng.normal(size=(700, 2))
+        params = HDBSCANParams(
+            min_points=4, min_cluster_size=10, processing_units=100, k=0.1, variant="rs"
+        )
+        mr = mr_hdbscan.fit(pts, params)
+        assert len(mr.labels) == 700
+
+    def test_variant_flag_validation(self):
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            HDBSCANParams(variant="nope")
+
+
 class TestForcedSplit:
     def test_single_gaussian_terminates(self, rng):
         """One dense blob: bubble model finds a single cluster every level —
