@@ -19,6 +19,8 @@ def _enable_compile_cache() -> None:
     cache = _os.environ.get("HDBSCAN_TPU_CACHE_DIR")
     if cache == "":
         return
+    if cache is None and _os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        return  # the user configured JAX's cache themselves; don't override
     if cache is None:
         # Repo checkout: keep the cache next to the package so every process
         # (tests, bench, driver) shares it. Unwritable parent (installed
@@ -31,6 +33,8 @@ def _enable_compile_cache() -> None:
     try:
         import jax
 
+        if jax.config.jax_compilation_cache_dir is not None:
+            return  # already configured in-process; preserve user intent
         jax.config.update("jax_compilation_cache_dir", cache)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception:  # pragma: no cover - cache is an optimization only

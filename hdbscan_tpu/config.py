@@ -38,6 +38,31 @@ class HDBSCANParams:
     #: "rs" = simple recursive sampling (cluster the sample points directly,
     #: the paper's RS baseline — quoted-numbers-only in the reference).
     variant: str = "db"
+    #: Harvest exact inter-subset MST "glue" edges with per-level tiled
+    #: Borůvka rounds, and re-weight sample-derived inter-edges with true
+    #: point-space distances (the reference carries the bubble-corrected
+    #: dmreach into the global merge, ``main/Main.java:248-265``, whose
+    #: sample-spacing-scale weights fragment the global tree). Set False for
+    #: reference-faithful edge pooling.
+    exact_inter_edges: bool = True
+    #: Compute core distances GLOBALLY (one tiled O(n^2 d) device pass)
+    #: instead of per-block. Per-block core distances inflate at partition
+    #: boundaries (a point's true neighbors may sit in another block), which
+    #: distorts MRD edge weights and noise exit levels and makes quality
+    #: depend on where the partitioner cut — the reference's dead exact path
+    #: broadcasts the whole dataset for the same reason
+    #: (``mappers/CoreDistanceMapper.java:57-112``). Set False for
+    #: reference-faithful per-subset core distances (``mappers/FirstStep``).
+    global_core_distances: bool = True
+    #: Post-merge refinement rounds for the distributed pipeline: seed tiled
+    #: Borůvka with the condensed tree's leaf clusters (every point's deepest
+    #: cluster), harvest the exact minimum MRD edges between them (true MST
+    #: edges by the cut property), rebuild the tree, repeat. Repairs the
+    #: saddle edges the per-partition pooling carried at slightly-too-heavy
+    #: weights — on lattice-valued data one displaced saddle edge moves a
+    #: whole region into a later merge wave and flips the flat cut. 0
+    #: disables (reference-faithful: the reference never refines).
+    refine_iterations: int = 1
     # Output file names derived from the input path (main/Main.java:516-526):
 
     def __post_init__(self):

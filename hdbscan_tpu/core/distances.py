@@ -24,8 +24,21 @@ METRICS = ("euclidean", "manhattan", "supremum", "cosine", "pearson")
 DEFAULT_METRIC = "euclidean"  # reference default: main/Main.java:419
 
 
+#: Broadcast-element budget for the difference-form Euclidean kernel. The
+#: dot-product expansion ``|x|^2 + |y|^2 - 2xy`` maps onto the MXU but
+#: cancels catastrophically in float32 when points are much closer together
+#: than their norms (error ~1e-7 * |x|^2 swamps small d^2). The difference
+#: form is exact but materializes/streams (n, m, d) elementwise work on the
+#: VPU — cheap for the low-dimensional tile shapes of the tiled scans, too
+#: much for large dense blocks (which parity-test in float64 on host anyway).
+_DIFF_FORM_BUDGET = 1 << 25
+
+
 def _sq_euclidean(x: jax.Array, y: jax.Array) -> jax.Array:
-    """Squared Euclidean distances via the dot-product expansion (MXU-friendly)."""
+    """Squared Euclidean distances; picks the accurate or the MXU form by shape."""
+    if x.shape[0] * y.shape[0] * x.shape[-1] <= _DIFF_FORM_BUDGET:
+        diff = x[:, None, :] - y[None, :, :]
+        return jnp.sum(diff * diff, axis=-1)
     x_sq = jnp.sum(x * x, axis=-1)
     y_sq = jnp.sum(y * y, axis=-1)
     cross = x @ y.T
@@ -88,6 +101,35 @@ def pairwise_distance(x: jax.Array, y: jax.Array, metric: str = DEFAULT_METRIC) 
     except KeyError:
         raise ValueError(f"unknown metric {metric!r}; expected one of {METRICS}") from None
     return fn(x, y)
+
+
+def rowwise_distance_np(a, b, metric: str = DEFAULT_METRIC):
+    """Distance between corresponding rows of two host arrays (numpy path).
+
+    Host-side helper for small edge lists (inter-partition edge re-weighting);
+    semantics match the device kernels above.
+    """
+    import numpy as np
+
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    if metric == "euclidean":
+        return np.sqrt(np.sum((a - b) ** 2, axis=-1))
+    if metric == "manhattan":
+        return np.sum(np.abs(a - b), axis=-1)
+    if metric == "supremum":
+        return np.max(np.abs(a - b), axis=-1)
+    if metric == "cosine":
+        num = np.sum(a * b, axis=-1)
+        den = np.linalg.norm(a, axis=-1) * np.linalg.norm(b, axis=-1)
+        return 1.0 - num / den
+    if metric == "pearson":
+        ac = a - a.mean(axis=-1, keepdims=True)
+        bc = b - b.mean(axis=-1, keepdims=True)
+        num = np.sum(ac * bc, axis=-1)
+        den = np.linalg.norm(ac, axis=-1) * np.linalg.norm(bc, axis=-1)
+        return 1.0 - num / den
+    raise ValueError(f"unknown metric {metric!r}; expected one of {METRICS}")
 
 
 def self_distance_matrix(x: jax.Array, metric: str = DEFAULT_METRIC) -> jax.Array:

@@ -33,11 +33,8 @@ from hdbscan_tpu.models.hdbscan import HDBSCANResult
 from hdbscan_tpu.ops.tiled import BoruvkaScanner, knn_core_distances
 
 
-def _find(parent: np.ndarray, x: int) -> int:
-    while parent[x] != x:
-        parent[x] = parent[parent[x]]
-        x = parent[x]
-    return x
+from hdbscan_tpu.utils.unionfind import find as _find
+from hdbscan_tpu.utils.unionfind import flatten_parents as _flatten_parents
 
 
 def mst_edges(
@@ -91,14 +88,8 @@ def mst_edges(
         n_comp -= added
         # Relabel components for the next device round (vectorized pointer
         # jumping — SURVEY.md §2.C row P9's min-label propagation, host side).
-        p = parent
-        while True:
-            q = p[p]
-            if np.array_equal(q, p):
-                break
-            p = q
-        parent = p
-        comp = p
+        parent = _flatten_parents(parent)
+        comp = parent
         if trace is not None:
             trace("boruvka_round", round=rnd, components=n_comp, edges_added=added)
         if added == 0:
@@ -109,6 +100,131 @@ def mst_edges(
         np.asarray(ew, np.float64),
         core,
     )
+
+
+def pool_mst(
+    u: np.ndarray, v: np.ndarray, w: np.ndarray, n: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Vectorized host Borůvka over an explicit edge pool.
+
+    The ``UnionFindReducer`` merge (``partition/reducers/UnionFindReducer.java:
+    20-70``) re-done without per-edge Python: each round computes every
+    component's minimum incident pool edge with numpy segment operations and
+    unions them all at once — O(E) work per round, <= ceil(log2 n) rounds.
+    Returns the MST (u, v, w) of the pooled multigraph.
+    """
+    u = np.asarray(u, np.int64)
+    v = np.asarray(v, np.int64)
+    w = np.asarray(w, np.float64)
+    comp = np.arange(n, dtype=np.int64)
+    su, sv, sw = [], [], []
+    # Pre-sort edges once by (w, u, v) for deterministic per-component mins.
+    order = np.lexsort((v, u, w))
+    u, v, w = u[order], v[order], w[order]
+    for _ in range(64):
+        cu, cv = comp[u], comp[v]
+        out = cu != cv
+        if not out.any():
+            break
+        eu, ev, ew_, cu_ = u[out], v[out], w[out], cu[out]
+        cv_ = cv[out]
+        # First pool edge (in sorted order) per component, from either side.
+        cc = np.concatenate([cu_, cv_])
+        ee = np.tile(np.arange(len(eu)), 2)
+        ord2 = np.lexsort((ee, cc))
+        cc, ee = cc[ord2], ee[ord2]
+        first = np.concatenate([[True], np.diff(cc) != 0])
+        picks = np.unique(ee[first])
+        # Union the picked edges (loop over <= #components picks).
+        parent = comp.copy()
+        for i_ in picks:
+            ra, rb = _find(parent, int(eu[i_])), _find(parent, int(ev[i_]))
+            if ra == rb:
+                continue
+            parent[rb] = ra
+            su.append(int(eu[i_]))
+            sv.append(int(ev[i_]))
+            sw.append(float(ew_[i_]))
+        comp = _flatten_parents(parent)
+    return np.asarray(su, np.int64), np.asarray(sv, np.int64), np.asarray(sw)
+
+
+def mst_edges_random_blocks(
+    data: np.ndarray,
+    min_pts: int,
+    metric: str = "euclidean",
+    n_parts: int = 8,
+    seed: int = 0,
+    row_tile: int = 1024,
+    col_tile: int = 8192,
+    dtype=np.float32,
+    max_block: int = 8192,
+    trace=None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """The Random Blocks exact method (paper's RB; the reference's dead
+    ``partition/`` + ``UnionFindReducer`` pipeline, SURVEY.md §2.B/§3.5),
+    TPU-blocked.
+
+    1. Global core distances in one tiled pass.
+    2. The dataset is randomly split into ``n_parts`` parts; every PAIR of
+       parts forms a block (so every point pair co-occurs in exactly one
+       block — the property that makes RB exact); each block's MST under
+       global-core mutual reachability is one slice of batched padded device
+       launches.
+    3. The pooled block MSTs are merged with :func:`pool_mst`. Union-of-MSTs
+       over an edge-covering family contains the true MST, so the result is
+       the exact mutual-reachability MST (modulo float32 weight rounding).
+
+    This is the capability path; :func:`mst_edges` (tiled global Borůvka) is
+    the faster way to the same tree.
+    """
+    from hdbscan_tpu.parallel.blocks import (
+        PackedBlocks,
+        _next_pow2,
+        run_packed_blocks,
+    )
+
+    n = len(data)
+    core, _ = knn_core_distances(
+        data, min_pts, metric, row_tile=row_tile, col_tile=col_tile, dtype=dtype
+    )
+    if trace is not None:
+        trace("core_distances", n=n)
+
+    # A pair-block holds ~2n/n_parts points and its dense MRD matrix must fit
+    # HBM: raise n_parts until blocks respect max_block (pow2-padded cap).
+    n_parts = max(n_parts, -(-2 * n // max_block))
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    parts = np.array_split(perm, n_parts)
+    if n_parts == 1:
+        blocks = [parts[0]]
+    else:
+        blocks = [
+            np.concatenate([parts[i], parts[j]])
+            for i in range(n_parts)
+            for j in range(i + 1, n_parts)
+        ]
+    cap = _next_pow2(max(len(b) for b in blocks))
+    b = len(blocks)
+    x = np.zeros((b, cap, data.shape[1]), dtype)
+    cb = np.full((b, cap), np.inf, np.float64)
+    idx = np.full((b, cap), -1, np.int64)
+    nv = np.zeros(b, np.int32)
+    for i, ids in enumerate(blocks):
+        x[i, : len(ids)] = data[ids]
+        cb[i, : len(ids)] = core[ids]
+        idx[i, : len(ids)] = ids
+        nv[i] = len(ids)
+    packed = PackedBlocks(
+        x=x, num_valid=nv, point_index=idx, subset_ids=np.arange(b), core=cb
+    )
+    eu, ev, ew, _ = run_packed_blocks(packed, min_pts, metric)
+    if trace is not None:
+        trace("block_msts", edges=len(eu), blocks=b)
+
+    ku, kv, kw = pool_mst(eu, ev, ew, n)
+    return ku, kv, kw, core
 
 
 def fit(

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass, field
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -83,6 +84,9 @@ class MRHDBSCANResult:
     n_levels: int
     n_edges: int
     levels: list = field(default_factory=list)
+    #: (u, v, w) pooled edge set, kept when fit(keep_edge_pool=True) —
+    #: for diagnostics and tests of the distributed merge.
+    edge_pool: tuple | None = None
 
 
 def _group_by_subset(subset_ids: np.ndarray, active: np.ndarray) -> list[np.ndarray]:
@@ -145,6 +149,29 @@ def _forced_split_groups(
     return groups
 
 
+@partial(jax.jit, static_argnames=("min_pts", "metric"))
+def _rs_device_block(x, num_valid, min_pts: int, metric: str):
+    """Fused RS sample program: distances -> core -> MRD -> Borůvka.
+
+    Padded (s_pad, d) input for compile reuse; returns the distance matrix
+    (kept device-resident for the follow-up reassign call) plus the packed
+    single-leaf fetch [u, v, w, mask | core] and the device edge arrays.
+    """
+    from hdbscan_tpu.core.distances import self_distance_matrix
+    from hdbscan_tpu.core.knn import core_distances_from_matrix, mutual_reachability
+    from hdbscan_tpu.core.mst import boruvka_mst
+
+    m = x.shape[0]
+    valid = jnp.arange(m, dtype=jnp.int32) < num_valid
+    dist = self_distance_matrix(x, metric)
+    core = core_distances_from_matrix(dist, min_pts, valid)
+    mrd = mutual_reachability(dist, core)
+    u, v, w, mask, _ = boruvka_mst(mrd, num_valid)
+    dt = w.dtype
+    packed = jnp.concatenate([u.astype(dt), v.astype(dt), w, mask.astype(dt), core])
+    return dist, u, v, mask, packed
+
+
 def _fit_samples_rs(
     samp_data: np.ndarray,
     min_pts: int,
@@ -162,36 +189,36 @@ def _fit_samples_rs(
     Returns (labels, (u, v, w), (iu, iv, iw)) in local sample indices: flat
     labels, the sample MST edges, and the cross-cluster edge subset.
     """
-    from hdbscan_tpu.core.bubbles import (
-        inter_cluster_edge_mask,
-        reassign_noise_bubbles,
-    )
-    from hdbscan_tpu.core.distances import self_distance_matrix
-    from hdbscan_tpu.parallel.blocks import block_mst_batch
+    from hdbscan_tpu.models.bubble_hdbscan import _bubble_reassign_block
 
     s = len(samp_data)
     s_pad = max(128, _next_pow2(s))
-    x = np.zeros((1, s_pad, samp_data.shape[1]), np.float64)
-    x[0, :s] = samp_data
-    u, v, w, mask, core = jax.device_get(
-        block_mst_batch(jnp.asarray(x), jnp.asarray([s], jnp.int32), min_pts, metric)
+    x = np.zeros((s_pad, samp_data.shape[1]), np.float64)
+    x[:s] = samp_data
+    dist, u_d, v_d, mask_d, packed_d = _rs_device_block(
+        jnp.asarray(x), jnp.int32(s), min_pts, metric
     )
-    m = np.asarray(mask[0])
-    u = np.asarray(u[0], np.int64)[m]
-    v = np.asarray(v[0], np.int64)[m]
-    w = np.asarray(w[0], np.float64)[m]
-    core_h = np.asarray(core[0], np.float64)[:s]
+    packed = jax.device_get(packed_d)
+    e = s_pad - 1
+    u_p = packed[:e].astype(np.int64)
+    v_p = packed[e : 2 * e].astype(np.int64)
+    w_p = packed[2 * e : 3 * e].astype(np.float64)
+    mask = packed[3 * e : 4 * e] != 0
+    core_h = packed[4 * e :].astype(np.float64)[:s]
+    u, v, w = u_p[mask], v_p[mask], w_p[mask]
 
     _, labels = tree_mod.extract_clusters(
         s, u, v, w, min_cluster_size, self_levels=core_h
     )
-    dist = self_distance_matrix(jnp.asarray(samp_data), metric)
-    labels = np.asarray(
-        reassign_noise_bubbles(dist, jnp.asarray(labels)), np.int64
+    labels_p = np.zeros(s_pad, np.int32)
+    labels_p[:s] = labels
+    out = jax.device_get(
+        _bubble_reassign_block(
+            dist, jnp.asarray(labels_p), u_d, v_d, mask_d, jnp.int32(s)
+        )
     )
-    cross = np.asarray(
-        inter_cluster_edge_mask(jnp.asarray(u), jnp.asarray(v), jnp.asarray(labels))
-    )
+    labels = np.asarray(out[:s_pad].round(), np.int64)[:s]
+    cross = (out[s_pad:] != 0)[mask]
     return labels, (u, v, w), (u[cross], v[cross], w[cross])
 
 
@@ -200,10 +227,19 @@ def fit(
     params: HDBSCANParams | None = None,
     mesh=None,
     max_levels: int = 64,
+    checkpoint_dir: str | None = None,
+    trace=None,
+    keep_edge_pool: bool = False,
 ) -> MRHDBSCANResult:
     """Run the full MR-HDBSCAN* pipeline on one host.
 
     ``mesh``: optional device mesh; small-subset blocks shard across it.
+    ``checkpoint_dir``: when set, the complete driver state is written there
+    after every level (the explicit analog of the reference's per-level HDFS
+    object files, SURVEY.md §5.4) and the newest matching checkpoint is
+    resumed from automatically.
+    ``trace``: optional callable/:class:`~hdbscan_tpu.utils.tracing.Tracer`
+    receiving per-stage events.
     """
     import time
 
@@ -219,15 +255,43 @@ def fit(
     subset = np.zeros(n, np.int64)
     processed = np.zeros(n, bool)
     core = np.full(n, np.inf)
+    global_core = params.global_core_distances
     pool_u: list[np.ndarray] = []
     pool_v: list[np.ndarray] = []
     pool_w: list[np.ndarray] = []
     level_stats: list[LevelStats] = []
+    start_level = 0
+    resumed = False
+    if checkpoint_dir is not None:
+        from hdbscan_tpu.utils import checkpoint as ckpt_mod
+
+        state = ckpt_mod.load_latest(checkpoint_dir, params, n)
+        if state is not None:
+            resumed = True
+            start_level = state["level"] + 1
+            subset = state["subset"]
+            processed = state["processed"]
+            core = state["core"]
+            pool_u = [state["pool_u"]]
+            pool_v = [state["pool_v"]]
+            pool_w = [state["pool_w"]]
+            rng.bit_generator.state = state["rng_state"]
+            level_stats = [LevelStats(**s) for s in state["level_stats"]]
+            if trace is not None:
+                trace("resume_from_checkpoint", level=state["level"])
+    if global_core and not resumed:
+        # One tiled pass over the whole dataset (config.global_core_distances):
+        # every downstream MRD weight — block MSTs, glue edges, self-edge
+        # noise levels — uses the point's TRUE density, not its block's.
+        # A resumed run restores the same array from the checkpoint instead.
+        from hdbscan_tpu.ops.tiled import knn_core_distances
+
+        core, _ = knn_core_distances(data, params.min_points, metric)
     n_dev = 1
     if mesh is not None:
         n_dev = math.prod(mesh.devices.shape)
 
-    for level in range(max_levels):
+    for level in range(start_level, max_levels):
         if processed.all():
             break
         t0 = time.monotonic()
@@ -240,6 +304,30 @@ def fit(
         n_inter = 0
         forced = 0
 
+        if params.exact_inter_edges and len(groups) >= 2:
+            # Per-level glue harvest: Borůvka rounds at point granularity,
+            # seeded with the current subsets, run to connectivity — every
+            # harvested edge is a true MST edge of the active set (cut
+            # property), so the inter-subset tree structure is exact. Sample-
+            # based inter-edges alone leave block seams whose weights are at
+            # the sample-spacing scale — far above the intra-block mutual-
+            # reachability scale in dense regions — which fragments the
+            # global hierarchy (plain distance here = a lower bound of the
+            # MRD weight; see config.exact_inter_edges).
+            from hdbscan_tpu.ops.tiled import boruvka_glue_edges
+
+            act = np.nonzero(~processed)[0]
+            gu_l, gv_l, gw_l = boruvka_glue_edges(
+                data[act],
+                subset[act],
+                metric,
+                core=core[act] if global_core else None,
+            )
+            pool_u.append(act[gu_l])
+            pool_v.append(act[gv_l])
+            pool_w.append(gw_l)
+            n_inter += len(gu_l)
+
         if small:
             # Bucket subsets by pow2 size class (SURVEY.md §7 "hard parts"):
             # a 100-point subset must not pay for a capacity-sized matrix, and
@@ -250,15 +338,18 @@ def fit(
                 buckets.setdefault(max(min_bucket, _next_pow2(len(g))), []).append(g)
             for cap_b in sorted(buckets):
                 group = buckets[cap_b]
-                packed = pack_blocks(data, group, cap_b)
+                packed = pack_blocks(
+                    data, group, cap_b, core=core if global_core else None
+                )
                 u, v, w, core_b = run_packed_blocks(
                     packed, params.min_points, metric, mesh=mesh, batch_pad=n_dev
                 )
                 pool_u.append(u)
                 pool_v.append(v)
                 pool_w.append(w)
-                for i, ids in enumerate(group):
-                    core[ids] = core_b[i, : len(ids)]
+                if not global_core:
+                    for i, ids in enumerate(group):
+                        core[ids] = core_b[i, : len(ids)]
             done = np.concatenate(small)
             processed[done] = True
             n_proc = len(done)
@@ -327,8 +418,20 @@ def fit(
 
             # Inter-group bubble MST edges -> global candidate edges between
             # the groups' sample points (main/Main.java:248-265 analog).
-            pool_u.append(samples_global[iu])
-            pool_v.append(samples_global[iv])
+            su, sv = samples_global[iu], samples_global[iv]
+            if params.exact_inter_edges and len(iu):
+                # Replace the bubble-corrected weight with the true point-space
+                # distance between the sample endpoints (config flag docs),
+                # clamped to mutual reachability when global cores are known —
+                # a merge below both endpoints' core distances cannot occur in
+                # a true HDBSCAN* hierarchy.
+                from hdbscan_tpu.core.distances import rowwise_distance_np
+
+                iw = rowwise_distance_np(data[su], data[sv], metric)
+                if global_core:
+                    iw = np.maximum(iw, np.maximum(core[su], core[sv]))
+            pool_u.append(su)
+            pool_v.append(sv)
             pool_w.append(iw)
             n_inter += len(iu)
 
@@ -337,19 +440,42 @@ def fit(
             subset[ids] = next_id + bubble_groups[assign]
             next_id += int(bubble_groups.max()) + 1
 
-        level_stats.append(
-            LevelStats(
-                level=level,
-                n_active=n_active,
-                n_small_subsets=len(small),
-                n_large_subsets=len(large),
-                n_processed=n_proc,
-                n_bubbles=n_bub,
-                n_inter_edges=n_inter,
-                forced_splits=forced,
-                wall_s=time.monotonic() - t0,
-            )
+        stats = LevelStats(
+            level=level,
+            n_active=n_active,
+            n_small_subsets=len(small),
+            n_large_subsets=len(large),
+            n_processed=n_proc,
+            n_bubbles=n_bub,
+            n_inter_edges=n_inter,
+            forced_splits=forced,
+            wall_s=time.monotonic() - t0,
         )
+        level_stats.append(stats)
+        if trace is not None:
+            trace("level", **{k: getattr(stats, k) for k in stats.__dataclass_fields__})
+        if checkpoint_dir is not None:
+            from dataclasses import asdict
+
+            from hdbscan_tpu.utils import checkpoint as ckpt_mod
+
+            cu = np.concatenate(pool_u) if pool_u else np.zeros(0, np.int64)
+            cv = np.concatenate(pool_v) if pool_v else np.zeros(0, np.int64)
+            cw = np.concatenate(pool_w) if pool_w else np.zeros(0, np.float64)
+            pool_u, pool_v, pool_w = [cu], [cv], [cw]
+            ckpt_mod.save_level(
+                checkpoint_dir,
+                level,
+                params,
+                subset,
+                processed,
+                core,
+                cu,
+                cv,
+                cw,
+                rng.bit_generator.state,
+                [asdict(s) for s in level_stats],
+            )
     else:
         if not processed.all():
             raise RuntimeError(
@@ -366,6 +492,34 @@ def fit(
     from hdbscan_tpu.models._finalize import finalize_clustering
 
     tree, labels, scores, infinite = finalize_clustering(n, u, v, w, core, params)
+
+    # Refinement (config.refine_iterations): harvest the exact minimum MRD
+    # edges between the tree's leaf clusters and rebuild. Each harvested edge
+    # is a true MST edge (cut property), so iterating monotonically lowers
+    # the pooled spanning weight toward the exact MST — repairing saddle
+    # edges whose slightly-too-heavy pooled weights fragment the flat cut.
+    if params.exact_inter_edges:
+        from hdbscan_tpu.ops.tiled import boruvka_glue_edges
+
+        for _ in range(params.refine_iterations):
+            t0 = time.monotonic()
+            groups_r = tree.point_last_cluster
+            if len(np.unique(groups_r)) < 2:
+                break
+            ru, rv, rw = boruvka_glue_edges(
+                data, groups_r, metric, core=core if global_core else None
+            )
+            if len(ru) == 0:
+                break
+            u = np.concatenate([u, ru])
+            v = np.concatenate([v, rv])
+            w = np.concatenate([w, rw])
+            tree, labels, scores, infinite = finalize_clustering(
+                n, u, v, w, core, params
+            )
+            if trace is not None:
+                trace("refine", new_edges=len(ru), wall_s=round(time.monotonic() - t0, 3))
+
     return MRHDBSCANResult(
         labels=labels,
         tree=tree,
@@ -375,4 +529,5 @@ def fit(
         n_levels=len(level_stats),
         n_edges=len(u),
         levels=level_stats,
+        edge_pool=(u, v, w) if keep_edge_pool else None,
     )

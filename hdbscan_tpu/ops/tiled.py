@@ -56,11 +56,13 @@ def _tile_sizes(n: int, row_tile: int, col_tile: int) -> tuple[int, int, int]:
     column tile, so padding to one column tile suffices — padding to
     lcm(row, col) for arbitrary sizes can blow n_pad up by orders of
     magnitude. Minimums respect TPU layout (8 sublanes x 128 lanes).
+    n_pad itself is a power of two so repeated calls on shrinking datasets
+    (the per-level glue harvest) reuse a handful of compiled shapes.
     """
     row_tile = _next_pow2(max(8, min(row_tile, n)))
     col_tile = _next_pow2(max(128, min(col_tile, n)))
     col_tile = max(col_tile, row_tile)
-    return row_tile, col_tile, _round_up(n, col_tile)
+    return row_tile, col_tile, _next_pow2(_round_up(n, col_tile))
 
 
 @partial(jax.jit, static_argnames=("k", "metric", "row_tile", "col_tile"))
@@ -177,6 +179,93 @@ def _min_outgoing_scan(
     n_row_tiles = n_pad // row_tile
     bw, bj = jax.lax.map(row_step, jnp.arange(n_row_tiles))
     return bw.reshape(n_pad), bj.reshape(n_pad)
+
+
+def boruvka_glue_edges(
+    data: np.ndarray,
+    groups: np.ndarray,
+    metric: str = "euclidean",
+    core: np.ndarray | None = None,
+    row_tile: int = 1024,
+    col_tile: int = 8192,
+    dtype=np.float32,
+    max_rounds: int = 64,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Exact inter-group MST "glue" edges — Borůvka rounds to connectivity.
+
+    Starting from ``groups`` as initial components, repeat: every component
+    finds its minimum outgoing edge with one tiled scan (distances recomputed
+    on the MXU, never stored), components union-merge — until one component
+    remains. By the MST cut property every harvested edge belongs to the MST
+    of ``data`` under the used weight, so the returned edge set contains the
+    complete inter-group portion of that MST (<= #groups - 1 edges, ceil(log2
+    #groups) scans). The distributed driver uses this as the per-level glue
+    between subsets: sample-based inter-edges alone leave block seams whose
+    weights sit at the sample-spacing scale — far above the intra-block
+    mutual-reachability scale in dense regions — which fragments the global
+    hierarchy and makes quality seed-dependent.
+
+    ``core``: optional per-point core distances for mutual-reachability
+    weights; None = plain distance (a lower bound of the MRD weight).
+
+    Returns (u, v, w) in LOCAL indices of ``data``, deterministically
+    tie-broken by (w, u, v).
+    """
+    from hdbscan_tpu.utils.unionfind import find as _uf_find
+    from hdbscan_tpu.utils.unionfind import flatten_parents as _flatten
+
+    n = len(data)
+    if core is None:
+        core = np.zeros(n)
+    comp = np.unique(np.asarray(groups, np.int64), return_inverse=True)[1]
+    if comp.max() == 0:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64), np.zeros(0, np.float64)
+    scanner = BoruvkaScanner(
+        data, core, metric, row_tile=row_tile, col_tile=col_tile, dtype=dtype
+    )
+    parent = np.arange(n, dtype=np.int64)
+
+    def find(x: int) -> int:
+        return _uf_find(parent, x)
+
+    # Seed union-find with the initial groups (first member = representative).
+    # comp is dense 0..G-1, so comp[order0][firsts] == arange(G) and
+    # reps[g] is group g's first point; every point then points at its rep.
+    order0 = np.argsort(comp, kind="stable")
+    firsts = np.concatenate([[True], np.diff(comp[order0]) != 0])
+    reps = order0[firsts]
+    parent = reps[comp].copy()
+
+    eu, ev, ew = [], [], []
+    for _ in range(max_rounds):
+        labels = _flatten(parent)
+        if len(np.unique(labels)) <= 1:
+            break
+        bw, bj = scanner.min_outgoing(labels)
+        has = bj >= 0
+        if not has.any():
+            break
+        ids = np.nonzero(has)[0]
+        sel = np.lexsort((bj[ids], ids, bw[ids]))
+        ids = ids[sel]
+        _, first = np.unique(labels[ids], return_index=True)
+        added = 0
+        for i_ in ids[first]:
+            ra, rb = find(int(i_)), find(int(bj[i_]))
+            if ra == rb:
+                continue
+            parent[rb] = ra
+            eu.append(int(i_))
+            ev.append(int(bj[i_]))
+            ew.append(float(bw[i_]))
+            added += 1
+        if added == 0:
+            break
+    return (
+        np.asarray(eu, np.int64),
+        np.asarray(ev, np.int64),
+        np.asarray(ew, np.float64),
+    )
 
 
 class BoruvkaScanner:

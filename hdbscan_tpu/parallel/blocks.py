@@ -68,13 +68,59 @@ def block_mst_batch_packed(x: jax.Array, num_valid: jax.Array, min_pts: int, met
 
 def unpack_block_mst(packed: np.ndarray, cap: int):
     """Host-side split of :func:`block_mst_batch_packed` output."""
+    u, v, w, mask = unpack_block_mst_edges(packed, cap)
+    core = packed[:, 4 * (cap - 1) :].astype(np.float64)
+    return u, v, w, mask, core
+
+
+def unpack_block_mst_edges(packed: np.ndarray, cap: int):
+    """Host-side split of the [u, v, w, mask] packed edge columns."""
     e = cap - 1
     u = packed[:, :e].astype(np.int64)
     v = packed[:, e : 2 * e].astype(np.int64)
     w = packed[:, 2 * e : 3 * e].astype(np.float64)
     mask = packed[:, 3 * e : 4 * e] != 0
-    core = packed[:, 4 * e :].astype(np.float64)
-    return u, v, w, mask, core
+    return u, v, w, mask
+
+
+@partial(jax.jit, static_argnames=("metric",))
+def block_mst_batch_with_core(
+    x: jax.Array, core: jax.Array, num_valid: jax.Array, metric: str
+):
+    """Per-block Borůvka MST under PRE-COMPUTED (global) core distances.
+
+    The random-blocks merge path (``partition/reducers/UnionFindReducer.java``
+    capability; ``mappers/CoreDistanceMapper.java`` broadcasts the whole
+    dataset for exactly this reason): blocks see only their own points, but
+    mutual reachability uses core distances computed over the whole dataset
+    (one tiled pass, ``ops.tiled.knn_core_distances``), so pooled block edges
+    are globally meaningful — per-block local core distances inflate at block
+    boundaries, which distorts the merged hierarchy and makes quality depend
+    on where the partitioner happened to cut.
+    Returns (u, v, w, mask) per block in local indices.
+    """
+
+    def one(xb, cb, nv):
+        cap = xb.shape[0]
+        valid = jnp.arange(cap, dtype=jnp.int32) < nv
+        dist = self_distance_matrix(xb, metric)
+        dist = jnp.where(valid[None, :] & valid[:, None], dist, jnp.inf)
+        mrd = mutual_reachability(dist, cb)
+        u, v, w, mask, _ = boruvka_mst(mrd, nv)
+        return u, v, w, mask
+
+    return jax.vmap(one)(x, core, num_valid)
+
+
+@partial(jax.jit, static_argnames=("metric",))
+def block_mst_batch_with_core_packed(
+    x: jax.Array, core: jax.Array, num_valid: jax.Array, metric: str
+):
+    """:func:`block_mst_batch_with_core`, outputs packed into ONE (B, 4*(cap-1))
+    array ([u, v, w, mask] in w's dtype) — single-leaf fetch over the tunnel."""
+    u, v, w, mask = block_mst_batch_with_core(x, core, num_valid, metric)
+    dt = w.dtype
+    return jnp.concatenate([u.astype(dt), v.astype(dt), w, mask.astype(dt)], axis=1)
 
 
 @partial(jax.jit, static_argnames=("metric",))
@@ -148,21 +194,29 @@ class PackedBlocks:
     num_valid: np.ndarray  # (B,) int32
     point_index: np.ndarray  # (B, cap) global point id per slot (-1 padding)
     subset_ids: np.ndarray  # (B,) the subset each block came from
+    core: np.ndarray | None = None  # (B, cap) precomputed global core distances
 
 
 def pack_blocks(
-    data: np.ndarray, point_ids_per_subset: list[np.ndarray], capacity: int
+    data: np.ndarray,
+    point_ids_per_subset: list[np.ndarray],
+    capacity: int,
+    core: np.ndarray | None = None,
 ) -> PackedBlocks:
     """Pack per-subset point-id lists into padded device blocks.
 
     Every subset must fit ``capacity`` (the driver routes only small subsets
     here — ``processing_units`` semantics, ``mappers/FirstStep.java:68``).
+    ``core``: optional per-point (global) core distances to pack alongside.
     """
     b = len(point_ids_per_subset)
     d = data.shape[1]
     x = np.zeros((b, capacity, d), data.dtype)
     num_valid = np.zeros(b, np.int32)
     point_index = np.full((b, capacity), -1, np.int64)
+    core_b = None
+    if core is not None:
+        core_b = np.full((b, capacity), np.inf, np.float64)
     for i, ids in enumerate(point_ids_per_subset):
         k = len(ids)
         if k > capacity:
@@ -170,11 +224,14 @@ def pack_blocks(
         x[i, :k] = data[ids]
         num_valid[i] = k
         point_index[i, :k] = ids
+        if core_b is not None:
+            core_b[i, :k] = core[ids]
     return PackedBlocks(
         x=x,
         num_valid=num_valid,
         point_index=point_index,
         subset_ids=np.arange(b),
+        core=core_b,
     )
 
 
@@ -227,13 +284,20 @@ def run_packed_blocks(
     core = np.empty((b, cap), np.float64)
     gu, gv, gw = [], [], []
 
+    with_core = packed.core is not None
+    if with_core:
+        core[:] = packed.core
+
     def drain(pending):
         # One batched fetch of one packed leaf per launch (each fetched leaf
         # pays a full host<->device round trip over the tunnel).
         fetched = jax.device_get([p[2] for p in pending])
         for (start, real, _), pk in zip(pending, fetched):
-            u, v, w, mask, core_c = unpack_block_mst(pk, cap)
-            core[start : start + real] = core_c[:real]
+            if with_core:
+                u, v, w, mask = unpack_block_mst_edges(pk, cap)
+            else:
+                u, v, w, mask, core_c = unpack_block_mst(pk, cap)
+                core[start : start + real] = core_c[:real]
             for i in range(real):
                 m = mask[i]
                 ids = packed.point_index[start + i]
@@ -253,11 +317,24 @@ def run_packed_blocks(
         if real != chunk:  # pad every launch to the same shape: one compile
             x = np.concatenate([x, np.zeros((chunk - real, *x.shape[1:]), x.dtype)])
             nv = np.concatenate([nv, np.zeros(chunk - real, nv.dtype)])
-        if sh is not None:
-            xj, nvj = jax.device_put((x, nv), (sh, sh))
+        if with_core:
+            cb = packed.core[start : start + chunk]
+            if len(cb) != chunk:
+                cb = np.concatenate([cb, np.full((chunk - len(cb), cap), np.inf)])
+            if sh is not None:
+                xj, cj, nvj = jax.device_put(
+                    (x, cb.astype(x.dtype), nv), (sh, sh, sh)
+                )
+            else:
+                xj, cj, nvj = jax.device_put((x, cb.astype(x.dtype), nv))
+            out = block_mst_batch_with_core_packed(xj, cj, nvj, metric)
         else:
-            xj, nvj = jax.device_put((x, nv))
-        pending.append((start, real, block_mst_batch_packed(xj, nvj, min_pts, metric)))
+            if sh is not None:
+                xj, nvj = jax.device_put((x, nv), (sh, sh))
+            else:
+                xj, nvj = jax.device_put((x, nv))
+            out = block_mst_batch_packed(xj, nvj, min_pts, metric)
+        pending.append((start, real, out))
         if len(pending) >= max_inflight:
             drain(pending)
             pending = []

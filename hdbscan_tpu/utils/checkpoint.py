@@ -1,0 +1,115 @@
+"""Per-level checkpoint/resume for the distributed pipeline.
+
+The reference checkpoints implicitly: every stage boundary is a
+``saveAsObjectFile`` to HDFS (``_unprocessed_<i>``, ``_local_mst<i>``, ... —
+``main/Main.java:101,199,230,238,265,298``; SURVEY.md §5.4), so a crashed
+driver can re-run from the last level's files. Here that capability is
+explicit and compact: one ``.npz`` per completed level holding the entire
+driver state (subset assignment, processed mask, core distances, pooled MST
+edges, RNG state), written atomically; ``load_latest`` resumes from the
+newest level whose parameter fingerprint matches.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+import numpy as np
+
+_PREFIX = "mr_level_"
+
+
+def _fingerprint(params, n: int) -> dict:
+    """The parameters that must match for a checkpoint to be resumable."""
+    return {
+        "n": int(n),
+        "min_points": params.min_points,
+        "min_cluster_size": params.min_cluster_size,
+        "processing_units": params.processing_units,
+        "k": params.k,
+        "dist_function": params.dist_function,
+        "variant": params.variant,
+        "seed": params.seed,
+        "exact_inter_edges": params.exact_inter_edges,
+        "global_core_distances": params.global_core_distances,
+    }
+
+
+def save_level(
+    ckpt_dir: str,
+    level: int,
+    params,
+    subset: np.ndarray,
+    processed: np.ndarray,
+    core: np.ndarray,
+    pool_u: np.ndarray,
+    pool_v: np.ndarray,
+    pool_w: np.ndarray,
+    rng_state: dict,
+    level_stats: list[dict],
+) -> str:
+    """Write the post-level driver state; atomic via rename."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    meta = {
+        "level": level,
+        "fingerprint": _fingerprint(params, len(subset)),
+        "rng_state": rng_state,
+        "level_stats": level_stats,
+    }
+    path = os.path.join(ckpt_dir, f"{_PREFIX}{level:04d}.npz")
+    fd, tmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".tmp")
+    os.close(fd)
+    try:
+        with open(tmp, "wb") as f:
+            np.savez_compressed(
+                f,
+                meta=np.frombuffer(json.dumps(meta).encode(), np.uint8),
+                subset=subset,
+                processed=processed,
+                core=core,
+                pool_u=pool_u,
+                pool_v=pool_v,
+                pool_w=pool_w,
+            )
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return path
+
+
+def load_latest(ckpt_dir: str, params, n: int) -> dict | None:
+    """Newest matching checkpoint as a dict, or None.
+
+    A checkpoint with a different parameter fingerprint raises — resuming a
+    different configuration silently would corrupt results.
+    """
+    if not os.path.isdir(ckpt_dir):
+        return None
+    files = sorted(
+        f for f in os.listdir(ckpt_dir) if f.startswith(_PREFIX) and f.endswith(".npz")
+    )
+    if not files:
+        return None
+    path = os.path.join(ckpt_dir, files[-1])
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["meta"]).decode())
+        want = _fingerprint(params, n)
+        if meta["fingerprint"] != want:
+            raise ValueError(
+                f"checkpoint {path} was written for {meta['fingerprint']}, "
+                f"current run is {want}; pass a fresh checkpoint_dir"
+            )
+        return {
+            "level": meta["level"],
+            "rng_state": meta["rng_state"],
+            "level_stats": meta["level_stats"],
+            "subset": z["subset"],
+            "processed": z["processed"],
+            "core": z["core"],
+            "pool_u": z["pool_u"],
+            "pool_v": z["pool_v"],
+            "pool_w": z["pool_w"],
+        }

@@ -1,0 +1,95 @@
+"""Structured per-stage tracing — the observability layer the reference lacks.
+
+The reference's only progress reporting is ``System.out.println`` of iteration
+numbers and HDFS file names, partly in Portuguese (``main/Main.java:108,200,
+232-233,316,383``; SURVEY.md §5.1). Here every pipeline stage can emit a
+structured event (name, wall seconds, counters) through a :class:`Tracer`,
+which the CLI/bench can print as logfmt lines or aggregate; an optional
+``jax.profiler`` context captures full XLA traces for TensorBoard.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TraceEvent:
+    name: str
+    wall_s: float  # 0.0 for instant events
+    fields: dict = field(default_factory=dict)
+
+    def format(self) -> str:
+        parts = [f"stage={self.name}", f"wall_s={self.wall_s:.3f}"]
+        parts += [f"{k}={v}" for k, v in self.fields.items()]
+        return " ".join(parts)
+
+
+class Tracer:
+    """Collects :class:`TraceEvent` records; optionally streams them.
+
+    Pass an instance anywhere a ``trace`` hook is accepted
+    (``models.exact.fit``, ``models.mr_hdbscan.fit``); calling it records an
+    instant event, ``stage()`` wraps a timed block.
+
+    Args:
+      stream: file-like; events print as logfmt lines as they happen
+        (``sys.stderr`` for live progress). None = collect only.
+    """
+
+    def __init__(self, stream=None):
+        self.events: list[TraceEvent] = []
+        self._stream = stream
+
+    def __call__(self, name: str, **fields) -> None:
+        self._emit(TraceEvent(name, 0.0, fields))
+
+    @contextmanager
+    def stage(self, name: str, **fields):
+        t0 = time.monotonic()
+        try:
+            yield self
+        finally:
+            self._emit(TraceEvent(name, time.monotonic() - t0, fields))
+
+    def _emit(self, ev: TraceEvent) -> None:
+        self.events.append(ev)
+        if self._stream is not None:
+            print(ev.format(), file=self._stream, flush=True)
+
+    def total(self, name: str) -> float:
+        """Summed wall seconds of all events with this stage name."""
+        return sum(e.wall_s for e in self.events if e.name == name)
+
+    def summary(self) -> str:
+        """One line per distinct stage: count and summed wall."""
+        agg: dict[str, list] = {}
+        for e in self.events:
+            agg.setdefault(e.name, [0, 0.0])
+            agg[e.name][0] += 1
+            agg[e.name][1] += e.wall_s
+        return "\n".join(
+            f"{name}: n={n} wall_s={w:.3f}" for name, (n, w) in agg.items()
+        )
+
+
+def stderr_tracer() -> Tracer:
+    """Tracer that live-streams logfmt lines to stderr."""
+    return Tracer(stream=sys.stderr)
+
+
+@contextmanager
+def xla_profile(logdir: str):
+    """Capture a ``jax.profiler`` trace (TensorBoard format) around a block.
+
+    The TPU-native replacement for the reference's nonexistent profiling
+    (SURVEY.md §5.1): wraps ``jax.profiler.trace``; view with TensorBoard's
+    profile plugin.
+    """
+    import jax
+
+    with jax.profiler.trace(logdir):
+        yield

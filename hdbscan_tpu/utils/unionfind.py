@@ -1,0 +1,32 @@
+"""Shared host-side union-find primitives.
+
+The distributed merge layers (tiled Borůvka, glue harvest, pooled-edge MST,
+merge forest) all union components between device rounds; these helpers are
+the single implementation (SURVEY.md §2.C row P9's host side).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def find(parent: np.ndarray, x: int) -> int:
+    """Path-halving find."""
+    while parent[x] != x:
+        parent[x] = parent[parent[x]]
+        x = parent[x]
+    return x
+
+
+def flatten_parents(parent: np.ndarray) -> np.ndarray:
+    """Vectorized full path compression: pointer jumping to fixpoint.
+
+    Returns an array where every entry points directly at its root — the
+    component relabeling fed back to the device between Borůvka rounds.
+    """
+    p = parent
+    while True:
+        q = p[p]
+        if np.array_equal(q, p):
+            return q
+        p = q

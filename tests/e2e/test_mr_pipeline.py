@@ -118,13 +118,17 @@ class TestRSVariant:
 
 
 class TestForcedSplit:
-    def test_single_gaussian_terminates(self, rng):
+    def test_single_gaussian_terminates_and_tracks_exact(self, rng):
         """One dense blob: bubble model finds a single cluster every level —
-        the forced-split guard must terminate the recursion."""
+        the forced-split guard must terminate the recursion, and the per-level
+        glue harvest (exact_inter_edges) must keep the distributed tree close
+        to the exact tree (which itself fragments a gaussian into micro-
+        clusters at minClSize=10 — the old sample-distance-only glue
+        artificially merged forced-split chunks instead)."""
         pts = rng.normal(size=(700, 2))
         params = HDBSCANParams(min_points=4, min_cluster_size=10, processing_units=100, k=0.1)
         mr = mr_hdbscan.fit(pts, params)
         assert len(mr.labels) == 700
-        # most of one gaussian should stay one cluster
-        vals, counts = np.unique(mr.labels[mr.labels > 0], return_counts=True)
-        assert counts.max() > 350
+        exact = hdbscan.fit(pts, params.replace(processing_units=1000))
+        ari = adjusted_rand_index(mr.labels, exact.labels)
+        assert ari > 0.2, f"ARI vs exact too low: {ari}"

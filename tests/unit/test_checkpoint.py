@@ -1,0 +1,77 @@
+"""Checkpoint/resume of the distributed pipeline (SURVEY.md §5.4 capability)."""
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.config import HDBSCANParams
+from hdbscan_tpu.models import mr_hdbscan
+from hdbscan_tpu.utils import checkpoint as ckpt_mod
+from hdbscan_tpu.utils.tracing import Tracer
+from tests.conftest import make_blobs
+
+
+@pytest.fixture
+def blobs(rng):
+    return make_blobs(rng, n=900, d=3, centers=3, spread=0.1)
+
+
+PARAMS = dict(min_points=4, min_cluster_size=8, processing_units=150, k=0.15, seed=5)
+
+
+class TestCheckpointResume:
+    def test_resume_after_interrupt_matches_uninterrupted(self, blobs, tmp_path):
+        pts, _ = blobs
+        params = HDBSCANParams(**PARAMS)
+        full = mr_hdbscan.fit(pts, params)
+        assert full.n_levels >= 2
+
+        ckpt = str(tmp_path / "ckpt")
+        # Interrupt: allow only the first level, checkpoint it, then die.
+        with pytest.raises(RuntimeError):
+            mr_hdbscan.fit(pts, params, max_levels=1, checkpoint_dir=ckpt)
+        # Resume to completion; labels must match the uninterrupted run.
+        tracer = Tracer()
+        resumed = mr_hdbscan.fit(pts, params, checkpoint_dir=ckpt, trace=tracer)
+        np.testing.assert_array_equal(resumed.labels, full.labels)
+        assert resumed.n_levels == full.n_levels
+        assert any(e.name == "resume_from_checkpoint" for e in tracer.events)
+
+    def test_completed_checkpoint_resumes_to_same_result(self, blobs, tmp_path):
+        pts, _ = blobs
+        params = HDBSCANParams(**PARAMS)
+        ckpt = str(tmp_path / "ckpt")
+        a = mr_hdbscan.fit(pts, params, checkpoint_dir=ckpt)
+        b = mr_hdbscan.fit(pts, params, checkpoint_dir=ckpt)  # all levels cached
+        np.testing.assert_array_equal(a.labels, b.labels)
+
+    def test_fingerprint_mismatch_raises(self, blobs, tmp_path):
+        pts, _ = blobs
+        params = HDBSCANParams(**PARAMS)
+        ckpt = str(tmp_path / "ckpt")
+        mr_hdbscan.fit(pts, params, checkpoint_dir=ckpt)
+        other = params.replace(min_points=7)
+        with pytest.raises(ValueError, match="fingerprint|checkpoint"):
+            mr_hdbscan.fit(pts, other, checkpoint_dir=ckpt)
+
+    def test_load_latest_empty_dir(self, tmp_path):
+        params = HDBSCANParams(**PARAMS)
+        assert ckpt_mod.load_latest(str(tmp_path / "nope"), params, 10) is None
+
+
+class TestTracer:
+    def test_stage_and_instant_events(self):
+        t = Tracer()
+        with t.stage("work", items=3):
+            t("inner", x=1)
+        assert [e.name for e in t.events] == ["inner", "work"]
+        assert t.events[1].wall_s >= 0
+        assert "stage=work" in t.events[1].format()
+        assert "work: n=1" in t.summary()
+
+    def test_fit_emits_level_events(self, blobs):
+        pts, _ = blobs
+        t = Tracer()
+        mr_hdbscan.fit(pts, HDBSCANParams(**PARAMS), trace=t)
+        levels = [e for e in t.events if e.name == "level"]
+        assert len(levels) >= 2
+        assert levels[0].fields["n_active"] == len(pts)
