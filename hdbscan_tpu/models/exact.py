@@ -47,7 +47,14 @@ def mst_edges(
     max_rounds: int = 64,
     trace=None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-    """Blocked Borůvka: (u, v, w) exact mutual-reachability MST + core distances."""
+    """Blocked Borůvka: (u, v, w) exact mutual-reachability MST + core distances.
+
+    Every round's edges come from full per-component min-outgoing scans, so
+    the tree is the exact MRD MST. (Seeding the union-find with the k-NN
+    graph's MST was tried and reverted: a k-NN-subgraph MST edge is NOT
+    necessarily a global MST edge — the cut property needs the minimum over
+    ALL crossing edges — and the parity tests caught the difference.)
+    """
     n = len(data)
     core, _ = knn_core_distances(
         data, min_pts, metric, row_tile=row_tile, col_tile=col_tile, dtype=dtype

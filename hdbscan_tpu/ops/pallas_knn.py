@@ -1,0 +1,164 @@
+"""Pallas TPU kernel for streaming k-nearest distances (core distances).
+
+An alternative backend for ``ops.tiled.knn_core_distances`` (euclidean):
+keeps one (ROW_TILE, COL_TILE) distance tile resident in VMEM and merges it
+into a running k-best with k min-extraction passes, plus a whole-tile skip
+once the k-best tightens. Distances use the exact difference form, one
+feature at a time (an outer difference per feature), so there is no float32
+catastrophic cancellation; the column operand is a host-transposed copy so
+each feature is a clean 2-D row slice.
+
+Measured on the 245k north-star set (one v5e chip): this kernel runs the
+full scan in ~16 s vs ~6 s for the XLA ``lax.top_k`` scan after the
+difference-form distance fix — the per-grid-step merge/reduction overhead
+dominates at these tiny k, and XLA's pipelined fused scan wins. The kernel
+is therefore NOT the default; it is kept as the Pallas substrate for future
+per-row-compaction selection (and as the reference implementation for
+exact-duplicate-safe distance tiles), with interpreter-mode unit tests
+guarding its semantics against the XLA path.
+
+Grid: (row_tiles, col_tiles), column-fastest; the output block for a row
+tile is revisited across the column sweep and accumulates the running k-best
+(ascending squared distances). Layout: feature axis padded to 128 lanes, k
+padded to 128 for the output tile; only the first k lanes are selected into.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ROW_TILE = 256
+COL_TILE = 2048
+LANES = 128  # TPU lane count: feature and k axes pad to this
+
+
+def _shift_insert(best, t: int, new_t, take):
+    """Merged slot t gets ``new_t``; where the tile won, old slots shift right."""
+    slot_iota = jax.lax.broadcasted_iota(jnp.int32, best.shape, 1)
+    shifted = jnp.concatenate([best[:, :1], best[:, :-1]], axis=1)
+    out = jnp.where((slot_iota > t) & take[:, None], shifted, best)
+    return jnp.where(slot_iota == t, new_t[:, None], out)
+
+
+def _knn_kernel(xr_ref, xct_ref, colmask_ref, out_ref, *, d_real: int, k: int):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[:] = jnp.full_like(out_ref, jnp.inf)
+
+    # Exact difference-form squared distances, one feature at a time:
+    # d2 += (xr[:, f] - xcT[f, :])^2 as a (R, 1) x (1, C) outer difference.
+    r = xr_ref.shape[0]
+    c = xct_ref.shape[1]
+    d2 = jnp.zeros((r, c), jnp.float32)
+    for f in range(d_real):
+        diff = xr_ref[:, f : f + 1] - xct_ref[f : f + 1, :]
+        d2 = d2 + diff * diff
+    d2 = d2 + colmask_ref[:]  # +inf on padding columns
+
+    # Whole-tile skip: once the running k-best tightens (after the first col
+    # tiles), most tiles hold no candidate below any row's current k-th best
+    # — one min pass decides, and the k-pass merge is skipped entirely.
+    row_min = jnp.min(d2, axis=1)
+    worst_best = out_ref[:, k - 1]
+    tile_has_candidate = jnp.any(row_min < worst_best)
+
+    @pl.when(tile_has_candidate)
+    def _():
+        # Two-way merge of (running best[t:], ascending) with (tile minima,
+        # extracted ascending): per slot t take the smaller head; the tile
+        # head is removed via a one-hot, the running stream shifts right.
+        col_iota = jax.lax.broadcasted_iota(jnp.int32, (r, c), 1)
+        best = out_ref[:]
+        cur_d2 = d2
+        for t in range(k):
+            m = jnp.min(cur_d2, axis=1)
+            cur = best[:, t]
+            take = m < cur
+            a = jnp.argmin(cur_d2, axis=1)
+            cur_d2 = jnp.where(
+                (col_iota == a[:, None]) & take[:, None], jnp.inf, cur_d2
+            )
+            best = _shift_insert(best, t, jnp.where(take, m, cur), take)
+        out_ref[:] = best
+
+
+@partial(
+    jax.jit, static_argnames=("d_real", "k", "row_tile", "col_tile", "interpret")
+)
+def knn_smallest_pallas(
+    data: jax.Array,
+    data_t: jax.Array,
+    colmask: jax.Array,
+    d_real: int,
+    k: int,
+    row_tile: int = ROW_TILE,
+    col_tile: int = COL_TILE,
+    interpret: bool = False,
+) -> jax.Array:
+    """(n_pad, LANES) padded data (+ its transpose) -> (n_pad, LANES) with the
+    k smallest squared distances per row ascending in the first k lanes (self
+    included; padding columns must carry ``colmask`` = +inf)."""
+    n_pad = data.shape[0]
+    assert n_pad % row_tile == 0 and n_pad % col_tile == 0
+    grid = (n_pad // row_tile, n_pad // col_tile)
+    return pl.pallas_call(
+        partial(_knn_kernel, d_real=d_real, k=k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((row_tile, LANES), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((LANES, col_tile), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, col_tile), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (row_tile, LANES), lambda i, j: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_pad, LANES), jnp.float32),
+        interpret=interpret,
+    )(data, data_t, colmask)
+
+
+def knn_core_distances_pallas(
+    data: np.ndarray,
+    min_pts: int,
+    k: int | None = None,
+    row_tile: int = ROW_TILE,
+    col_tile: int = COL_TILE,
+    interpret: bool = False,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Drop-in for ``ops.tiled.knn_core_distances`` (euclidean only).
+
+    Returns ``(core, knn)`` with the same semantics: ``knn`` holds the k
+    smallest distances per point ascending with self included; ``core`` is
+    the ``min_pts``-th smallest (self included).
+    """
+    n, d = data.shape
+    if d > LANES:
+        raise ValueError(f"pallas knn kernel supports d <= {LANES}, got {d}")
+    k = max(k or 0, max(min_pts - 1, 1))
+    if k > LANES:
+        raise ValueError(f"pallas knn kernel supports k <= {LANES}, got {k}")
+    n_pad = max(col_tile, row_tile)
+    while n_pad < n:
+        n_pad *= 2
+    x = np.zeros((n_pad, LANES), np.float32)
+    x[:n, :d] = data
+    colmask = np.full((1, n_pad), np.inf, np.float32)
+    colmask[0, :n] = 0.0
+    xj, xtj, mj = jax.device_put((x, np.ascontiguousarray(x.T), colmask))
+    d2 = knn_smallest_pallas(
+        xj, xtj, mj, d, k, row_tile=row_tile, col_tile=col_tile, interpret=interpret
+    )
+    knn = np.sqrt(np.maximum(np.asarray(d2, np.float64)[:n, :k], 0.0))
+    if min_pts <= 1:
+        core = np.zeros(n, np.float64)
+    else:
+        core = knn[:, min(min_pts - 1, n) - 1].copy()
+    return core, knn

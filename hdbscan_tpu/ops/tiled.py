@@ -65,11 +65,22 @@ def _tile_sizes(n: int, row_tile: int, col_tile: int) -> tuple[int, int, int]:
     return row_tile, col_tile, _next_pow2(_round_up(n, col_tile))
 
 
-@partial(jax.jit, static_argnames=("k", "metric", "row_tile", "col_tile"))
-def _knn_core_scan(data, valid, k: int, metric: str, row_tile: int, col_tile: int):
-    """Per-row k smallest distances (self included) over the padded dataset.
+@partial(
+    jax.jit, static_argnames=("k", "metric", "row_tile", "col_tile", "with_indices")
+)
+def _knn_core_scan(
+    data, valid, k: int, metric: str, row_tile: int, col_tile: int,
+    with_indices: bool = False,
+):
+    """Per-row k smallest distances (self included), optionally with the
+    matching column indices.
 
-    Returns (n_pad, k) ascending distances; invalid rows give +inf.
+    Returns ((n_pad, k) ascending distances, (n_pad, k) int32 neighbor ids or
+    None); invalid rows give +inf / -1. Index tracking doubles the top_k
+    working set, so it is off unless a caller needs the k-NN graph. Ties
+    break toward lower column ids, so for duplicate-bearing data a point's
+    own id may be displaced by an earlier duplicate (only the distances are
+    contract; the ids identify *some* k nearest columns).
     """
     n_pad = data.shape[0]
     n_col_tiles = n_pad // col_tile
@@ -79,24 +90,52 @@ def _knn_core_scan(data, valid, k: int, metric: str, row_tile: int, col_tile: in
         xr = jax.lax.dynamic_slice_in_dim(data, r * row_tile, row_tile)
         vr = jax.lax.dynamic_slice_in_dim(valid, r * row_tile, row_tile)
 
-        def col_step(c, best):
+        def tile_dist(c):
             xc = jax.lax.dynamic_slice_in_dim(data, c * col_tile, col_tile)
             vc = jax.lax.dynamic_slice_in_dim(valid, c * col_tile, col_tile)
             d = pairwise_distance(xr, xc, metric)
-            d = jnp.where(vc[None, :], d, inf)
-            # top_k keeps the k LARGEST; negate to keep the k smallest.
-            merged = jnp.concatenate([best, -d], axis=1)
-            best, _ = jax.lax.top_k(merged, k)
-            return best
+            return jnp.where(vc[None, :], d, inf)
 
-        best = jnp.full((row_tile, k), -jnp.inf, data.dtype)
-        best = jax.lax.fori_loop(0, n_col_tiles, col_step, best)
-        knn = -best  # top_k of -d is descending in -d => ascending in d
-        return jnp.where(vr[:, None], knn, inf)
+        if with_indices:
+
+            def col_step(c, carry):
+                best, bidx = carry
+                d = tile_dist(c)
+                cols = c * col_tile + jax.lax.broadcasted_iota(
+                    jnp.int32, (row_tile, col_tile), 1
+                )
+                # top_k keeps the k LARGEST; negate to keep the k smallest.
+                merged = jnp.concatenate([best, -d], axis=1)
+                merged_i = jnp.concatenate([bidx, cols], axis=1)
+                new_best, sel = jax.lax.top_k(merged, k)
+                return new_best, jnp.take_along_axis(merged_i, sel, axis=1)
+
+            init = (
+                jnp.full((row_tile, k), -jnp.inf, data.dtype),
+                jnp.full((row_tile, k), -1, jnp.int32),
+            )
+            best, bidx = jax.lax.fori_loop(0, n_col_tiles, col_step, init)
+            knn = -best  # top_k of -d is descending in -d => ascending in d
+            return (
+                jnp.where(vr[:, None], knn, inf),
+                jnp.where(vr[:, None], bidx, -1),
+            )
+
+        def col_step(c, best):
+            merged = jnp.concatenate([best, -tile_dist(c)], axis=1)
+            return jax.lax.top_k(merged, k)[0]
+
+        best = jax.lax.fori_loop(
+            0, n_col_tiles, col_step, jnp.full((row_tile, k), -jnp.inf, data.dtype)
+        )
+        return jnp.where(vr[:, None], -best, inf)
 
     n_row_tiles = n_pad // row_tile
+    if with_indices:
+        out, out_i = jax.lax.map(row_step, jnp.arange(n_row_tiles))
+        return out.reshape(n_pad, k), out_i.reshape(n_pad, k)
     out = jax.lax.map(row_step, jnp.arange(n_row_tiles))
-    return out.reshape(n_pad, k)
+    return out.reshape(n_pad, k), None
 
 
 def knn_core_distances(
@@ -107,12 +146,14 @@ def knn_core_distances(
     row_tile: int = 1024,
     col_tile: int = 8192,
     dtype=np.float32,
-) -> tuple[np.ndarray, np.ndarray]:
+    return_indices: bool = False,
+):
     """Streaming exact core distances (and the full k-NN distance list).
 
     Returns ``(core, knn)``: ``core[i]`` is the ``min_pts``-th smallest
     distance from i (self included — ``core/knn.py`` semantics), ``knn`` the
-    (n, k) ascending distance list backing it.
+    (n, k) ascending distance list backing it. With ``return_indices`` the
+    (n, k) int64 neighbor-id matrix is appended (self appears at distance 0).
     """
     n = len(data)
     # Reference semantics: core distance = largest of the (minPts - 1)
@@ -121,14 +162,20 @@ def knn_core_distances(
     row_tile, col_tile, n_pad = _tile_sizes(n, row_tile, col_tile)
     data_p = jnp.asarray(_pad_rows(np.asarray(data, dtype), n_pad))
     valid_p = jnp.asarray(np.arange(n_pad) < n)
-    knn = np.asarray(
-        _knn_core_scan(data_p, valid_p, k, metric, row_tile, col_tile),
-        np.float64,
-    )[:n]
+    knn_j, idx_j = _knn_core_scan(
+        data_p, valid_p, k, metric, row_tile, col_tile, with_indices=return_indices
+    )
+    if return_indices:
+        knn_h, idx = jax.device_get((knn_j, idx_j))
+        knn = np.asarray(knn_h, np.float64)[:n]
+    else:
+        knn = np.asarray(knn_j, np.float64)[:n]
     if min_pts <= 1:
         core = np.zeros(n, np.float64)
     else:
         core = knn[:, min(min_pts - 1, n) - 1].copy()
+    if return_indices:
+        return core, knn, np.asarray(idx, np.int64)[:n]
     return core, knn
 
 

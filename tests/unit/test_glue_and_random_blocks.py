@@ -104,3 +104,17 @@ class TestRefinement:
         ari_yes = adjusted_rand_index(refined.labels, ex.labels)
         assert ari_yes >= ari_no - 1e-9
         assert ari_yes > 0.9, f"refined ARI vs exact too low: {ari_yes}"
+
+
+class TestKnnIndices:
+    def test_return_indices_matches_brute_force(self, rng):
+        from hdbscan_tpu.ops.tiled import knn_core_distances
+
+        pts = rng.normal(size=(300, 3))
+        core, knn, idx = knn_core_distances(pts, 5, k=4, return_indices=True)
+        d = np.sqrt(((pts[:, None, :] - pts[None, :, :]) ** 2).sum(-1))
+        for i in range(0, 300, 37):
+            got = np.sort(d[i][idx[i]])
+            np.testing.assert_allclose(got, knn[i], rtol=1e-5, atol=1e-7)
+        # distinct random points: the unique zero-distance column is self
+        assert np.all(idx[:, 0] == np.arange(300))

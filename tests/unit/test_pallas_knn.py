@@ -1,0 +1,32 @@
+"""Interpreter-mode tests for the Pallas k-NN kernel (semantics vs XLA path)."""
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.ops.pallas_knn import knn_core_distances_pallas
+from hdbscan_tpu.ops.tiled import knn_core_distances
+
+
+class TestPallasKnnKernel:
+    def test_matches_xla_scan(self, rng):
+        data = rng.normal(size=(500, 3))
+        core_p, knn_p = knn_core_distances_pallas(data, 8, interpret=True)
+        core_x, knn_x = knn_core_distances(data, 8)
+        np.testing.assert_allclose(core_p, core_x, rtol=1e-5)
+        np.testing.assert_allclose(knn_p, knn_x[:, : knn_p.shape[1]], rtol=1e-5, atol=1e-7)
+
+    def test_exact_zero_for_duplicates(self, rng):
+        """The difference-form tiles must give exactly zero distance for
+        duplicate points (the dot-product expansion does not)."""
+        data = np.repeat(rng.normal(size=(40, 3)), 10, axis=0)
+        core_p, _ = knn_core_distances_pallas(data, 8, interpret=True)
+        assert np.all(core_p == 0.0)
+
+    def test_min_pts_one_gives_zeros(self, rng):
+        data = rng.normal(size=(300, 2))
+        core_p, _ = knn_core_distances_pallas(data, 1, interpret=True)
+        assert np.all(core_p == 0.0)
+
+    def test_dimension_limit(self, rng):
+        with pytest.raises(ValueError):
+            knn_core_distances_pallas(rng.normal(size=(10, 200)), 4, interpret=True)
