@@ -63,6 +63,13 @@ class HDBSCANParams:
     #: whole region into a later merge wave and flips the flat cut. 0
     #: disables (reference-faithful: the reference never refines).
     refine_iterations: int = 1
+    #: Collapse duplicate rows into weighted unique points before the exact
+    #: pipeline (``core/dedup.py``). Semantics-preserving (a duplicate group
+    #: is a zero-extent bubble; the member-weighted tree equals the full-row
+    #: tree) while the O(n^2 d) device scans shrink to unique-count scale —
+    #: 4.8x fewer rows (23x less scan work) on the lattice-valued north-star
+    #: set. Off by default for strict row-level reference parity.
+    dedup_points: bool = False
     # Output file names derived from the input path (main/Main.java:516-526):
 
     def __post_init__(self):

@@ -53,6 +53,32 @@ __all__ = [
 
 
 @partial(jax.jit, static_argnames=("num_bubbles",))
+def bubble_stats_weighted(
+    points: jax.Array, assign: jax.Array, weights: jax.Array, num_bubbles: int
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """:func:`bubble_stats` over WEIGHTED points (deduplicated rows carry
+    their duplicate multiplicity): LS/SS/n become weighted segment sums, so a
+    weighted point behaves exactly like that many coincident rows. Same
+    padding/empty-bubble contract as :func:`bubble_stats` (which delegates
+    here with unit weights — one copy of the CF formulas)."""
+    d = points.shape[-1]
+    dt = points.dtype
+    w = weights.astype(dt)
+    ls = jax.ops.segment_sum(points * w[:, None], assign, num_segments=num_bubbles)
+    ss = jax.ops.segment_sum(
+        points * points * w[:, None], assign, num_segments=num_bubbles
+    )
+    n = jax.ops.segment_sum(w, assign, num_segments=num_bubbles)
+    n_safe = jnp.maximum(n, 1.0)
+    rep = ls / n_safe[:, None]
+    var = (2.0 * n[:, None] * ss - 2.0 * ls * ls) / jnp.maximum(n * (n - 1.0), 1.0)[:, None]
+    extent = jnp.sqrt(jnp.maximum(jnp.sum(var, axis=-1), 0.0))
+    extent = jnp.where(n > 1, extent, jnp.zeros((), dt))
+    nn_dist = jnp.power(1.0 / n_safe, 1.0 / d) * extent
+    return rep, extent, nn_dist, n
+
+
+@partial(jax.jit, static_argnames=("num_bubbles",))
 def bubble_stats(
     points: jax.Array, assign: jax.Array, num_bubbles: int
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
@@ -73,18 +99,9 @@ def bubble_stats(
       = 0 (the reference's singleton CFs start that way,
       ``mappers/FirstStep.java:92-101``). Empty bubbles get n = 0, rep = 0.
     """
-    d = points.shape[-1]
-    dt = points.dtype
-    ls = jax.ops.segment_sum(points, assign, num_segments=num_bubbles)
-    ss = jax.ops.segment_sum(points * points, assign, num_segments=num_bubbles)
-    n = jax.ops.segment_sum(jnp.ones(points.shape[0], dt), assign, num_segments=num_bubbles)
-    n_safe = jnp.maximum(n, 1.0)
-    rep = ls / n_safe[:, None]
-    var = (2.0 * n[:, None] * ss - 2.0 * ls * ls) / jnp.maximum(n * (n - 1.0), 1.0)[:, None]
-    extent = jnp.sqrt(jnp.maximum(jnp.sum(var, axis=-1), 0.0))
-    extent = jnp.where(n > 1, extent, jnp.zeros((), dt))
-    nn_dist = jnp.power(1.0 / n_safe, 1.0 / d) * extent
-    return rep, extent, nn_dist, n
+    return bubble_stats_weighted(
+        points, assign, jnp.ones(points.shape[0], points.dtype), num_bubbles
+    )
 
 
 def bubble_distance_matrix(

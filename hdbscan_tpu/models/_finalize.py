@@ -25,27 +25,44 @@ def finalize_clustering(
     core: np.ndarray,
     params: HDBSCANParams,
     num_constraints_satisfied: np.ndarray | None = None,
+    point_weights: np.ndarray | None = None,
+    constraint_index_map: np.ndarray | None = None,
 ) -> tuple[tree_mod.CondensedTree, np.ndarray, np.ndarray, bool]:
     """Edge pool + core distances -> (tree, labels, outlier_scores, infinite).
 
     Constraint counts load from ``params.constraints_file`` when not supplied
     (both gamma and virtual-child vGamma credits feed propagation).
+    ``point_weights``: member count per vertex (deduplicated pipelines).
+    ``constraint_index_map``: row id -> vertex id translation for constraint
+    files when vertices are deduplicated points.
     """
-    forest = tree_mod.build_merge_forest(n, u, v, w)
+    forest = tree_mod.build_merge_forest(n, u, v, w, point_weights=point_weights)
     tree = tree_mod.condense_forest(
         forest,
         params.min_cluster_size,
+        point_weights=point_weights,
         self_levels=core if params.self_edges else None,
     )
     virtual_child_constraints = None
     if params.constraints_file and num_constraints_satisfied is None:
         from hdbscan_tpu.core.constraints import (
+            Constraint,
             count_constraints_satisfied,
             load_constraints,
         )
 
+        cons = load_constraints(params.constraints_file)
+        if constraint_index_map is not None:
+            cons = [
+                Constraint(
+                    int(constraint_index_map[c.point_a]),
+                    int(constraint_index_map[c.point_b]),
+                    c.kind,
+                )
+                for c in cons
+            ]
         num_constraints_satisfied, virtual_child_constraints = (
-            count_constraints_satisfied(tree, load_constraints(params.constraints_file))
+            count_constraints_satisfied(tree, cons)
         )
     infinite = tree_mod.propagate_tree(
         tree, num_constraints_satisfied, virtual_child_constraints

@@ -61,6 +61,32 @@ def mst_edges(
     )
     if trace is not None:
         trace("core_distances", n=n)
+    u, v, w = mst_edges_from_core(
+        data,
+        core,
+        metric,
+        row_tile=row_tile,
+        col_tile=col_tile,
+        dtype=dtype,
+        max_rounds=max_rounds,
+        trace=trace,
+    )
+    return u, v, w, core
+
+
+def mst_edges_from_core(
+    data: np.ndarray,
+    core: np.ndarray,
+    metric: str = "euclidean",
+    row_tile: int = 1024,
+    col_tile: int = 8192,
+    dtype=np.float32,
+    max_rounds: int = 64,
+    trace=None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """The Borůvka round loop of :func:`mst_edges` for PRE-COMPUTED core
+    distances (the weighted/dedup path supplies multiset-weighted cores)."""
+    n = len(data)
     scanner = BoruvkaScanner(
         data, core, metric, row_tile=row_tile, col_tile=col_tile, dtype=dtype
     )
@@ -105,7 +131,6 @@ def mst_edges(
         np.asarray(eu, np.int64),
         np.asarray(ev, np.int64),
         np.asarray(ew, np.float64),
-        core,
     )
 
 
@@ -255,6 +280,16 @@ def fit(
     n = len(data)
     if n == 0:
         raise ValueError("empty dataset")
+    if params.dedup_points:
+        return _fit_dedup(
+            data,
+            params,
+            row_tile=row_tile,
+            col_tile=col_tile,
+            dtype=dtype,
+            num_constraints_satisfied=num_constraints_satisfied,
+            trace=trace,
+        )
     u, v, w, core = mst_edges(
         data,
         params.min_points,
@@ -275,5 +310,77 @@ def fit(
         core_distances=core,
         mst=(u, v, w),
         outlier_scores=scores,
+        infinite_stability=infinite,
+    )
+
+
+def _fit_dedup(
+    data: np.ndarray,
+    params: HDBSCANParams,
+    *,
+    row_tile: int,
+    col_tile: int,
+    dtype,
+    num_constraints_satisfied,
+    trace,
+) -> HDBSCANResult:
+    """Exact HDBSCAN* over deduplicated weighted points (``core/dedup.py``).
+
+    Semantics-preserving: the condensed tree over weighted unique points
+    equals the full-row tree (duplicate groups contract to one merge node
+    either way); device scans run at unique-count scale. Constraint row ids
+    are mapped through the dedup inverse before counting.
+    """
+    from hdbscan_tpu.core.dedup import (
+        deduplicate,
+        expand_heavy_groups,
+        global_weighted_core_distances,
+    )
+
+    n = len(data)
+    uniq, counts, inverse = deduplicate(data)
+    if trace is not None:
+        trace("dedup", rows=n, unique=len(uniq))
+    core_u = global_weighted_core_distances(
+        uniq, counts, params.min_points, params.dist_function
+    )
+    if trace is not None:
+        trace("core_distances", n=len(uniq))
+    u, v, w = mst_edges_from_core(
+        uniq,
+        core_u,
+        params.dist_function,
+        row_tile=row_tile,
+        col_tile=col_tile,
+        dtype=dtype,
+        trace=trace,
+    )
+    # Tree extraction over the expanded vertex set (see expand_heavy_groups:
+    # groups heavy enough to pass minClusterSize must dissolve under tie
+    # contraction exactly like their full-row counterparts).
+    u2, v2, w2, core2, weights2 = expand_heavy_groups(
+        u, v, w, core_u, counts, params.min_cluster_size
+    )
+
+    from hdbscan_tpu.models._finalize import finalize_clustering
+
+    tree, labels_x, scores_x, infinite = finalize_clustering(
+        len(weights2),
+        u2,
+        v2,
+        w2,
+        core2,
+        params,
+        num_constraints_satisfied,
+        point_weights=weights2,
+        constraint_index_map=inverse,
+    )
+    m = len(uniq)
+    return HDBSCANResult(
+        labels=labels_x[:m][inverse],
+        tree=tree,
+        core_distances=core_u[inverse],
+        mst=(u, v, w),
+        outlier_scores=scores_x[:m][inverse],
         infinite_stability=infinite,
     )

@@ -84,9 +84,13 @@ class MRHDBSCANResult:
     n_levels: int
     n_edges: int
     levels: list = field(default_factory=list)
-    #: (u, v, w) pooled edge set, kept when fit(keep_edge_pool=True) —
-    #: for diagnostics and tests of the distributed merge.
+    #: (u, v, w) pooled edge set, kept when fit(keep_edge_pool=True) — for
+    #: diagnostics and tests of the distributed merge. NOTE: with
+    #: ``dedup_points`` the ids (and ``levels`` counters) live in UNIQUE-
+    #: vertex space; translate rows via ``dedup_inverse`` (row -> vertex).
     edge_pool: tuple | None = None
+    #: row -> unique-vertex index map when the run deduplicated (else None).
+    dedup_inverse: np.ndarray | None = None
 
 
 def _group_by_subset(subset_ids: np.ndarray, active: np.ndarray) -> list[np.ndarray]:
@@ -240,10 +244,69 @@ def fit(
     resumed from automatically.
     ``trace``: optional callable/:class:`~hdbscan_tpu.utils.tracing.Tracer`
     receiving per-stage events.
+
+    With ``params.dedup_points`` the whole pipeline runs over weighted unique
+    points (``core/dedup.py``; requires ``global_core_distances``) and the
+    result is broadcast back to row space.
     """
+    params = params or HDBSCANParams()
+    if params.dedup_points:
+        if not params.global_core_distances:
+            raise ValueError("dedup_points requires global_core_distances")
+        from hdbscan_tpu.core.dedup import deduplicate
+
+        data = np.ascontiguousarray(np.asarray(data, np.float64))
+        uniq, counts, inverse = deduplicate(data)
+        if trace is not None:
+            trace("dedup", rows=len(data), unique=len(uniq))
+        res = _fit_rows(
+            uniq,
+            params,
+            mesh=mesh,
+            max_levels=max_levels,
+            checkpoint_dir=checkpoint_dir,
+            trace=trace,
+            keep_edge_pool=keep_edge_pool,
+            weights=counts,
+            constraint_index_map=inverse,
+        )
+        return MRHDBSCANResult(
+            labels=res.labels[inverse],
+            tree=res.tree,
+            core_distances=res.core_distances[inverse],
+            outlier_scores=res.outlier_scores[inverse],
+            infinite_stability=res.infinite_stability,
+            n_levels=res.n_levels,
+            n_edges=res.n_edges,
+            levels=res.levels,
+            edge_pool=res.edge_pool,
+            dedup_inverse=inverse,
+        )
+    return _fit_rows(
+        data,
+        params,
+        mesh=mesh,
+        max_levels=max_levels,
+        checkpoint_dir=checkpoint_dir,
+        trace=trace,
+        keep_edge_pool=keep_edge_pool,
+    )
+
+
+def _fit_rows(
+    data: np.ndarray,
+    params: HDBSCANParams,
+    mesh=None,
+    max_levels: int = 64,
+    checkpoint_dir: str | None = None,
+    trace=None,
+    keep_edge_pool: bool = False,
+    weights: np.ndarray | None = None,
+    constraint_index_map: np.ndarray | None = None,
+) -> MRHDBSCANResult:
+    """The level loop over (possibly weighted) vertex rows."""
     import time
 
-    params = params or HDBSCANParams()
     data = np.ascontiguousarray(np.asarray(data, np.float64))
     n, d = data.shape
     if n == 0:
@@ -286,7 +349,14 @@ def fit(
         # A resumed run restores the same array from the checkpoint instead.
         from hdbscan_tpu.ops.tiled import knn_core_distances
 
-        core, _ = knn_core_distances(data, params.min_points, metric)
+        if weights is not None:
+            from hdbscan_tpu.core.dedup import global_weighted_core_distances
+
+            core = global_weighted_core_distances(
+                data, weights, params.min_points, metric
+            )
+        else:
+            core, _ = knn_core_distances(data, params.min_points, metric)
     n_dev = 1
     if mesh is not None:
         n_dev = math.prod(mesh.devices.shape)
@@ -382,8 +452,18 @@ def fit(
                 pts_p[:size] = data[ids]
                 asg_p = np.full(n_pad, s_pad, np.int32)
                 asg_p[:size] = assign
-                pts_j, asg_j = jax.device_put((pts_p, asg_p))
-                rep, extent, nn_dist, n_b = bubble_stats(pts_j, asg_j, s_pad)
+                if weights is not None:
+                    from hdbscan_tpu.core.bubbles import bubble_stats_weighted
+
+                    w_p = np.zeros(n_pad, np.float64)
+                    w_p[:size] = weights[ids]
+                    pts_j, asg_j, w_j = jax.device_put((pts_p, asg_p, w_p))
+                    rep, extent, nn_dist, n_b = bubble_stats_weighted(
+                        pts_j, asg_j, w_j, s_pad
+                    )
+                else:
+                    pts_j, asg_j = jax.device_put((pts_p, asg_p))
+                    rep, extent, nn_dist, n_b = bubble_stats(pts_j, asg_j, s_pad)
                 # Device arrays pass straight through — fit_bubbles batches the
                 # one device->host fetch the tree extraction needs.
                 model = fit_bubbles(
@@ -491,7 +571,28 @@ def fit(
     # condensed tree, exactly as in the single-block path.
     from hdbscan_tpu.models._finalize import finalize_clustering
 
-    tree, labels, scores, infinite = finalize_clustering(n, u, v, w, core, params)
+    def build_tree(u_, v_, w_):
+        # Weighted vertices heavy enough to pass minClusterSize must dissolve
+        # under tie contraction like their full-row counterparts — expand
+        # them into unit pseudo-leaves before extraction (core/dedup.py).
+        if weights is not None:
+            from hdbscan_tpu.core.dedup import expand_heavy_groups
+
+            u2, v2, w2, core2, weights2 = expand_heavy_groups(
+                u_, v_, w_, core, weights, params.min_cluster_size
+            )
+        else:
+            u2, v2, w2, core2, weights2 = u_, v_, w_, core, None
+        n2 = n if weights2 is None else len(weights2)
+        tree, labels, scores, infinite = finalize_clustering(
+            n2, u2, v2, w2, core2, params,
+            point_weights=weights2,
+            constraint_index_map=constraint_index_map,
+        )
+        # Pseudo-leaves alias their base vertex: slice back to vertex space.
+        return tree, labels[:n], scores[:n], infinite
+
+    tree, labels, scores, infinite = build_tree(u, v, w)
 
     # Refinement (config.refine_iterations): harvest the exact minimum MRD
     # edges between the tree's leaf clusters and rebuild. Each harvested edge
@@ -503,7 +604,7 @@ def fit(
 
         for _ in range(params.refine_iterations):
             t0 = time.monotonic()
-            groups_r = tree.point_last_cluster
+            groups_r = tree.point_last_cluster[:n]
             if len(np.unique(groups_r)) < 2:
                 break
             ru, rv, rw = boruvka_glue_edges(
@@ -514,9 +615,7 @@ def fit(
             u = np.concatenate([u, ru])
             v = np.concatenate([v, rv])
             w = np.concatenate([w, rw])
-            tree, labels, scores, infinite = finalize_clustering(
-                n, u, v, w, core, params
-            )
+            tree, labels, scores, infinite = build_tree(u, v, w)
             if trace is not None:
                 trace("refine", new_edges=len(ru), wall_s=round(time.monotonic() - t0, 3))
 

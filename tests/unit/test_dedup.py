@@ -1,0 +1,116 @@
+"""Weighted dedup path: must reproduce the full-row exact clustering."""
+
+import numpy as np
+
+from hdbscan_tpu.config import HDBSCANParams
+from hdbscan_tpu.core.dedup import deduplicate, weighted_core_distances
+from hdbscan_tpu.models import exact, hdbscan
+from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+from tests.conftest import make_blobs
+
+
+def _dup_data(rng, n_unique=150, reps=(1, 2, 5, 9)):
+    pts, _ = make_blobs(rng, n=n_unique, d=3, centers=3)
+    rows = np.concatenate(
+        [np.repeat(pts[i : i + 1], reps[i % len(reps)], axis=0) for i in range(n_unique)]
+    )
+    return rows[rng.permutation(len(rows))]
+
+
+class TestDeduplicate:
+    def test_roundtrip(self, rng):
+        rows = _dup_data(rng)
+        uniq, counts, inverse = deduplicate(rows)
+        assert counts.sum() == len(rows)
+        np.testing.assert_array_equal(uniq[inverse], rows)
+
+
+class TestWeightedCoreDistances:
+    def test_matches_multiset_core(self, rng):
+        rows = _dup_data(rng)
+        uniq, counts, inverse = deduplicate(rows)
+        min_pts = 6
+        from hdbscan_tpu.ops.tiled import knn_core_distances
+
+        _, knn_d, knn_i = knn_core_distances(
+            uniq, min_pts, k=min_pts, return_indices=True
+        )
+        core_u = weighted_core_distances(knn_d, knn_i, counts, min_pts)
+        # brute-force multiset core over the full rows: the (minPts-1)-th
+        # smallest with self included (reference semantics, core/knn.py)
+        d = np.sqrt(((rows[:, None, :] - rows[None, :, :]) ** 2).sum(-1))
+        want_rows = np.sort(d, axis=1)[:, min_pts - 2]
+        np.testing.assert_allclose(core_u[inverse], want_rows, rtol=1e-5, atol=1e-7)
+
+
+class TestDedupFitEquivalence:
+    def test_labels_match_full_row_exact(self, rng):
+        rows = _dup_data(rng)
+        params = HDBSCANParams(min_points=6, min_cluster_size=20)
+        full = hdbscan.fit(rows, params)
+        dd = exact.fit(rows, params.replace(dedup_points=True))
+        ari = adjusted_rand_index(dd.labels, full.labels)
+        assert ari == 1.0, f"dedup clustering differs from full-row exact: ARI={ari}"
+        np.testing.assert_allclose(
+            dd.core_distances, full.core_distances, rtol=1e-5, atol=1e-7
+        )
+
+    def test_no_duplicates_is_identity(self, rng):
+        pts, _ = make_blobs(rng, n=300, d=3, centers=3)
+        params = HDBSCANParams(min_points=5, min_cluster_size=15)
+        a = exact.fit(pts, params)
+        b = exact.fit(pts, params.replace(dedup_points=True))
+        assert adjusted_rand_index(a.labels, b.labels) == 1.0
+
+
+class TestDedupMRPipeline:
+    def test_mr_dedup_close_to_plain_mr(self, rng):
+        from hdbscan_tpu.models import mr_hdbscan
+
+        rows = _dup_data(rng, n_unique=400, reps=(1, 3, 2, 4))
+        params = HDBSCANParams(
+            min_points=5, min_cluster_size=30, processing_units=300, k=0.1, seed=1
+        )
+        plain = mr_hdbscan.fit(rows, params)
+        dd = mr_hdbscan.fit(rows, params.replace(dedup_points=True))
+        assert len(dd.labels) == len(rows)
+        # both must resolve the macro blob structure; exact equality is not
+        # expected (sampling operates on different vertex sets)
+        full = hdbscan.fit(rows, params.replace(processing_units=10000))
+        ari_dd = adjusted_rand_index(dd.labels, full.labels)
+        assert ari_dd > 0.85, f"dedup MR ARI vs exact too low: {ari_dd}"
+
+    def test_mr_dedup_requires_global_cores(self, rng):
+        from hdbscan_tpu.models import mr_hdbscan
+        import pytest as _pytest
+
+        rows = _dup_data(rng)
+        params = HDBSCANParams(dedup_points=True, global_core_distances=False)
+        with _pytest.raises(ValueError):
+            mr_hdbscan.fit(rows, params)
+
+
+class TestHeavyGroupExpansion:
+    def test_heavy_duplicate_groups_match_full_row_tree(self, rng):
+        """Regression: groups whose member count passes minClusterSize must
+        dissolve under tie contraction exactly like their full-row
+        counterparts (atomic weighted vertices force spurious splits)."""
+        pts, _ = make_blobs(rng, n=60, d=2, centers=2)
+        reps = np.where(np.arange(60) % 2 == 0, 6, 1)
+        rows = np.repeat(pts, reps, axis=0)
+        params = HDBSCANParams(min_points=8, min_cluster_size=5)
+        full = hdbscan.fit(rows, params)
+        dd = exact.fit(rows, params.replace(dedup_points=True))
+        ari = adjusted_rand_index(dd.labels, full.labels)
+        assert ari == 1.0, f"heavy-group dedup diverges from full-row: ARI={ari}"
+        assert dd.tree.n_clusters == full.tree.n_clusters
+
+    def test_tiny_dataset_core_clamp_is_finite(self):
+        """Regression: rows below minPts coverage must clamp to the farthest
+        finite distance (not the +inf knn padding)."""
+        rows = np.array([[0.0, 0.0], [0.0, 0.0], [1.0, 0.0], [2.0, 0.0]])
+        params = HDBSCANParams(min_points=6, min_cluster_size=2)
+        full = hdbscan.fit(rows, params)
+        dd = exact.fit(rows, params.replace(dedup_points=True))
+        assert np.all(np.isfinite(dd.core_distances))
+        np.testing.assert_allclose(dd.core_distances, full.core_distances, rtol=1e-6)
