@@ -5,7 +5,8 @@ Usage mirrors the reference's documented contract (``main/Main.java:534-614``)::
     python -m hdbscan_tpu file=<input> minPts=4 minClSize=4 \
         [processing_units=N] [k=0.2] [constraints=<csv>] [compact={true,false}] \
         [dist_function={euclidean,cosine,pearson,manhattan,supremum}] \
-        [out_dir=DIR] [seed=N] [variant={db,rs}]
+        [out_dir=DIR] [seed=N] [variant={db,rs}] [dedup={true,false}] \
+        [exact_inter_edges={true,false}] [global_cores={true,false}] [refine=N]
 
 Unlike the reference, argv is actually honored (the reference shadows it with
 hard-coded args, ``main/Main.java:71`` — treated as a bug, SURVEY.md §7), and

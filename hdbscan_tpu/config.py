@@ -120,6 +120,10 @@ class HDBSCANParams:
             "out_dir": ("out_dir", str),
             "seed": ("seed", int),
             "variant": ("variant", str),
+            "dedup": ("dedup_points", lambda s: s.lower() == "true"),
+            "exact_inter_edges": ("exact_inter_edges", lambda s: s.lower() == "true"),
+            "global_cores": ("global_core_distances", lambda s: s.lower() == "true"),
+            "refine": ("refine_iterations", int),
         }
         kwargs = {}
         for arg in argv:

@@ -5,11 +5,11 @@ Skin set: 245,057 rows, 51,433 unique points, 4.8x). A duplicate group is a
 zero-extent data bubble: collapsing it to one point with a member count
 preserves the exact HDBSCAN* semantics —
 
-- core distance: the minPts-th smallest distance over the row MULTISET (self
-  included) equals the first unique-neighbor distance at which the cumulative
-  member count reaches minPts (0 if the group itself holds >= minPts members,
-  matching the reference's self-included kNN buffer, ``HDBSCANStar.java:71-106``
-  where a duplicate contributes a 0 distance per copy);
+- core distance: the (minPts-1)-th smallest distance over the row MULTISET
+  (self included — the reference's kNN-buffer semantics, ``HDBSCANStar.java:
+  71-106``, where a duplicate contributes a 0 distance per copy) equals the
+  first unique-neighbor distance at which the cumulative member count reaches
+  minPts - 1; it is 0 iff the group itself holds >= minPts - 1 members;
 - mutual-reachability MST: within-group edges all carry weight core_i (d=0),
   so the group contracts to one merge-forest node — exactly what the
   member-weighted merge forest does with ``point_weights=counts`` and
@@ -78,7 +78,13 @@ def weighted_core_distances(
 
 
 def global_weighted_core_distances(
-    data: np.ndarray, counts: np.ndarray, min_pts: int, metric: str
+    data: np.ndarray,
+    counts: np.ndarray,
+    min_pts: int,
+    metric: str,
+    row_tile: int = 1024,
+    col_tile: int = 8192,
+    dtype=np.float32,
 ) -> np.ndarray:
     """One tiled scan + multiset cumsum: the weighted global core distances.
 
@@ -88,7 +94,14 @@ def global_weighted_core_distances(
     from hdbscan_tpu.ops.tiled import knn_core_distances
 
     _, knn_d, knn_i = knn_core_distances(
-        data, min_pts, metric, k=max(min_pts, 2), return_indices=True
+        data,
+        min_pts,
+        metric,
+        k=max(min_pts, 2),
+        row_tile=row_tile,
+        col_tile=col_tile,
+        dtype=dtype,
+        return_indices=True,
     )
     return weighted_core_distances(knn_d, knn_i, counts, min_pts)
 

@@ -45,6 +45,7 @@ def mst_edges(
     col_tile: int = 8192,
     dtype=np.float32,
     max_rounds: int = 64,
+    mesh=None,
     trace=None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Blocked Borůvka: (u, v, w) exact mutual-reachability MST + core distances.
@@ -69,6 +70,7 @@ def mst_edges(
         col_tile=col_tile,
         dtype=dtype,
         max_rounds=max_rounds,
+        mesh=mesh,
         trace=trace,
     )
     return u, v, w, core
@@ -82,13 +84,15 @@ def mst_edges_from_core(
     col_tile: int = 8192,
     dtype=np.float32,
     max_rounds: int = 64,
+    mesh=None,
     trace=None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """The Borůvka round loop of :func:`mst_edges` for PRE-COMPUTED core
     distances (the weighted/dedup path supplies multiset-weighted cores)."""
     n = len(data)
     scanner = BoruvkaScanner(
-        data, core, metric, row_tile=row_tile, col_tile=col_tile, dtype=dtype
+        data, core, metric, row_tile=row_tile, col_tile=col_tile, dtype=dtype,
+        mesh=mesh,
     )
 
     parent = np.arange(n, dtype=np.int64)
@@ -266,6 +270,7 @@ def fit(
     row_tile: int = 1024,
     col_tile: int = 8192,
     dtype=np.float32,
+    mesh=None,
     num_constraints_satisfied: np.ndarray | None = None,
     trace=None,
 ) -> HDBSCANResult:
@@ -287,6 +292,7 @@ def fit(
             row_tile=row_tile,
             col_tile=col_tile,
             dtype=dtype,
+            mesh=mesh,
             num_constraints_satisfied=num_constraints_satisfied,
             trace=trace,
         )
@@ -297,6 +303,7 @@ def fit(
         row_tile=row_tile,
         col_tile=col_tile,
         dtype=dtype,
+        mesh=mesh,
         trace=trace,
     )
     from hdbscan_tpu.models._finalize import finalize_clustering
@@ -321,6 +328,7 @@ def _fit_dedup(
     row_tile: int,
     col_tile: int,
     dtype,
+    mesh=None,
     num_constraints_satisfied,
     trace,
 ) -> HDBSCANResult:
@@ -342,7 +350,13 @@ def _fit_dedup(
     if trace is not None:
         trace("dedup", rows=n, unique=len(uniq))
     core_u = global_weighted_core_distances(
-        uniq, counts, params.min_points, params.dist_function
+        uniq,
+        counts,
+        params.min_points,
+        params.dist_function,
+        row_tile=row_tile,
+        col_tile=col_tile,
+        dtype=dtype,
     )
     if trace is not None:
         trace("core_distances", n=len(uniq))
@@ -353,6 +367,7 @@ def _fit_dedup(
         row_tile=row_tile,
         col_tile=col_tile,
         dtype=dtype,
+        mesh=mesh,
         trace=trace,
     )
     # Tree extraction over the expanded vertex set (see expand_heavy_groups:
@@ -380,7 +395,8 @@ def _fit_dedup(
         labels=labels_x[:m][inverse],
         tree=tree,
         core_distances=core_u[inverse],
-        mst=(u, v, w),
+        mst=(u, v, w),  # unique-vertex space; see HDBSCANResult.mst note
         outlier_scores=scores_x[:m][inverse],
         infinite_stability=infinite,
+        dedup_inverse=inverse,
     )

@@ -32,9 +32,13 @@ class HDBSCANResult:
     labels: np.ndarray  # flat partition, 0 = noise
     tree: tree_mod.CondensedTree
     core_distances: np.ndarray
-    mst: tuple[np.ndarray, np.ndarray, np.ndarray]  # (u, v, w) without self edges
+    #: (u, v, w) MST without self edges. NOTE: with ``dedup_points`` the ids
+    #: live in UNIQUE-vertex space — translate rows via ``dedup_inverse``.
+    mst: tuple[np.ndarray, np.ndarray, np.ndarray]
     outlier_scores: np.ndarray
     infinite_stability: bool
+    #: row -> unique-vertex index map when the run deduplicated (else None).
+    dedup_inverse: np.ndarray | None = None
 
 
 @partial(jax.jit, static_argnames=("min_pts", "metric"))

@@ -392,6 +392,7 @@ def _fit_rows(
                 subset[act],
                 metric,
                 core=core[act] if global_core else None,
+                mesh=mesh,
             )
             pool_u.append(act[gu_l])
             pool_v.append(act[gv_l])
@@ -608,7 +609,8 @@ def _fit_rows(
             if len(np.unique(groups_r)) < 2:
                 break
             ru, rv, rw = boruvka_glue_edges(
-                data, groups_r, metric, core=core if global_core else None
+                data, groups_r, metric, core=core if global_core else None,
+                mesh=mesh,
             )
             if len(ru) == 0:
                 break

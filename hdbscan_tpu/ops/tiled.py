@@ -183,6 +183,45 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
+def _min_out_row_block(
+    data, core, comp, valid, base, metric: str, row_tile: int, col_tile: int
+):
+    """Min outgoing edge per row of one row block starting at ``base``.
+
+    The shared tile body of the single-device and mesh-sharded scans: MRD
+    weights, outgoing mask, and the smallest-column-wins tie-break live here
+    ONCE. Returns ((row_tile,) best_w, (row_tile,) best_j).
+    """
+    n_pad = data.shape[0]
+    n_col_tiles = n_pad // col_tile
+    inf = jnp.array(jnp.inf, data.dtype)
+    xr = jax.lax.dynamic_slice_in_dim(data, base, row_tile)
+    cr = jax.lax.dynamic_slice_in_dim(core, base, row_tile)
+    kr = jax.lax.dynamic_slice_in_dim(comp, base, row_tile)
+    vr = jax.lax.dynamic_slice_in_dim(valid, base, row_tile)
+
+    def col_step(c, carry):
+        bw, bj = carry
+        xc = jax.lax.dynamic_slice_in_dim(data, c * col_tile, col_tile)
+        cc = jax.lax.dynamic_slice_in_dim(core, c * col_tile, col_tile)
+        kc = jax.lax.dynamic_slice_in_dim(comp, c * col_tile, col_tile)
+        vc = jax.lax.dynamic_slice_in_dim(valid, c * col_tile, col_tile)
+        d = pairwise_distance(xr, xc, metric)
+        w = jnp.maximum(d, jnp.maximum(cr[:, None], cc[None, :]))
+        out = (kr[:, None] != kc[None, :]) & vc[None, :] & vr[:, None]
+        w = jnp.where(out, w, inf)
+        tw = jnp.min(w, axis=1)
+        tj = jnp.argmin(w, axis=1).astype(jnp.int32) + c * col_tile
+        upd = tw < bw
+        return jnp.where(upd, tw, bw), jnp.where(upd, tj, bj)
+
+    # Carry inits derive from (possibly device-varying) slices so the mesh
+    # path's shard_map varying-axis types match between input and output.
+    bw0 = jnp.full_like(cr, jnp.inf)
+    bj0 = jnp.full_like(kr, -1)
+    return jax.lax.fori_loop(0, n_col_tiles, col_step, (bw0, bj0))
+
+
 @partial(jax.jit, static_argnames=("metric", "row_tile", "col_tile"))
 def _min_outgoing_scan(
     data, core, comp, valid, metric: str, row_tile: int, col_tile: int
@@ -195,33 +234,11 @@ def _min_outgoing_scan(
     over ascending j), making round output independent of tiling.
     """
     n_pad = data.shape[0]
-    n_col_tiles = n_pad // col_tile
-    inf = jnp.array(jnp.inf, data.dtype)
 
     def row_step(r):
-        xr = jax.lax.dynamic_slice_in_dim(data, r * row_tile, row_tile)
-        cr = jax.lax.dynamic_slice_in_dim(core, r * row_tile, row_tile)
-        kr = jax.lax.dynamic_slice_in_dim(comp, r * row_tile, row_tile)
-        vr = jax.lax.dynamic_slice_in_dim(valid, r * row_tile, row_tile)
-
-        def col_step(c, carry):
-            bw, bj = carry
-            xc = jax.lax.dynamic_slice_in_dim(data, c * col_tile, col_tile)
-            cc = jax.lax.dynamic_slice_in_dim(core, c * col_tile, col_tile)
-            kc = jax.lax.dynamic_slice_in_dim(comp, c * col_tile, col_tile)
-            vc = jax.lax.dynamic_slice_in_dim(valid, c * col_tile, col_tile)
-            d = pairwise_distance(xr, xc, metric)
-            w = jnp.maximum(d, jnp.maximum(cr[:, None], cc[None, :]))
-            out = (kr[:, None] != kc[None, :]) & vc[None, :] & vr[:, None]
-            w = jnp.where(out, w, inf)
-            tw = jnp.min(w, axis=1)
-            tj = jnp.argmin(w, axis=1).astype(jnp.int32) + c * col_tile
-            upd = tw < bw
-            return jnp.where(upd, tw, bw), jnp.where(upd, tj, bj)
-
-        bw0 = jnp.full((row_tile,), jnp.inf, data.dtype)
-        bj0 = jnp.full((row_tile,), -1, jnp.int32)
-        return jax.lax.fori_loop(0, n_col_tiles, col_step, (bw0, bj0))
+        return _min_out_row_block(
+            data, core, comp, valid, r * row_tile, metric, row_tile, col_tile
+        )
 
     n_row_tiles = n_pad // row_tile
     bw, bj = jax.lax.map(row_step, jnp.arange(n_row_tiles))
@@ -237,6 +254,7 @@ def boruvka_glue_edges(
     col_tile: int = 8192,
     dtype=np.float32,
     max_rounds: int = 64,
+    mesh=None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Exact inter-group MST "glue" edges — Borůvka rounds to connectivity.
 
@@ -268,7 +286,8 @@ def boruvka_glue_edges(
     if comp.max() == 0:
         return np.zeros(0, np.int64), np.zeros(0, np.int64), np.zeros(0, np.float64)
     scanner = BoruvkaScanner(
-        data, core, metric, row_tile=row_tile, col_tile=col_tile, dtype=dtype
+        data, core, metric, row_tile=row_tile, col_tile=col_tile, dtype=dtype,
+        mesh=mesh,
     )
     parent = np.arange(n, dtype=np.int64)
 
@@ -315,12 +334,85 @@ def boruvka_glue_edges(
     )
 
 
+#: (mesh, metric, row_tile, col_tile) -> compiled sharded scan.
+_SHARDED_SCAN_CACHE: dict = {}
+
+
+def _min_outgoing_scan_sharded(
+    mesh, rows_sharding, data, core, comp, valid, metric: str, row_tile: int, col_tile: int
+):
+    """Mesh-parallel Borůvka scan: row shards per device, columns replicated.
+
+    Each device computes min-outgoing edges for its contiguous row block
+    against the FULL column set (``shard_map`` with replicated inputs and a
+    per-device row offset); no cross-device collective is needed because the
+    per-component reduction happens on host. Multi-chip analog of the
+    reference's ``mapPartitionsToPair`` row parallelism (SURVEY.md §2.C P1).
+    """
+    import math as _math
+
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    from hdbscan_tpu.parallel.mesh import BATCH_AXIS
+
+    n_dev = _math.prod(mesh.devices.shape)
+    n_pad = data.shape[0]
+    shard = n_pad // n_dev
+    key = (mesh, metric, row_tile, col_tile)
+    fn = _SHARDED_SCAN_CACHE.get(key)
+    if fn is None:
+
+        def per_device(data_f, core_f, comp_f, valid_f, row_off):
+            start = row_off[0]
+
+            def row_step(r):
+                return _min_out_row_block(
+                    data_f,
+                    core_f,
+                    comp_f,
+                    valid_f,
+                    start + r * row_tile,
+                    metric,
+                    row_tile,
+                    col_tile,
+                )
+
+            n_row_tiles = data_f.shape[0] // n_dev // row_tile
+            bw, bj = jax.lax.map(row_step, jnp.arange(n_row_tiles))
+            return bw.reshape(-1), bj.reshape(-1)
+
+        fn = jax.jit(
+            shard_map(
+                per_device,
+                mesh=mesh,
+                in_specs=(P(), P(), P(), P(), P(BATCH_AXIS)),
+                out_specs=(P(BATCH_AXIS), P(BATCH_AXIS)),
+            )
+        )
+        _SHARDED_SCAN_CACHE[key] = fn
+    offsets = jax.device_put(
+        np.arange(n_dev, dtype=np.int32) * shard, rows_sharding
+    )
+    return fn(data, core, comp, valid, offsets)
+
+
 class BoruvkaScanner:
     """Device-resident state for repeated Borůvka rounds over one dataset.
 
     Keeps the padded point matrix + core distances on device across rounds;
     only the (n,) component labels cross host<->device per round (the host
     does union-find merging between rounds — ``models/exact.py``).
+
+    ``mesh``: optional 1-D device mesh — the ROW axis of every scan shards
+    across it (each device scans its row block against the full replicated
+    column set; SURVEY.md §2.C P1 applied to the exact path). The per-point
+    results gather back to host where the per-component reduction happens, so
+    multi-chip scans need no cross-device collectives at all.
     """
 
     def __init__(
@@ -331,21 +423,49 @@ class BoruvkaScanner:
         row_tile: int = 1024,
         col_tile: int = 8192,
         dtype=np.float32,
+        mesh=None,
     ):
         n = len(data)
         self.n = n
         self.metric = metric
         self.row_tile, self.col_tile, n_pad = _tile_sizes(n, row_tile, col_tile)
+        self.mesh = mesh
+        if mesh is not None:
+            # The row axis must divide evenly into (devices x row_tile) slabs.
+            import math as _math
+
+            n_dev = _math.prod(mesh.devices.shape)
+            n_pad = _round_up(n_pad, n_dev * self.row_tile)
         self.n_pad = n_pad
-        self._data = jnp.asarray(_pad_rows(np.asarray(data, dtype), n_pad))
-        self._core = jnp.asarray(_pad_rows(np.asarray(core, dtype), n_pad))
-        self._valid = jnp.asarray(np.arange(n_pad) < n)
+        data_p = _pad_rows(np.asarray(data, dtype), n_pad)
+        core_p = _pad_rows(np.asarray(core, dtype), n_pad)
+        valid_p = np.arange(n_pad) < n
+        if mesh is None:
+            self._data, self._core, self._valid = jax.device_put(
+                (data_p, core_p, valid_p)
+            )
+            self._rows = None
+        else:
+            from hdbscan_tpu.parallel.mesh import replicated, row_sharding
+
+            rep = replicated(mesh)
+            rows = row_sharding(mesh)
+            self._data, self._core, self._valid = jax.device_put(
+                (data_p, core_p, valid_p), (rep, rep, rep)
+            )
+            self._rows = rows
 
     def min_outgoing(self, comp: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """(best_w, best_j) per point, edges leaving the point's component."""
-        comp_p = jnp.asarray(_pad_rows(np.asarray(comp, np.int32), self.n_pad))
-        bw, bj = jax.device_get(
-            _min_outgoing_scan(
+        comp_p = _pad_rows(np.asarray(comp, np.int32), self.n_pad)
+        if self.mesh is not None:
+            from hdbscan_tpu.parallel.mesh import replicated
+
+            comp_p = jax.device_put(comp_p, replicated(self.mesh))
+        else:
+            comp_p = jnp.asarray(comp_p)
+        if self.mesh is None:
+            out = _min_outgoing_scan(
                 self._data,
                 self._core,
                 comp_p,
@@ -354,7 +474,19 @@ class BoruvkaScanner:
                 self.row_tile,
                 self.col_tile,
             )
-        )
+        else:
+            out = _min_outgoing_scan_sharded(
+                self.mesh,
+                self._rows,
+                self._data,
+                self._core,
+                comp_p,
+                self._valid,
+                self.metric,
+                self.row_tile,
+                self.col_tile,
+            )
+        bw, bj = jax.device_get(out)
         return (
             np.asarray(bw, np.float64)[: self.n],
             np.asarray(bj, np.int64)[: self.n],

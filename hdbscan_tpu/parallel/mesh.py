@@ -30,6 +30,11 @@ def block_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(BATCH_AXIS))
 
 
+#: Leading-axis sharding for (n, ...) row sets (tiled-scan row shards) —
+#: identical placement to block_sharding; the alias names the intent.
+row_sharding = block_sharding
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     """Replicated sharding — broadcast arrays (sample matrices, models),
     the ``Broadcast``/driver-closure analog (SURVEY.md §2.C row P4)."""

@@ -1,0 +1,43 @@
+"""Mesh-sharded Borůvka scans must match the single-device scan exactly."""
+
+import numpy as np
+
+from hdbscan_tpu.ops.tiled import BoruvkaScanner
+from hdbscan_tpu.parallel.mesh import get_mesh
+from tests.conftest import make_blobs
+
+
+class TestShardedScanner:
+    def test_matches_single_device(self, rng):
+        pts, _ = make_blobs(rng, n=700, d=3, centers=3)
+        core = rng.uniform(0.0, 0.2, size=700)
+        comp = rng.integers(0, 9, size=700)
+        single = BoruvkaScanner(pts, core, row_tile=64, col_tile=128)
+        sharded = BoruvkaScanner(pts, core, row_tile=64, col_tile=128, mesh=get_mesh())
+        bw1, bj1 = single.min_outgoing(comp)
+        bw2, bj2 = sharded.min_outgoing(comp)
+        np.testing.assert_allclose(bw2, bw1, rtol=1e-6)
+        np.testing.assert_array_equal(bj2, bj1)
+
+    def test_glue_edges_on_mesh_match(self, rng):
+        from hdbscan_tpu.ops.tiled import boruvka_glue_edges
+
+        pts, _ = make_blobs(rng, n=500, d=2, centers=3)
+        groups = rng.integers(0, 4, size=500)
+        u1, v1, w1 = boruvka_glue_edges(pts, groups, "euclidean")
+        u2, v2, w2 = boruvka_glue_edges(pts, groups, "euclidean", mesh=get_mesh())
+        np.testing.assert_allclose(np.sort(w2), np.sort(w1), rtol=1e-6)
+
+    def test_exact_fit_on_mesh_matches(self, rng):
+        from hdbscan_tpu.config import HDBSCANParams
+        from hdbscan_tpu.models import exact
+        from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+
+        pts, _ = make_blobs(rng, n=400, d=3, centers=3)
+        params = HDBSCANParams(min_points=5, min_cluster_size=15)
+        single = exact.fit(pts, params)
+        sharded = exact.fit(pts, params, mesh=get_mesh(), row_tile=32, col_tile=128)
+        assert adjusted_rand_index(sharded.labels, single.labels) == 1.0
+        np.testing.assert_allclose(
+            np.sort(sharded.mst[2]), np.sort(single.mst[2]), rtol=1e-6
+        )
