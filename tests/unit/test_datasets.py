@@ -1,0 +1,29 @@
+"""Synthetic Gauss-family generators (paper evaluation shape)."""
+
+import numpy as np
+
+from hdbscan_tpu.config import HDBSCANParams
+from hdbscan_tpu.models import hdbscan
+from hdbscan_tpu.utils.datasets import GAUSS_CONFIGS, make_gauss, make_paper_gauss
+from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+
+
+class TestGaussGenerators:
+    def test_shapes_and_determinism(self):
+        pts, labels = make_gauss(500, dims=10, n_clusters=20, seed=3)
+        assert pts.shape == (500, 10) and labels.shape == (500,)
+        assert labels.min() >= 1 and labels.max() <= 20  # 1-based (0 = noise)
+        pts2, labels2 = make_gauss(500, dims=10, n_clusters=20, seed=3)
+        np.testing.assert_array_equal(pts, pts2)
+        np.testing.assert_array_equal(labels, labels2)
+
+    def test_paper_configs(self):
+        for name, k in GAUSS_CONFIGS.items():
+            _, labels = make_paper_gauss(name, 300, seed=1)
+            assert labels.max() <= k
+
+    def test_exact_recovers_well_separated_clusters(self):
+        pts, truth = make_gauss(1500, dims=10, n_clusters=5, separation=20.0, seed=0)
+        res = hdbscan.fit(pts, HDBSCANParams(min_points=5, min_cluster_size=30))
+        ari = adjusted_rand_index(res.labels, truth, noise_as_singletons=True)
+        assert ari > 0.95, f"exact ARI on separated gaussians too low: {ari}"
