@@ -38,6 +38,12 @@ import numpy as np
 from hdbscan_tpu.core.distances import pairwise_distance
 
 
+#: Max rows per k-NN-scan dispatch (pow2 so chunks divide the pow2 n_pad
+#: evenly — one compiled shape). Bounds single-program device runtime; a
+#: multi-minute program at n >= 1M can trip worker/tunnel deadlines.
+_DISPATCH_ROWS = 1 << 17
+
+
 def _pad_rows(a: np.ndarray, n_pad: int) -> np.ndarray:
     if len(a) == n_pad:
         return a
@@ -69,26 +75,31 @@ def _tile_sizes(n: int, row_tile: int, col_tile: int) -> tuple[int, int, int]:
     jax.jit, static_argnames=("k", "metric", "row_tile", "col_tile", "with_indices")
 )
 def _knn_core_scan(
-    data, valid, k: int, metric: str, row_tile: int, col_tile: int,
+    rows, data, valid, k: int, metric: str, row_tile: int, col_tile: int,
     with_indices: bool = False,
 ):
     """Per-row k smallest distances (self included), optionally with the
-    matching column indices.
+    matching column indices, for the row block ``rows`` against all of
+    ``data`` (callers pass the same array for a full self-scan, or device
+    slices to bound per-dispatch runtime — a single >1-minute device program
+    can trip worker/tunnel deadlines at large n).
 
-    Returns ((n_pad, k) ascending distances, (n_pad, k) int32 neighbor ids or
-    None); invalid rows give +inf / -1. Index tracking doubles the top_k
+    Returns ((rows, k) ascending distances, (rows, k) int32 neighbor ids or
+    None). Invalid COLUMNS are masked via ``valid``; pad ROWS are NOT masked
+    — they produce garbage entries that callers must slice off (everything
+    here is trimmed ``[:n]`` host-side). Index tracking doubles the top_k
     working set, so it is off unless a caller needs the k-NN graph. Ties
     break toward lower column ids, so for duplicate-bearing data a point's
     own id may be displaced by an earlier duplicate (only the distances are
     contract; the ids identify *some* k nearest columns).
     """
+    n_rows = rows.shape[0]
     n_pad = data.shape[0]
     n_col_tiles = n_pad // col_tile
     inf = jnp.array(jnp.inf, data.dtype)
 
     def row_step(r):
-        xr = jax.lax.dynamic_slice_in_dim(data, r * row_tile, row_tile)
-        vr = jax.lax.dynamic_slice_in_dim(valid, r * row_tile, row_tile)
+        xr = jax.lax.dynamic_slice_in_dim(rows, r * row_tile, row_tile)
 
         def tile_dist(c):
             xc = jax.lax.dynamic_slice_in_dim(data, c * col_tile, col_tile)
@@ -115,11 +126,9 @@ def _knn_core_scan(
                 jnp.full((row_tile, k), -1, jnp.int32),
             )
             best, bidx = jax.lax.fori_loop(0, n_col_tiles, col_step, init)
-            knn = -best  # top_k of -d is descending in -d => ascending in d
-            return (
-                jnp.where(vr[:, None], knn, inf),
-                jnp.where(vr[:, None], bidx, -1),
-            )
+            # top_k of -d is descending in -d => ascending in d. Rows beyond
+            # the caller's valid range produce garbage and are sliced off.
+            return -best, bidx
 
         def col_step(c, best):
             merged = jnp.concatenate([best, -tile_dist(c)], axis=1)
@@ -128,14 +137,14 @@ def _knn_core_scan(
         best = jax.lax.fori_loop(
             0, n_col_tiles, col_step, jnp.full((row_tile, k), -jnp.inf, data.dtype)
         )
-        return jnp.where(vr[:, None], -best, inf)
+        return -best
 
-    n_row_tiles = n_pad // row_tile
+    n_row_tiles = n_rows // row_tile
     if with_indices:
         out, out_i = jax.lax.map(row_step, jnp.arange(n_row_tiles))
-        return out.reshape(n_pad, k), out_i.reshape(n_pad, k)
+        return out.reshape(n_rows, k), out_i.reshape(n_rows, k)
     out = jax.lax.map(row_step, jnp.arange(n_row_tiles))
-    return out.reshape(n_pad, k), None
+    return out.reshape(n_rows, k), None
 
 
 def knn_core_distances(
@@ -162,20 +171,29 @@ def knn_core_distances(
     row_tile, col_tile, n_pad = _tile_sizes(n, row_tile, col_tile)
     data_p = jnp.asarray(_pad_rows(np.asarray(data, dtype), n_pad))
     valid_p = jnp.asarray(np.arange(n_pad) < n)
-    knn_j, idx_j = _knn_core_scan(
-        data_p, valid_p, k, metric, row_tile, col_tile, with_indices=return_indices
-    )
+    # Bound per-dispatch device runtime: one huge program (minutes at n >= 1M)
+    # can trip worker/tunnel deadlines. Row blocks of <= _DISPATCH_ROWS rows
+    # scan against the full column set; dispaches pipeline (JAX async).
+    chunk_rows = max(row_tile, min(_DISPATCH_ROWS, n_pad))
+    pending = []
+    for a in range(0, n_pad, chunk_rows):
+        b = min(a + chunk_rows, n_pad)
+        pending.append(
+            _knn_core_scan(
+                data_p[a:b], data_p, valid_p, k, metric, row_tile, col_tile,
+                with_indices=return_indices,
+            )
+        )
+    fetched = jax.device_get(pending)
+    knn = np.concatenate([np.asarray(c[0], np.float64) for c in fetched])[:n]
     if return_indices:
-        knn_h, idx = jax.device_get((knn_j, idx_j))
-        knn = np.asarray(knn_h, np.float64)[:n]
-    else:
-        knn = np.asarray(knn_j, np.float64)[:n]
+        idx = np.concatenate([np.asarray(c[1]) for c in fetched])[:n]
     if min_pts <= 1:
         core = np.zeros(n, np.float64)
     else:
         core = knn[:, min(min_pts - 1, n) - 1].copy()
     if return_indices:
-        return core, knn, np.asarray(idx, np.int64)[:n]
+        return core, knn, np.asarray(idx, np.int64)
     return core, knn
 
 

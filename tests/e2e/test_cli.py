@@ -1,0 +1,55 @@
+"""CLI driver end-to-end (the Main.main capability, argv honored)."""
+
+import os
+
+import numpy as np
+
+from hdbscan_tpu.cli import main
+
+
+class TestCLI:
+    def test_iris_exact_path(self, tmp_path, capsys):
+        rc = main(
+            [
+                "file=/root/reference/数据集/dataset.txt",
+                "minPts=4",
+                "minClSize=4",
+                "processing_units=200",
+                f"out_dir={tmp_path}",
+            ]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "exact" in out and "2 clusters" in out
+        for kind in ("hierarchy", "tree", "partition", "outlier_scores", "visualization"):
+            files = [f for f in os.listdir(tmp_path) if kind.split("_")[0] in f]
+            assert files, f"missing {kind} output"
+        # partition file round-trips to the expected labels
+        part = np.loadtxt(tmp_path / "dataset_partition.csv", delimiter=",")
+        assert part.shape == (150,)
+        assert set(np.unique(part)) == {2.0, 3.0}
+
+    def test_mr_path_with_flags(self, tmp_path, capsys):
+        rc = main(
+            [
+                "file=/root/reference/数据集/dataset.txt",
+                "minPts=4",
+                "minClSize=4",
+                "processing_units=60",
+                "k=0.2",
+                "variant=rs",
+                "dedup=true",
+                "seed=1",
+                f"out_dir={tmp_path}",
+            ]
+        )
+        assert rc == 0
+        assert "mr (" in capsys.readouterr().out
+
+    def test_bad_flag_errors(self, capsys):
+        assert main(["file=x", "bogus=1"]) == 2
+        assert "unknown flag" in capsys.readouterr().err
+
+    def test_help(self, capsys):
+        assert main(["--help"]) == 0
+        assert "minPts" in capsys.readouterr().out
