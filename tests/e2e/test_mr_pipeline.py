@@ -132,3 +132,22 @@ class TestForcedSplit:
         exact = hdbscan.fit(pts, params.replace(processing_units=1000))
         ari = adjusted_rand_index(mr.labels, exact.labels)
         assert ari > 0.2, f"ARI vs exact too low: {ari}"
+
+
+class TestAlternateMetrics:
+    def test_mr_pipeline_with_manhattan_metric(self, iris):
+        """Alternate distance plug-ins must flow through the WHOLE distributed
+        pipeline (blocks, bubbles, glue, refinement), not just the exact path."""
+        params = HDBSCANParams(
+            min_points=4,
+            min_cluster_size=4,
+            processing_units=50,
+            k=0.2,
+            seed=0,
+            dist_function="manhattan",
+        )
+        exact_res = hdbscan.fit(iris, params)
+        mr = mr_hdbscan.fit(iris, params)
+        assert mr.n_levels >= 2
+        ari = adjusted_rand_index(mr.labels, exact_res.labels)
+        assert ari > 0.5, f"manhattan MR vs exact ARI too low: {ari}"

@@ -89,3 +89,25 @@ def test_alternate_metrics_run(iris):
         res = model.fit(iris, params)
         oracle = O.hdbscan_oracle(iris, 4, 4, metric=metric)
         assert adjusted_rand_index(res.labels, oracle["labels"]) == 1.0
+
+
+class TestOutputFileContracts:
+    def test_tree_file_offsets_point_at_first_hierarchy_row(self, iris, tmp_path):
+        """The tree file's character offsets must land on the first hierarchy
+        row where each cluster's label appears (Cluster.java:165 contract)."""
+        from hdbscan_tpu.config import HDBSCANParams
+        from hdbscan_tpu.models import hdbscan
+        from hdbscan_tpu.utils import io as io_mod
+
+        params = HDBSCANParams(min_points=4, min_cluster_size=4)
+        res = hdbscan.fit(iris, params)
+        hpath = str(tmp_path / "h.csv")
+        offsets = io_mod.write_hierarchy_file(hpath, res.tree, compact=False)
+        blob = open(hpath).read()
+        for label, off in offsets.items():
+            line = blob[off:].split("\n", 1)[0]
+            labels_at_line = line.split(",")[1:]
+            assert str(label) in labels_at_line, (label, off)
+            # FIRST appearance: no earlier row may contain the label.
+            for prior in blob[:off].splitlines():
+                assert str(label) not in prior.split(",")[1:], (label, off)
