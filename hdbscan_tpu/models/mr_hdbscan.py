@@ -428,6 +428,7 @@ def _fit_rows(
         next_id = 0
         for ids in large:
             size = len(ids)
+            forced_before = forced
             s_count = min(size, max(2, math.ceil(params.k * size)))
             samp_local = rng.choice(size, s_count, replace=False)
             samples_global = ids[samp_local]
@@ -518,8 +519,27 @@ def _fit_rows(
 
             # Next-level subset = renumbered bubble group (LabelClassification
             # + driver renumbering analog).
-            subset[ids] = next_id + bubble_groups[assign]
-            next_id += int(bubble_groups.max()) + 1
+            pt_groups = bubble_groups[assign]
+            if np.bincount(pt_groups).max() >= size:
+                # Degenerate subset (e.g. all-identical points): every point
+                # lands in one group no matter how the model splits, so the
+                # recursion cannot make progress. Fall back to positional
+                # chunking, and pool explicit chain edges between consecutive
+                # chunks (true point distances — 0 for coincident points) so
+                # the chunks stay connected even in compat modes where the
+                # glue harvest is disabled (exact_inter_edges=False).
+                pt_groups = np.arange(size) // cap
+                if forced == forced_before:
+                    forced += 1  # not already counted by the forced-split path
+                from hdbscan_tpu.core.distances import rowwise_distance_np
+
+                heads = ids[np.arange(cap, size, cap)]
+                tails = ids[np.arange(cap, size, cap) - 1]
+                pool_u.append(tails)
+                pool_v.append(heads)
+                pool_w.append(rowwise_distance_np(data[tails], data[heads], metric))
+            subset[ids] = next_id + pt_groups
+            next_id += int(pt_groups.max()) + 1
 
         stats = LevelStats(
             level=level,

@@ -1,0 +1,83 @@
+"""Degenerate-input hardening: tiny n, identical points, single cluster."""
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.config import HDBSCANParams
+from hdbscan_tpu.models import exact, hdbscan, mr_hdbscan
+
+
+class TestTinyInputs:
+    def test_single_point(self):
+        res = hdbscan.fit(np.zeros((1, 3)), HDBSCANParams(min_points=1, min_cluster_size=1))
+        assert len(res.labels) == 1
+
+    def test_two_points(self):
+        res = hdbscan.fit(
+            np.array([[0.0, 0.0], [1.0, 0.0]]),
+            HDBSCANParams(min_points=2, min_cluster_size=1),
+        )
+        assert len(res.labels) == 2
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError):
+            hdbscan.fit(np.zeros((0, 2)), HDBSCANParams())
+        with pytest.raises(ValueError):
+            mr_hdbscan.fit(np.zeros((0, 2)), HDBSCANParams())
+
+    def test_min_pts_larger_than_n(self):
+        pts = np.random.default_rng(0).normal(size=(5, 2))
+        res = hdbscan.fit(pts, HDBSCANParams(min_points=10, min_cluster_size=2))
+        assert len(res.labels) == 5
+        assert np.all(np.isfinite(res.core_distances))
+
+
+class TestAllIdenticalPoints:
+    def test_exact_all_identical(self):
+        pts = np.ones((40, 3))
+        res = hdbscan.fit(pts, HDBSCANParams(min_points=4, min_cluster_size=4))
+        assert len(set(res.labels.tolist())) == 1  # one cluster (or all noise)
+
+    def test_dedup_all_identical(self):
+        pts = np.ones((40, 3))
+        res = exact.fit(pts, HDBSCANParams(min_points=4, min_cluster_size=4, dedup_points=True))
+        assert len(res.labels) == 40
+        assert np.all(res.core_distances == 0.0)
+
+    def test_mr_all_identical_terminates(self):
+        pts = np.ones((300, 2))
+        params = HDBSCANParams(min_points=4, min_cluster_size=4, processing_units=100, k=0.1)
+        res = mr_hdbscan.fit(pts, params)
+        assert len(res.labels) == 300
+
+
+class TestSingleColumn:
+    def test_1d_data(self):
+        pts = np.concatenate([np.zeros(50), np.ones(50) * 10])[:, None]
+        res = hdbscan.fit(pts, HDBSCANParams(min_points=3, min_cluster_size=5))
+        assert len(set(res.labels[res.labels > 0].tolist())) == 2
+
+
+class TestDegenerateGuardCompat:
+    def test_identical_points_connected_without_glue(self):
+        """Regression: positional-chunk fallback must pool chain edges so
+        coincident points stay one component even with the glue harvest
+        disabled (exact_inter_edges=False compat mode)."""
+        r = mr_hdbscan.fit(
+            np.ones((300, 2)),
+            HDBSCANParams(
+                min_points=4,
+                min_cluster_size=4,
+                processing_units=100,
+                k=0.1,
+                exact_inter_edges=False,
+            ),
+        )
+        assert len(set(r.labels.tolist())) == 1
+
+    def test_forced_splits_counted_once(self):
+        r = mr_hdbscan.fit(
+            np.ones((300, 2)),
+            HDBSCANParams(min_points=4, min_cluster_size=4, processing_units=100, k=0.1),
+        )
+        assert r.levels[0].forced_splits == 1
