@@ -535,9 +535,13 @@ def _fit_rows(
 
                 heads = ids[np.arange(cap, size, cap)]
                 tails = ids[np.arange(cap, size, cap) - 1]
+                cw = rowwise_distance_np(data[tails], data[heads], metric)
+                if global_core:
+                    # clamp to mutual reachability, as for sample inter-edges
+                    cw = np.maximum(cw, np.maximum(core[tails], core[heads]))
                 pool_u.append(tails)
                 pool_v.append(heads)
-                pool_w.append(rowwise_distance_np(data[tails], data[heads], metric))
+                pool_w.append(cw)
             subset[ids] = next_id + pt_groups
             next_id += int(pt_groups.max()) + 1
 

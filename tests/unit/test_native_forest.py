@@ -1,0 +1,79 @@
+"""Native merge-forest must match the pure-Python implementation exactly."""
+
+import os
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.core import tree as tree_mod
+from hdbscan_tpu.native import merge_forest_lib
+from tests.conftest import make_blobs
+
+
+def _python_forest(n, u, v, w, pw=None):
+    """Force the pure-Python path regardless of compiler availability."""
+    import hdbscan_tpu.native as native
+
+    saved = native._lib, native._lib_tried
+    native._lib, native._lib_tried = None, True
+    try:
+        return tree_mod.build_merge_forest(n, u, v, w, point_weights=pw)
+    finally:
+        native._lib, native._lib_tried = saved
+
+
+@pytest.mark.skipif(merge_forest_lib() is None, reason="no C compiler")
+class TestNativeMergeForest:
+    def _compare(self, n, u, v, w, pw=None):
+        a = _python_forest(n, u, v, w, pw)
+        b = tree_mod.build_merge_forest(n, u, v, w, point_weights=pw)
+        assert a.n_points == b.n_points
+        assert a.roots == b.roots
+        np.testing.assert_allclose(b.dist, a.dist)
+        np.testing.assert_allclose(b.sizes, a.sizes)
+        assert len(a.children) == len(b.children)
+        for ca, cb in zip(a.children, b.children):
+            if ca is None:
+                assert cb is None
+            else:
+                assert sorted(ca) == sorted(cb)
+
+    def test_random_edges(self, rng):
+        n = 200
+        u = rng.integers(0, n, 600)
+        v = rng.integers(0, n, 600)
+        w = rng.uniform(0, 5, 600)
+        keep = u != v
+        self._compare(n, u[keep], v[keep], w[keep])
+
+    def test_tie_heavy_lattice(self, rng):
+        """Integer-grid distances: massive tie groups exercise contraction."""
+        pts = rng.integers(0, 5, size=(150, 2)).astype(float)
+        d = np.abs(pts[:, None, :] - pts[None, :, :]).sum(-1)
+        iu, iv = np.triu_indices(150, 1)
+        sel = rng.choice(len(iu), 2000, replace=False)
+        self._compare(150, iu[sel], iv[sel], d[iu[sel], iv[sel]])
+
+    def test_weighted_points(self, rng):
+        pts, _ = make_blobs(rng, n=120, d=2, centers=3)
+        d = np.sqrt(((pts[:, None, :] - pts[None, :, :]) ** 2).sum(-1))
+        iu, iv = np.triu_indices(120, 1)
+        pw = rng.integers(1, 9, 120).astype(float)
+        self._compare(120, iu, iv, d[iu, iv], pw=pw)
+
+    def test_full_clustering_identical(self, rng):
+        """End-to-end labels must be identical through either implementation."""
+        from hdbscan_tpu.config import HDBSCANParams
+        from hdbscan_tpu.models import hdbscan
+
+        pts, _ = make_blobs(rng, n=400, d=3, centers=3)
+        import hdbscan_tpu.native as native
+
+        res_native = hdbscan.fit(pts, HDBSCANParams(min_points=5, min_cluster_size=10))
+        saved = native._lib, native._lib_tried
+        native._lib, native._lib_tried = None, True
+        try:
+            res_py = hdbscan.fit(pts, HDBSCANParams(min_points=5, min_cluster_size=10))
+        finally:
+            native._lib, native._lib_tried = saved
+        np.testing.assert_array_equal(res_native.labels, res_py.labels)
