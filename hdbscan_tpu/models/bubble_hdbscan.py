@@ -73,15 +73,27 @@ def _bubble_device_block(rep, extent, nn_dist, n_b, num_valid, min_pts: int, dim
     return dist, u, v, mask, packed
 
 
-def _unpack_bubble_block(packed: np.ndarray, m_pad: int):
+def unpack_edge_leaf(packed: np.ndarray, m_pad: int, with_n_b: bool):
+    """Split a packed [u | v | w | mask | core (| n_b)] device leaf.
+
+    One copy of the offset arithmetic for every fused block program that
+    packs its outputs into a single fetched leaf (`_bubble_device_block`,
+    `mr_hdbscan._rs_device_block`).
+    """
     e = m_pad - 1
     u = packed[:e].astype(np.int64)
     v = packed[e : 2 * e].astype(np.int64)
     w = packed[2 * e : 3 * e].astype(np.float64)
     mask = packed[3 * e : 4 * e] != 0
     core = packed[4 * e : 4 * e + m_pad].astype(np.float64)
+    if not with_n_b:
+        return u, v, w, mask, core
     n_b = packed[4 * e + m_pad :].astype(np.float64)
     return u, v, w, mask, core, n_b
+
+
+def _unpack_bubble_block(packed: np.ndarray, m_pad: int):
+    return unpack_edge_leaf(packed, m_pad, with_n_b=True)
 
 
 @jax.jit

@@ -202,13 +202,12 @@ def _fit_samples_rs(
     dist, u_d, v_d, mask_d, packed_d = _rs_device_block(
         jnp.asarray(x), jnp.int32(s), min_pts, metric
     )
-    packed = jax.device_get(packed_d)
-    e = s_pad - 1
-    u_p = packed[:e].astype(np.int64)
-    v_p = packed[e : 2 * e].astype(np.int64)
-    w_p = packed[2 * e : 3 * e].astype(np.float64)
-    mask = packed[3 * e : 4 * e] != 0
-    core_h = packed[4 * e :].astype(np.float64)[:s]
+    from hdbscan_tpu.models.bubble_hdbscan import unpack_edge_leaf
+
+    u_p, v_p, w_p, mask, core_p = unpack_edge_leaf(
+        jax.device_get(packed_d), s_pad, with_n_b=False
+    )
+    core_h = core_p[:s]
     u, v, w = u_p[mask], v_p[mask], w_p[mask]
 
     _, labels = tree_mod.extract_clusters(
@@ -325,10 +324,12 @@ def _fit_rows(
     level_stats: list[LevelStats] = []
     start_level = 0
     resumed = False
+    ckpt_digest = None
     if checkpoint_dir is not None:
         from hdbscan_tpu.utils import checkpoint as ckpt_mod
 
-        state = ckpt_mod.load_latest(checkpoint_dir, params, n)
+        ckpt_digest = ckpt_mod._data_digest(data)
+        state = ckpt_mod.load_latest(checkpoint_dir, params, n, ckpt_digest)
         if state is not None:
             resumed = True
             start_level = state["level"] + 1
@@ -572,6 +573,7 @@ def _fit_rows(
                 checkpoint_dir,
                 level,
                 params,
+                ckpt_digest,
                 subset,
                 processed,
                 core,

@@ -55,20 +55,28 @@ def _next_pow2(x: int) -> int:
     return 1 << max(0, (x - 1).bit_length())
 
 
-def _tile_sizes(n: int, row_tile: int, col_tile: int) -> tuple[int, int, int]:
+def _tile_sizes(
+    n: int, row_tile: int, col_tile: int, pad_pow2: bool = False
+) -> tuple[int, int, int]:
     """Clamp tiles to pow2 (so row_tile | col_tile) and compute n_pad.
 
     Keeping both tiles powers of two guarantees the row tile divides the
     column tile, so padding to one column tile suffices — padding to
     lcm(row, col) for arbitrary sizes can blow n_pad up by orders of
     magnitude. Minimums respect TPU layout (8 sublanes x 128 lanes).
-    n_pad itself is a power of two so repeated calls on shrinking datasets
-    (the per-level glue harvest) reuse a handful of compiled shapes.
+    ``pad_pow2`` additionally rounds n_pad to a power of two so REPEATED
+    calls on shrinking datasets (the per-level glue harvest) reuse a handful
+    of compiled shapes; one-shot full-dataset scans must NOT pay for it —
+    pow2 padding inflates the O(n_pad^2) scan work by up to ~4x for unlucky
+    n just above a power of two.
     """
     row_tile = _next_pow2(max(8, min(row_tile, n)))
     col_tile = _next_pow2(max(128, min(col_tile, n)))
     col_tile = max(col_tile, row_tile)
-    return row_tile, col_tile, _next_pow2(_round_up(n, col_tile))
+    n_pad = _round_up(n, col_tile)
+    if pad_pow2:
+        n_pad = _next_pow2(n_pad)
+    return row_tile, col_tile, n_pad
 
 
 @partial(
@@ -305,7 +313,7 @@ def boruvka_glue_edges(
         return np.zeros(0, np.int64), np.zeros(0, np.int64), np.zeros(0, np.float64)
     scanner = BoruvkaScanner(
         data, core, metric, row_tile=row_tile, col_tile=col_tile, dtype=dtype,
-        mesh=mesh,
+        mesh=mesh, pad_pow2=True,  # repeated per-level calls on shrinking n
     )
     parent = np.arange(n, dtype=np.int64)
 
@@ -442,11 +450,14 @@ class BoruvkaScanner:
         col_tile: int = 8192,
         dtype=np.float32,
         mesh=None,
+        pad_pow2: bool = False,
     ):
         n = len(data)
         self.n = n
         self.metric = metric
-        self.row_tile, self.col_tile, n_pad = _tile_sizes(n, row_tile, col_tile)
+        self.row_tile, self.col_tile, n_pad = _tile_sizes(
+            n, row_tile, col_tile, pad_pow2=pad_pow2
+        )
         self.mesh = mesh
         if mesh is not None:
             # The row axis must divide evenly into (devices x row_tile) slabs.

@@ -21,10 +21,24 @@ import numpy as np
 _PREFIX = "mr_level_"
 
 
-def _fingerprint(params, n: int) -> dict:
+def _data_digest(data) -> str:
+    """Cheap dataset identity: shape + a strided row sample, hashed. Catches
+    the silent-wrong-resume case where a checkpoint dir is reused across
+    different datasets of identical size."""
+    import hashlib
+
+    a = np.ascontiguousarray(data)
+    h = hashlib.sha1()
+    h.update(str(a.shape).encode())
+    h.update(a[:: max(1, len(a) // 64)].tobytes())
+    return h.hexdigest()[:16]
+
+
+def _fingerprint(params, n: int, data_digest: str | None = None) -> dict:
     """The parameters that must match for a checkpoint to be resumable."""
     return {
         "n": int(n),
+        "data": data_digest,
         "min_points": params.min_points,
         "min_cluster_size": params.min_cluster_size,
         "processing_units": params.processing_units,
@@ -41,6 +55,7 @@ def save_level(
     ckpt_dir: str,
     level: int,
     params,
+    data_digest: str,
     subset: np.ndarray,
     processed: np.ndarray,
     core: np.ndarray,
@@ -54,7 +69,7 @@ def save_level(
     os.makedirs(ckpt_dir, exist_ok=True)
     meta = {
         "level": level,
-        "fingerprint": _fingerprint(params, len(subset)),
+        "fingerprint": _fingerprint(params, len(subset), data_digest),
         "rng_state": rng_state,
         "level_stats": level_stats,
     }
@@ -80,7 +95,7 @@ def save_level(
     return path
 
 
-def load_latest(ckpt_dir: str, params, n: int) -> dict | None:
+def load_latest(ckpt_dir: str, params, n: int, data_digest: str | None = None) -> dict | None:
     """Newest matching checkpoint as a dict, or None.
 
     A checkpoint with a different parameter fingerprint raises — resuming a
@@ -96,7 +111,7 @@ def load_latest(ckpt_dir: str, params, n: int) -> dict | None:
     path = os.path.join(ckpt_dir, files[-1])
     with np.load(path) as z:
         meta = json.loads(bytes(z["meta"]).decode())
-        want = _fingerprint(params, n)
+        want = _fingerprint(params, n, data_digest)
         if meta["fingerprint"] != want:
             raise ValueError(
                 f"checkpoint {path} was written for {meta['fingerprint']}, "

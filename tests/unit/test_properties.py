@@ -1,0 +1,66 @@
+"""Property tests from the SURVEY §4 test-strategy list: ARI permutation
+invariance and hierarchy monotonicity (Prim-vs-Borůvka weight invariance and
+tie-order invariance live in test_mst.py / test_tree.py)."""
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.config import HDBSCANParams
+from hdbscan_tpu.models import hdbscan
+from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+from tests.conftest import make_blobs
+
+
+class TestARIProperties:
+    def test_label_permutation_invariant(self, rng):
+        # pure ARI (noise handling off): renaming labels must not move the
+        # score — with noise_as_singletons, label 0 is special by design.
+        a = rng.integers(0, 5, 400)
+        b = rng.integers(0, 4, 400)
+        base = adjusted_rand_index(a, b, noise_as_singletons=False)
+        perm = rng.permutation(6)
+        np.testing.assert_allclose(
+            adjusted_rand_index(perm[a], b, noise_as_singletons=False), base
+        )
+        np.testing.assert_allclose(
+            adjusted_rand_index(a, perm[:5][b], noise_as_singletons=False), base
+        )
+
+    def test_identity_and_symmetry(self, rng):
+        a = rng.integers(1, 5, 300)
+        b = rng.integers(1, 6, 300)
+        assert adjusted_rand_index(a, a) == 1.0
+        np.testing.assert_allclose(
+            adjusted_rand_index(a, b), adjusted_rand_index(b, a)
+        )
+
+    def test_noise_as_singletons_changes_score(self, rng):
+        a = rng.integers(0, 3, 300)  # 0 = noise
+        b = rng.integers(1, 4, 300)
+        with_noise = adjusted_rand_index(a, b, noise_as_singletons=True)
+        without = adjusted_rand_index(a, b, noise_as_singletons=False)
+        assert with_noise != without  # noise handling must matter
+
+
+@pytest.mark.parametrize("seed", [0, 3, 9])
+class TestHierarchyMonotonicity:
+    def test_tree_invariants(self, rng, seed):
+        r2 = np.random.default_rng(seed)
+        pts, _ = make_blobs(r2, n=300, d=3, centers=4, spread=0.2)
+        res = hdbscan.fit(pts, HDBSCANParams(min_points=4, min_cluster_size=6))
+        t = res.tree
+        for c in range(2, t.n_clusters + 1):
+            par = t.parent[c]
+            # a cluster is born when its parent splits: birth <= parent birth
+            assert t.birth[c] <= t.birth[par] or np.isinf(t.birth[par])
+            # clusters die at or below their birth level
+            if t.death[c] > 0:
+                assert t.death[c] <= t.birth[c] + 1e-12
+        # every point's exit level is at or below its deepest cluster's birth
+        for p_ in range(t.n_points):
+            c = t.point_last_cluster[p_]
+            if t.point_exit_level[p_] > 0 and np.isfinite(t.birth[c]):
+                assert t.point_exit_level[p_] <= t.birth[c] + 1e-12
+        # weighted member counts are monotone along parent chains
+        for c in range(2, t.n_clusters + 1):
+            assert t.num_members[c] <= t.num_members[t.parent[c]]
