@@ -215,8 +215,8 @@ def mst_edges_random_blocks(
     the faster way to the same tree.
     """
     from hdbscan_tpu.parallel.blocks import (
-        PackedBlocks,
         _next_pow2,
+        pack_blocks,
         run_packed_blocks,
     )
 
@@ -243,23 +243,26 @@ def mst_edges_random_blocks(
         ]
     cap = _next_pow2(max(len(b) for b in blocks))
     b = len(blocks)
-    x = np.zeros((b, cap, data.shape[1]), dtype)
-    cb = np.full((b, cap), np.inf, np.float64)
-    idx = np.full((b, cap), -1, np.int64)
-    nv = np.zeros(b, np.int32)
-    for i, ids in enumerate(blocks):
-        x[i, : len(ids)] = data[ids]
-        cb[i, : len(ids)] = core[ids]
-        idx[i, : len(ids)] = ids
-        nv[i] = len(ids)
-    packed = PackedBlocks(
-        x=x, num_valid=nv, point_index=idx, subset_ids=np.arange(b), core=cb
-    )
-    eu, ev, ew, _ = run_packed_blocks(packed, min_pts, metric)
-    if trace is not None:
-        trace("block_msts", edges=len(eu), blocks=b)
+    # B grows as C(n_parts, 2): at 1M points the full (B, cap, d) host tensor
+    # would be hundreds of GB. Pack and launch in streamed chunks instead,
+    # pooling the running MST after each chunk so host memory stays at
+    # O(n + chunk) regardless of B. The chunk budget counts all three packed
+    # arrays (x, core, point_index), which dominate at low d.
+    per_block = cap * (data.shape[1] * np.dtype(dtype).itemsize + 16)
+    chunk = max(1, int(2**28 // per_block))
+    data_c = data.astype(dtype, copy=False)
+    ku = kv = kw = None
+    for lo in range(0, b, chunk):
+        packed = pack_blocks(data_c, blocks[lo : lo + chunk], cap, core=core)
+        eu, ev, ew, _ = run_packed_blocks(packed, min_pts, metric)
+        if ku is not None:
+            eu = np.concatenate([ku, eu])
+            ev = np.concatenate([kv, ev])
+            ew = np.concatenate([kw, ew])
+        ku, kv, kw = pool_mst(eu, ev, ew, n)
+        if trace is not None:
+            trace("block_msts", blocks=min(lo + chunk, b), total_blocks=b)
 
-    ku, kv, kw = pool_mst(eu, ev, ew, n)
     return ku, kv, kw, core
 
 

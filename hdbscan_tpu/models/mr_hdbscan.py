@@ -443,7 +443,13 @@ def _fit_rows(
                     params.min_cluster_size,
                     metric,
                 )
-                weights_s = np.bincount(assign, minlength=s_count).astype(np.float64)
+                # Group sizes must count members, not vertices, when rows are
+                # deduplicated — matching the db path's weighted semantics.
+                weights_s = np.bincount(
+                    assign,
+                    weights=weights[ids] if weights is not None else None,
+                    minlength=s_count,
+                ).astype(np.float64)
             else:
                 # DB: summarize assigned points into data bubbles, cluster those.
                 # Pad bubble slots AND the point axis to pow2 so subsets of
@@ -599,6 +605,15 @@ def _fit_rows(
     from hdbscan_tpu.models._finalize import finalize_clustering
 
     def build_tree(u_, v_, w_):
+        if not global_core and len(w_):
+            # Without global cores the glue/refine harvests emit plain
+            # point distances (a lower bound of MRD). Every point's
+            # per-block core distance is known once the level loop ends,
+            # so clamp the whole pool to mutual reachability here: a merge
+            # below both endpoints' core distances cannot occur in a true
+            # HDBSCAN* hierarchy. Per-block MST edges already carry MRD
+            # weights >= both cores, so this is a no-op for them.
+            w_ = np.maximum(w_, np.maximum(core[u_], core[v_]))
         # Weighted vertices heavy enough to pass minClusterSize must dissolve
         # under tie contraction like their full-row counterparts — expand
         # them into unit pseudo-leaves before extraction (core/dedup.py).

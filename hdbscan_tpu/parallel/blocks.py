@@ -288,27 +288,28 @@ def run_packed_blocks(
     if with_core:
         core[:] = packed.core
 
-    def drain(pending):
+    def drain_one(start, real, out):
         # One batched fetch of one packed leaf per launch (each fetched leaf
         # pays a full host<->device round trip over the tunnel).
-        fetched = jax.device_get([p[2] for p in pending])
-        for (start, real, _), pk in zip(pending, fetched):
-            if with_core:
-                u, v, w, mask = unpack_block_mst_edges(pk, cap)
-            else:
-                u, v, w, mask, core_c = unpack_block_mst(pk, cap)
-                core[start : start + real] = core_c[:real]
-            for i in range(real):
-                m = mask[i]
-                ids = packed.point_index[start + i]
-                gu.append(ids[u[i][m]])
-                gv.append(ids[v[i][m]])
-                gw.append(w[i][m])
+        pk = jax.device_get(out)
+        if with_core:
+            u, v, w, mask = unpack_block_mst_edges(pk, cap)
+        else:
+            u, v, w, mask, core_c = unpack_block_mst(pk, cap)
+            core[start : start + real] = core_c[:real]
+        for i in range(real):
+            m = mask[i]
+            ids = packed.point_index[start + i]
+            gu.append(ids[u[i][m]])
+            gv.append(ids[v[i][m]])
+            gw.append(w[i][m])
 
     # Dispatch launches (JAX async) ahead of fetching so the device pipelines
-    # while the host feeds — but drain in bounded windows so resident
+    # while the host feeds — draining the OLDEST launch as soon as the window
+    # fills (rolling window, not drain-all): one launch computes while one
+    # drains, which is all the overlap the pipeline can use, and resident
     # inputs+outputs stay within ~2x the per-launch HBM budget.
-    max_inflight = 8
+    max_inflight = 2
     pending = []
     for start in range(0, b, chunk):
         x = packed.x[start : start + chunk]
@@ -336,10 +337,9 @@ def run_packed_blocks(
             out = block_mst_batch_packed(xj, nvj, min_pts, metric)
         pending.append((start, real, out))
         if len(pending) >= max_inflight:
-            drain(pending)
-            pending = []
-    if pending:
-        drain(pending)
+            drain_one(*pending.pop(0))
+    for p in pending:
+        drain_one(*p)
     return (
         np.concatenate(gu) if gu else np.zeros(0, np.int64),
         np.concatenate(gv) if gv else np.zeros(0, np.int64),

@@ -21,17 +21,26 @@ import numpy as np
 _PREFIX = "mr_level_"
 
 
+#: Digest scheme version. A checkpoint written under a different scheme is
+#: treated as absent (fresh start) rather than raising: the digest exists to
+#: catch silent wrong-data resumes, not to brick old checkpoint dirs.
+_DIGEST_SCHEME = "v2-"
+
+
 def _data_digest(data) -> str:
-    """Cheap dataset identity: shape + a strided row sample, hashed. Catches
-    the silent-wrong-resume case where a checkpoint dir is reused across
-    different datasets of identical size."""
+    """Dataset identity: shape + a hash over the full buffer. One sequential
+    pass (~6 MB for the 245k north-star set) is cheap next to any fit, and
+    unlike a strided row sample it catches edits anywhere in the data, so a
+    stale checkpoint can never resume silently. hashlib consumes the array
+    via the buffer protocol — no host-RAM copy of multi-GB datasets."""
     import hashlib
 
     a = np.ascontiguousarray(data)
     h = hashlib.sha1()
     h.update(str(a.shape).encode())
-    h.update(a[:: max(1, len(a) // 64)].tobytes())
-    return h.hexdigest()[:16]
+    h.update(str(a.dtype).encode())
+    h.update(a)
+    return _DIGEST_SCHEME + h.hexdigest()[:16]
 
 
 def _fingerprint(params, n: int, data_digest: str | None = None) -> dict:
@@ -108,15 +117,30 @@ def load_latest(ckpt_dir: str, params, n: int, data_digest: str | None = None) -
     )
     if not files:
         return None
-    path = os.path.join(ckpt_dir, files[-1])
+    # Newest-to-oldest: files written under an older digest scheme are
+    # unverifiable — skip them (rather than abort) so the newest
+    # verifiable checkpoint still resumes.
+    want = _fingerprint(params, n, data_digest)
+    path = meta = None
+    for name in reversed(files):
+        cand = os.path.join(ckpt_dir, name)
+        with np.load(cand) as z:
+            m = json.loads(bytes(z["meta"]).decode())
+        have = m["fingerprint"]
+        if data_digest is not None and (have.get("data") or "").partition("-")[0] != (
+            data_digest.partition("-")[0]
+        ):
+            continue
+        path, meta = cand, m
+        break
+    if path is None:
+        return None  # only older-scheme checkpoints present: start fresh
+    if meta["fingerprint"] != want:
+        raise ValueError(
+            f"checkpoint {path} was written for {meta['fingerprint']}, "
+            f"current run is {want}; pass a fresh checkpoint_dir"
+        )
     with np.load(path) as z:
-        meta = json.loads(bytes(z["meta"]).decode())
-        want = _fingerprint(params, n, data_digest)
-        if meta["fingerprint"] != want:
-            raise ValueError(
-                f"checkpoint {path} was written for {meta['fingerprint']}, "
-                f"current run is {want}; pass a fresh checkpoint_dir"
-            )
         return {
             "level": meta["level"],
             "rng_state": meta["rng_state"],
