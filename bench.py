@@ -35,9 +35,19 @@ MIN_PTS, MIN_CL_SIZE = 8, 3000
 
 
 def main() -> None:
+    import jax
+
     from hdbscan_tpu.config import HDBSCANParams
     from hdbscan_tpu.models import exact, mr_hdbscan
+    from hdbscan_tpu.parallel.mesh import get_mesh
     from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+
+    # Multi-chip-ready: on a host with >1 accelerator the same bench shards
+    # the scans and block batches over the full mesh (row shards over ICI);
+    # the single-chip path stays mesh-free (no shard_map overhead).
+    mesh = get_mesh() if len(jax.devices()) > 1 else None
+    if mesh is not None:
+        print(f"[bench] mesh: {mesh.devices.shape} devices", file=sys.stderr)
 
     raw = np.loadtxt(SKIN_PATH)
     data, truth = raw[:, :3], raw[:, 3].astype(np.int64)
@@ -53,9 +63,9 @@ def main() -> None:
     params = HDBSCANParams(
         min_points=MIN_PTS, min_cluster_size=MIN_CL_SIZE, dedup_points=True
     )
-    exact.fit(data, params)  # warm XLA compiles (persistent cache helps too)
+    exact.fit(data, params, mesh=mesh)  # warm XLA compiles (persistent cache helps too)
     t0 = time.monotonic()
-    r_exact = exact.fit(data, params)
+    r_exact = exact.fit(data, params, mesh=mesh)
     exact_wall = time.monotonic() - t0
     exact_ari = ari(r_exact.labels)
     print(
@@ -75,9 +85,9 @@ def main() -> None:
         seed=0,
         dedup_points=True,
     )
-    mr_hdbscan.fit(data, mr_params)  # warm full-shape compiles
+    mr_hdbscan.fit(data, mr_params, mesh=mesh)  # warm full-shape compiles
     t0 = time.monotonic()
-    r_mr = mr_hdbscan.fit(data, mr_params)
+    r_mr = mr_hdbscan.fit(data, mr_params, mesh=mesh)
     mr_wall = time.monotonic() - t0
     mr_ari = ari(r_mr.labels)
     print(

@@ -1,0 +1,82 @@
+"""Quality/wall evaluation of the boundary-aware hybrid mode (config.boundary_quality).
+
+Compares, on a Gauss-family synthetic (the paper's evaluation shape):
+  exact      — tiled global Borůvka (ground truth tree)
+  compat     — per-block cores, no glue, no refine (reference-faithful, weak)
+  boundary   — the hybrid: seam-margin boundary set, exact cores + glue on it
+  fullq      — global cores + full glue + refine (round-1 default, O(n²) heavy)
+
+Emits one JSON line per run: {config, n, dims, sep, wall_s, ari_truth, ari_exact}.
+Usage: python benchmarks/boundary_eval.py [n] [separation] [modes_csv]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+from hdbscan_tpu import HDBSCANParams
+from hdbscan_tpu.models import exact, mr_hdbscan
+from hdbscan_tpu.utils.datasets import make_gauss
+from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
+    sep = float(sys.argv[2]) if len(sys.argv) > 2 else 5.0
+    modes = (sys.argv[3] if len(sys.argv) > 3 else "exact,compat,bound05,fullq").split(",")
+    dims, n_clusters = 10, 30
+    cap = 65536 if n > 500_000 else 16384
+    mcs = max(64, n // 200)
+    data, y = make_gauss(n, dims=dims, n_clusters=n_clusters, separation=sep, seed=2)
+    base = dict(
+        min_points=8, min_cluster_size=mcs, processing_units=cap, seed=0, k=0.01
+    )
+
+    configs = {
+        "compat": dict(
+            global_core_distances=False, exact_inter_edges=False, refine_iterations=0
+        ),
+        "bound02": dict(boundary_quality=0.02),
+        "bound05": dict(boundary_quality=0.05),
+        "bound10": dict(boundary_quality=0.10),
+        "fullq": dict(),
+    }
+
+    # Exact labels persist across invocations so each mode can run in its own
+    # process (fresh device state) and still report ARI vs the exact tree.
+    import os
+
+    cache = f"/tmp/beval_exact_{n}_{sep}_{mcs}.npy"
+    exact_labels = np.load(cache) if os.path.exists(cache) else None
+    for mode in modes:
+        t0 = time.time()
+        if mode == "exact":
+            r = exact.fit(data, HDBSCANParams(**base))
+            exact_labels = r.labels
+            np.save(cache, exact_labels)
+        else:
+            r = mr_hdbscan.fit(data, HDBSCANParams(**base, **configs[mode]))
+        wall = time.time() - t0
+        rec = {
+            "config": mode,
+            "n": n,
+            "dims": dims,
+            "sep": sep,
+            "min_cluster_size": mcs,
+            "processing_units": cap,
+            "wall_s": round(wall, 2),
+            "ari_truth": round(float(adjusted_rand_index(r.labels, y)), 4),
+        }
+        if exact_labels is not None and mode != "exact":
+            rec["ari_exact"] = round(
+                float(adjusted_rand_index(r.labels, exact_labels)), 4
+            )
+        print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

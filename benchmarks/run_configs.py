@@ -6,9 +6,12 @@ Run on the real TPU chip (do not force CPU):
 
 Configs (BASELINE.json "configs"):
   1. HDBSCAN* single-partition Euclidean (dataset.txt, minPts=4)
-  2. HDBSCAN* (exact, blocked Borůvka) Euclidean on Skin_NonSkin, minPts=16
-  3. MR-HDBSCAN* with data bubbles + recursive-sampling partitioner
-  4. Alternate distance plug-ins: Manhattan + cosine
+  2. HDBSCAN* (exact, blocked Borůvka) Euclidean on Skin_NonSkin —
+     TWO rows: literal (minPts=16) and calibrated (minPts=8 + dedup)
+  3. MR-HDBSCAN* with data bubbles + recursive-sampling partitioner —
+     TWO rows: literal (8 partitions, minPts=16) and calibrated
+  4. Alternate distance plug-ins: Manhattan (Skin 8k) + cosine on a
+     directional set (Skin cosine is degenerate — see the config 4 comment)
   5. 64-partition random split with inter-partition MST merge
 
 Reference wall-clock baselines (BASELINE.md, seconds): Skin DB = 60.19,
@@ -95,55 +98,118 @@ def main() -> None:
             clusters=len(set(r.labels[r.labels > 0].tolist())),
         )
 
+    # Configs 2 and 3 emit TWO rows each (the unified benchmark story,
+    # VERDICT r1 item 4): "literal" = the BASELINE.json parameterization
+    # verbatim (minPts=16 / 8-partition capacity, rows as-is), "calibrated" =
+    # the macro-structure setting the headline bench uses (minPts=8,
+    # dedup_points — chosen against ground truth and labeled as such).
     if 2 in which:
-        params = HDBSCANParams(min_points=16, min_cluster_size=SKIN_MCS)
-        exact.fit(skin, params)  # warm (all configs time warm-compile runs)
-        t0 = time.monotonic()
-        r = exact.fit(skin, params)
-        emit(
-            "skin_exact_rb",
-            time.monotonic() - t0,
-            SKIN_RB_BASELINE,
-            ari=ari(r.labels),
-        )
+        for tag, params in (
+            (
+                "literal",
+                HDBSCANParams(min_points=16, min_cluster_size=SKIN_MCS),
+            ),
+            (
+                "calibrated",
+                HDBSCANParams(
+                    min_points=SKIN_MP, min_cluster_size=SKIN_MCS, dedup_points=True
+                ),
+            ),
+        ):
+            exact.fit(skin, params)  # warm (all configs time warm-compile runs)
+            t0 = time.monotonic()
+            r = exact.fit(skin, params)
+            emit(
+                f"skin_exact_rb_{tag}",
+                time.monotonic() - t0,
+                SKIN_RB_BASELINE,
+                ari=ari(r.labels),
+                min_points=params.min_points,
+                dedup=params.dedup_points,
+            )
 
     if 3 in which:
-        params = HDBSCANParams(
-            min_points=SKIN_MP,
-            min_cluster_size=SKIN_MCS,
-            processing_units=8192,
-            k=0.01,
-            seed=0,
-        )
-        mr_hdbscan.fit(skin, params)  # warm (full shapes)
-        t0 = time.monotonic()
-        r = mr_hdbscan.fit(skin, params)
-        emit(
-            "skin_mr_db",
-            time.monotonic() - t0,
-            SKIN_DB_BASELINE,
-            ari=ari(r.labels),
-            levels=r.n_levels,
-        )
+        for tag, params in (
+            (
+                "literal",  # 8 partitions of the 245k rows, as BASELINE.json
+                HDBSCANParams(
+                    min_points=16,
+                    min_cluster_size=SKIN_MCS,
+                    processing_units=32768,
+                    k=0.01,
+                    seed=0,
+                ),
+            ),
+            (
+                "calibrated",  # the headline bench's DB setting
+                HDBSCANParams(
+                    min_points=SKIN_MP,
+                    min_cluster_size=SKIN_MCS,
+                    processing_units=8192,
+                    k=0.03,
+                    seed=0,
+                    dedup_points=True,
+                ),
+            ),
+        ):
+            mr_hdbscan.fit(skin, params)  # warm (full shapes)
+            t0 = time.monotonic()
+            r = mr_hdbscan.fit(skin, params)
+            emit(
+                f"skin_mr_db_{tag}",
+                time.monotonic() - t0,
+                SKIN_DB_BASELINE,
+                ari=ari(r.labels),
+                levels=r.n_levels,
+                min_points=params.min_points,
+                processing_units=params.processing_units,
+                dedup=params.dedup_points,
+            )
 
     if 4 in which:
         sub = skin[:: max(1, len(skin) // 8192)]
         sub_truth = truth[:: max(1, len(skin) // 8192)]
-        for metric in ("manhattan", "cosine"):
+        params = HDBSCANParams(
+            min_points=8, min_cluster_size=100, dist_function="manhattan"
+        )
+        hdbscan.fit(sub, params)  # warm
+        t0 = time.monotonic()
+        r = hdbscan.fit(sub, params)
+        emit(
+            "skin8k_manhattan",
+            time.monotonic() - t0,
+            None,
+            ari=round(
+                adjusted_rand_index(r.labels, sub_truth, noise_as_singletons=True), 4
+            ),
+        )
+        # Cosine on Skin is DEGENERATE (resolved r1 finding): RGB rows are
+        # near-collinear rays — 13.8% of pairs sit at cosine distance < 1e-3,
+        # minPts=16 cosine core distances are ~1e-5, and 256 all-zero rows
+        # have no direction at all — so every cosine clustering of Skin
+        # collapses to one cluster (ARI 0 regardless of implementation; see
+        # utils/datasets.make_directional docstring for the numbers). The
+        # cosine plug-in leg therefore runs on a dataset whose structure IS
+        # angular: direction clusters with random magnitudes, where cosine
+        # separates cleanly and Euclidean cannot.
+        from hdbscan_tpu.utils.datasets import make_directional
+
+        dpts, dtruth = make_directional(8192, dims=8, n_clusters=6, seed=0)
+        for metric in ("cosine", "euclidean"):
             params = HDBSCANParams(
                 min_points=8, min_cluster_size=100, dist_function=metric
             )
-            hdbscan.fit(sub, params)  # warm
+            hdbscan.fit(dpts, params)  # warm
             t0 = time.monotonic()
-            r = hdbscan.fit(sub, params)
+            r = hdbscan.fit(dpts, params)
             emit(
-                f"skin8k_{metric}",
+                f"directional8k_{metric}",
                 time.monotonic() - t0,
                 None,
                 ari=round(
-                    adjusted_rand_index(r.labels, sub_truth, noise_as_singletons=True),
-                    4,
+                    adjusted_rand_index(r.labels, dtruth, noise_as_singletons=True), 4
                 ),
+                note="cosine plug-in leg; Skin cosine is degenerate (see comment)",
             )
 
     if 5 in which:

@@ -1,0 +1,90 @@
+"""Seed-sweep quality harness — mean (stddev) ARI over many seeds.
+
+The paper reports stochastic-variant quality as mean (stddev) over 45 runs
+(ResearchReport.pdf §5.2; BASELINE.md Table 2: DB stddev <= 0.015, RS <=
+0.025). Round 1 quoted single-seed anecdotes; this harness measures the same
+protocol: the DB and RS variants, >= 10 seeds each, on the bundled Skin set
+and the Gauss synthetic family.
+
+Emits one JSON line per (dataset, variant) with mean/std ARI + wall stats.
+Usage: python benchmarks/seed_sweep.py [n_seeds] [dataset1,dataset2,...]
+Datasets: skin | gauss200k. Results land in benchmarks/seed_sweep_r2.jsonl
+via shell redirection.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+from hdbscan_tpu import HDBSCANParams
+from hdbscan_tpu.models import mr_hdbscan
+from hdbscan_tpu.utils.datasets import make_gauss
+from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+from hdbscan_tpu.utils.io import load_points
+
+SKIN_PATH = "/root/reference/数据集/Skin_NonSkin.txt"
+
+
+def load_dataset(name: str):
+    if name == "skin":
+        raw = load_points(SKIN_PATH)
+        data, truth = raw[:, :3], raw[:, 3].astype(np.int64)
+        params = dict(
+            min_points=8,
+            min_cluster_size=3000,
+            processing_units=8192,
+            k=0.03,
+            dedup_points=True,
+        )
+    elif name == "gauss200k":
+        data, truth = make_gauss(200_000, dims=10, n_clusters=20, seed=7)
+        params = dict(
+            min_points=8, min_cluster_size=1000, processing_units=16384, k=0.01
+        )
+    else:
+        raise ValueError(f"unknown dataset {name!r}")
+    return data, truth, params
+
+
+def main() -> None:
+    n_seeds = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    datasets = (sys.argv[2] if len(sys.argv) > 2 else "skin,gauss200k").split(",")
+
+    for ds in datasets:
+        data, truth, base = load_dataset(ds)
+        for variant in ("db", "rs"):
+            aris, walls = [], []
+            for seed in range(n_seeds):
+                p = HDBSCANParams(**base, variant=variant, seed=seed)
+                t0 = time.time()
+                r = mr_hdbscan.fit(data, p)
+                walls.append(time.time() - t0)
+                aris.append(
+                    float(
+                        adjusted_rand_index(
+                            r.labels, truth, noise_as_singletons=True
+                        )
+                    )
+                )
+            rec = {
+                "dataset": ds,
+                "variant": variant,
+                "n": len(data),
+                "n_seeds": n_seeds,
+                "ari_mean": round(float(np.mean(aris)), 4),
+                "ari_std": round(float(np.std(aris)), 4),
+                "ari_min": round(float(np.min(aris)), 4),
+                "ari_max": round(float(np.max(aris)), 4),
+                "wall_mean_s": round(float(np.mean(walls)), 2),
+                "wall_std_s": round(float(np.std(walls)), 2),
+                "params": {k: v for k, v in base.items()},
+            }
+            print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

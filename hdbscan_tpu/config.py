@@ -63,6 +63,19 @@ class HDBSCANParams:
     #: whole region into a later merge wave and flips the flat cut. 0
     #: disables (reference-faithful: the reference never refines).
     refine_iterations: int = 1
+    #: Boundary-aware hybrid quality mode (sub-quadratic at DB quality).
+    #: When > 0: the fraction of each final block treated as "boundary" —
+    #: points whose seam margin (distance to the nearest other-subset sample
+    #: minus distance to their own, recorded at every level's assignment) is
+    #: smallest. Only those m = boundary_quality·n points pay exact global
+    #: core distances (one O(m·n·d) scan) and host the inter-block Borůvka
+    #: glue + refinement (O(m²·d) per round); interior points keep per-block
+    #: cores (their k-NN ball is inside their block by construction), and the
+    #: whole pooled edge set is re-weighted to mutual reachability under the
+    #: hybrid core vector. Supersedes ``global_core_distances`` and the
+    #: per-level full-set glue scans, replacing every O(n²·d) quality pass —
+    #: the scale mode for the paper's 8-11.6M-row datasets (BASELINE.md).
+    boundary_quality: float = 0.0
     #: Collapse duplicate rows into weighted unique points before the exact
     #: pipeline (``core/dedup.py``). Semantics-preserving (a duplicate group
     #: is a zero-extent bubble; the member-weighted tree equals the full-row
@@ -85,6 +98,14 @@ class HDBSCANParams:
             raise ValueError("processing_units must be >= 1")
         if self.variant not in ("db", "rs"):
             raise ValueError(f"variant must be 'db' or 'rs', got {self.variant!r}")
+        if not (0.0 <= self.boundary_quality < 1.0):
+            raise ValueError("boundary_quality must be in [0, 1)")
+        if self.boundary_quality > 0 and self.dedup_points:
+            raise ValueError(
+                "boundary_quality and dedup_points are mutually exclusive "
+                "(dedup requires global core distances; boundary mode "
+                "replaces them)"
+            )
 
     @property
     def base_name(self) -> str:
@@ -124,6 +145,7 @@ class HDBSCANParams:
             "exact_inter_edges": ("exact_inter_edges", lambda s: s.lower() == "true"),
             "global_cores": ("global_core_distances", lambda s: s.lower() == "true"),
             "refine": ("refine_iterations", int),
+            "boundary": ("boundary_quality", float),
         }
         kwargs = {}
         for arg in argv:

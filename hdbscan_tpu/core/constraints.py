@@ -72,14 +72,46 @@ def load_constraints(path: str) -> list[Constraint]:
     return out
 
 
-def _ancestor_chains(tree: CondensedTree) -> list[set]:
-    """chains[c] = set of ancestor-or-self labels of cluster c (root included)."""
-    c = tree.n_clusters
-    chains: list[set] = [set() for _ in range(c + 1)]
-    for label in range(1, c + 1):
-        par = int(tree.parent[label])
-        chains[label] = {label} | (chains[par] if par > 0 else set())
-    return chains
+def _lca_vectorized(parent: np.ndarray, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Lowest common ancestor for label-pair arrays via binary lifting.
+
+    ``parent[c] < c`` holds by construction of the condensed tree's labeling
+    (children are created after their parent), ``parent[root] <= 0``. Cost:
+    an (K, C) ancestor table with K = ceil(log2 max_depth), then O(K) vector
+    ops per pair array — millions of constraints resolve in milliseconds.
+    """
+    c_count = len(parent) - 1
+    depth = np.zeros(c_count + 1, np.int64)
+    up0 = np.arange(c_count + 1, dtype=np.int64)
+    for c in range(2, c_count + 1):
+        p = int(parent[c])
+        if p > 0:
+            depth[c] = depth[p] + 1
+            up0[c] = p
+    k_levels = max(1, int(depth.max()).bit_length())
+    up = np.empty((k_levels, c_count + 1), np.int64)
+    up[0] = up0
+    for k in range(1, k_levels):
+        up[k] = up[k - 1][up[k - 1]]
+
+    a = a.copy()
+    b = b.copy()
+    # Equalize depths (lift the deeper side by the depth difference, one
+    # binary digit per table level).
+    diff = depth[a] - depth[b]
+    ha = np.maximum(diff, 0)
+    hb = np.maximum(-diff, 0)
+    for k in range(k_levels):
+        bit = 1 << k
+        a = np.where(ha & bit != 0, up[k][a], a)
+        b = np.where(hb & bit != 0, up[k][b], b)
+    # Simultaneous binary descent: keep lifting both while ancestors differ.
+    neq = a != b
+    for k in range(k_levels - 1, -1, -1):
+        lift = neq & (up[k][a] != up[k][b])
+        a = np.where(lift, up[k][a], a)
+        b = np.where(lift, up[k][b], b)
+    return np.where(neq, up[0][a], a)
 
 
 def count_constraints_satisfied(
@@ -90,39 +122,50 @@ def count_constraints_satisfied(
     Feed the first array to ``propagate_tree`` (constraint satisfaction
     dominates stability in EOM competition, ``Cluster.java:114-142``); the
     second is the tree file's vGamma column.
+
+    Fully vectorized: the per-constraint ancestor-chain walks reduce to LCA
+    algebra. A must-link credits every label on chain(a) ∩ chain(b) =
+    ancestors-or-self of LCA — +2 placed at the LCA. A cannot-link credits
+    chain(a) Δ chain(b) — +1 at each endpoint's deepest cluster, −2 at the
+    LCA (root always cancels, matching the reference's pre-loop crediting,
+    ``HDBSCANStar.java:241-244``). One bottom-up subtree-sum then turns the
+    point credits into per-label chain sums. O(P·log D + C) total instead of
+    O(P·D) chain walks.
     """
-    c = tree.n_clusters
-    num = np.zeros(c + 1, np.int64)
-    vnum = np.zeros(c + 1, np.int64)
+    c_count = tree.n_clusters
+    num = np.zeros(c_count + 1, np.int64)
+    vnum = np.zeros(c_count + 1, np.int64)
     if not constraints:
         return num, vnum
-    chains = _ancestor_chains(tree)
     last = tree.point_last_cluster
+    pa = np.array([c.point_a for c in constraints], np.int64)
+    pb = np.array([c.point_b for c in constraints], np.int64)
+    is_ml = np.array([c.kind == MUST_LINK for c in constraints], bool)
+    la, lb = last[pa], last[pb]
+    lca = _lca_vectorized(tree.parent, la, lb)
 
-    for con in constraints:
-        pa, pb = int(con.point_a), int(con.point_b)
-        chain_a = chains[int(last[pa])]
-        chain_b = chains[int(last[pb])]
-        if con.kind == MUST_LINK:
-            # Root included: the reference pre-credits cluster 1 before the
-            # hierarchy loop (HDBSCANStar.java:241-244) — every must-link
-            # earns root +2 while all points are labeled 1.
-            for lbl in chain_a & chain_b:
-                num[lbl] += 2
-        else:
-            # Root never appears in a chain difference (it is in every
-            # chain), matching the reference: labelA == labelB == 1 at the
-            # pre-loop call, so cannot-links earn root nothing.
-            for lbl in chain_a - chain_b:
-                num[lbl] += 1
-            for lbl in chain_b - chain_a:
-                num[lbl] += 1
-            # Noise endpoints credit the virtual child of the cluster the
-            # point went noise from (its deepest cluster) — but only if that
-            # cluster split, mirroring the reference's parents-of-new-clusters
-            # scoping (HDBSCANStar.java:744-750,765-781).
-            for p in (pa, pb):
-                lbl = int(last[p])
-                if tree.has_children[lbl]:
-                    vnum[lbl] += 1
+    # Credits placed at tree nodes; the subtree-sum below distributes each
+    # credit to every ancestor-or-self label.
+    credit = np.zeros(c_count + 1, np.int64)
+    np.add.at(credit, lca[is_ml], 2)
+    cl = ~is_ml
+    np.add.at(credit, la[cl], 1)
+    np.add.at(credit, lb[cl], 1)
+    np.add.at(credit, lca[cl], -2)
+    # parent[c] < c, so one descending pass accumulates whole subtrees.
+    for c in range(c_count, 1, -1):
+        p = int(tree.parent[c])
+        if p > 0:
+            credit[p] += credit[c]
+    num = credit
+    num[0] = 0
+
+    # Noise endpoints credit the virtual child of the cluster the point went
+    # noise from (its deepest cluster) — but only if that cluster split,
+    # mirroring the reference's parents-of-new-clusters scoping
+    # (HDBSCANStar.java:744-750,765-781).
+    ends = np.concatenate([la[cl], lb[cl]])
+    ends = ends[tree.has_children[ends]]
+    np.add.at(vnum, ends, 1)
+    vnum[0] = 0
     return num, vnum

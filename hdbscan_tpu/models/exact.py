@@ -33,8 +33,7 @@ from hdbscan_tpu.models.hdbscan import HDBSCANResult
 from hdbscan_tpu.ops.tiled import BoruvkaScanner, knn_core_distances
 
 
-from hdbscan_tpu.utils.unionfind import find as _find
-from hdbscan_tpu.utils.unionfind import flatten_parents as _flatten_parents
+from hdbscan_tpu.utils.unionfind import contract_min_edges as _contract
 
 
 def mst_edges(
@@ -95,7 +94,6 @@ def mst_edges_from_core(
         mesh=mesh,
     )
 
-    parent = np.arange(n, dtype=np.int64)
     comp = np.arange(n, dtype=np.int64)
     eu, ev, ew = [], [], []
     n_comp = n
@@ -103,38 +101,22 @@ def mst_edges_from_core(
         if n_comp <= 1:
             break
         bw, bj = scanner.min_outgoing(comp)
-        has = bj >= 0
-        if not has.any():
+        # Fully vectorized per-component selection + union (SURVEY.md §2.C
+        # row P9's host side): no per-edge Python even with millions of
+        # components in the early rounds.
+        emit, comp, new_count = _contract(comp, bj, bw)
+        if len(emit) == 0:
             break  # disconnected pool (cannot happen for a full metric space)
-        # Per-component minimum outgoing candidate, ties broken by (w, i, j)
-        # so the MST is reproducible across tilings and round orderings.
-        ids = np.nonzero(has)[0]
-        order = np.lexsort((bj[ids], ids, bw[ids]))
-        ids = ids[order]
-        _, first = np.unique(comp[ids], return_index=True)
-        added = 0
-        for i_ in ids[first]:
-            ra, rb = _find(parent, int(i_)), _find(parent, int(bj[i_]))
-            if ra == rb:
-                continue  # two components picked the same (tied) edge
-            parent[rb] = ra
-            eu.append(int(i_))
-            ev.append(int(bj[i_]))
-            ew.append(float(bw[i_]))
-            added += 1
-        n_comp -= added
-        # Relabel components for the next device round (vectorized pointer
-        # jumping — SURVEY.md §2.C row P9's min-label propagation, host side).
-        parent = _flatten_parents(parent)
-        comp = parent
+        eu.append(emit)
+        ev.append(bj[emit])
+        ew.append(bw[emit])
+        n_comp = new_count
         if trace is not None:
-            trace("boruvka_round", round=rnd, components=n_comp, edges_added=added)
-        if added == 0:
-            break
+            trace("boruvka_round", round=rnd, components=n_comp, edges_added=len(emit))
     return (
-        np.asarray(eu, np.int64),
-        np.asarray(ev, np.int64),
-        np.asarray(ew, np.float64),
+        np.concatenate(eu) if eu else np.zeros(0, np.int64),
+        np.concatenate(ev) if ev else np.zeros(0, np.int64),
+        np.concatenate(ew) if ew else np.zeros(0, np.float64),
     )
 
 
@@ -159,30 +141,38 @@ def pool_mst(
     u, v, w = u[order], v[order], w[order]
     for _ in range(64):
         cu, cv = comp[u], comp[v]
-        out = cu != cv
-        if not out.any():
+        out = np.nonzero(cu != cv)[0]
+        if len(out) == 0:
             break
-        eu, ev, ew_, cu_ = u[out], v[out], w[out], cu[out]
-        cv_ = cv[out]
-        # First pool edge (in sorted order) per component, from either side.
-        cc = np.concatenate([cu_, cv_])
-        ee = np.tile(np.arange(len(eu)), 2)
+        # First pool edge (in sorted order) per component, from either side;
+        # the winner becomes that component's candidate, attached to its
+        # representative vertex (comp labels ARE root vertex ids here).
+        cc = np.concatenate([cu[out], cv[out]])
+        ee = np.tile(out, 2)
         ord2 = np.lexsort((ee, cc))
-        cc, ee = cc[ord2], ee[ord2]
-        first = np.concatenate([[True], np.diff(cc) != 0])
-        picks = np.unique(ee[first])
-        # Union the picked edges (loop over <= #components picks).
-        parent = comp.copy()
-        for i_ in picks:
-            ra, rb = _find(parent, int(eu[i_])), _find(parent, int(ev[i_]))
-            if ra == rb:
-                continue
-            parent[rb] = ra
-            su.append(int(eu[i_]))
-            sv.append(int(ev[i_]))
-            sw.append(float(ew_[i_]))
-        comp = _flatten_parents(parent)
-    return np.asarray(su, np.int64), np.asarray(sv, np.int64), np.asarray(sw)
+        cc_, ee_ = cc[ord2], ee[ord2]
+        first = np.concatenate([[True], np.diff(cc_) != 0])
+        reps, picks = cc_[first], ee_[first]
+        cand_j = np.full(n, -1, np.int64)
+        cand_w = np.zeros(n, np.float64)
+        edge_map = np.full(n, -1, np.int64)
+        # Point each rep at the OTHER side's rep vertex.
+        other = np.where(cu[picks] == reps, cv[picks], cu[picks])
+        cand_j[reps] = other
+        cand_w[reps] = w[picks]
+        edge_map[reps] = picks
+        emit, comp, _ = _contract(comp, cand_j, cand_w)
+        if len(emit) == 0:
+            break
+        e = edge_map[emit]
+        su.append(u[e])
+        sv.append(v[e])
+        sw.append(w[e])
+    return (
+        np.concatenate(su) if su else np.zeros(0, np.int64),
+        np.concatenate(sv) if sv else np.zeros(0, np.int64),
+        np.concatenate(sw) if sw else np.zeros(0, np.float64),
+    )
 
 
 def mst_edges_random_blocks(

@@ -93,6 +93,55 @@ class MRHDBSCANResult:
     dedup_inverse: np.ndarray | None = None
 
 
+def _select_boundary(
+    margin: np.ndarray,
+    subset: np.ndarray,
+    q: float,
+    min_per_block: int = 32,
+) -> np.ndarray:
+    """Boundary-point ids: per final block, the smallest-margin members.
+
+    Per-block quantile selection (the lowest ``q`` fraction, floored at
+    ``min_per_block``) is density-adaptive — a global margin threshold would
+    mix distance scales across blocks of different density — and guarantees
+    every block contributes glue representatives, keeping the inter-block
+    harvest connected.
+    """
+    n = len(margin)
+    _, inv = np.unique(subset, return_inverse=True)
+    counts = np.bincount(inv)
+    take = np.maximum(
+        np.minimum(counts, min_per_block), np.ceil(q * counts).astype(np.int64)
+    )
+    order = np.lexsort((margin, inv))  # by block, then ascending margin
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    rank = np.empty(n, np.int64)
+    rank[order] = np.arange(n) - np.repeat(starts, counts)
+    return np.nonzero(rank < take[inv])[0]
+
+
+def _reweight_pool(
+    u: np.ndarray,
+    v: np.ndarray,
+    w: np.ndarray,
+    data: np.ndarray,
+    core: np.ndarray,
+    metric: str,
+    chunk: int = 1 << 20,
+) -> np.ndarray:
+    """Recompute every pooled edge's weight as exact mutual reachability under
+    the current core vector: max(d(u,v), core_u, core_v). Chunked rowwise so
+    host memory stays O(chunk·d) at any pool size."""
+    from hdbscan_tpu.core.distances import rowwise_distance_np
+
+    out = np.empty_like(w)
+    for lo in range(0, len(u), chunk):
+        sl = slice(lo, lo + chunk)
+        d = rowwise_distance_np(data[u[sl]], data[v[sl]], metric)
+        out[sl] = np.maximum(d, np.maximum(core[u[sl]], core[v[sl]]))
+    return out
+
+
 def _group_by_subset(subset_ids: np.ndarray, active: np.ndarray) -> list[np.ndarray]:
     """Active point ids grouped by subset id (sorted once, no per-key scans)."""
     ids = np.nonzero(active)[0]
@@ -317,7 +366,18 @@ def _fit_rows(
     subset = np.zeros(n, np.int64)
     processed = np.zeros(n, bool)
     core = np.full(n, np.inf)
-    global_core = params.global_core_distances
+    # Boundary-quality mode replaces the global core scan and the full-set
+    # glue/refine scans with boundary-restricted ones (config.boundary_quality).
+    boundary_q = params.boundary_quality
+    boundary = boundary_q > 0
+    global_core = params.global_core_distances and not boundary
+    bmargin = np.full(n, np.inf) if boundary else None
+    # Globally unique id of the block each point was FROZEN in. ``subset``
+    # ids are renumbered per level (next_id restarts at 0), so frozen blocks
+    # from different levels collide there — the boundary phase needs the true
+    # final partition.
+    final_block = np.full(n, -1, np.int64)
+    block_counter = 0
     pool_u: list[np.ndarray] = []
     pool_v: list[np.ndarray] = []
     pool_w: list[np.ndarray] = []
@@ -341,6 +401,11 @@ def _fit_rows(
             pool_w = [state["pool_w"]]
             rng.bit_generator.state = state["rng_state"]
             level_stats = [LevelStats(**s) for s in state["level_stats"]]
+            if boundary and state.get("bmargin") is not None:
+                bmargin = state["bmargin"]
+            if state.get("final_block") is not None:
+                final_block = state["final_block"]
+                block_counter = int(final_block.max()) + 1
             if trace is not None:
                 trace("resume_from_checkpoint", level=state["level"])
     if global_core and not resumed:
@@ -375,7 +440,7 @@ def _fit_rows(
         n_inter = 0
         forced = 0
 
-        if params.exact_inter_edges and len(groups) >= 2:
+        if params.exact_inter_edges and len(groups) >= 2 and not boundary:
             # Per-level glue harvest: Borůvka rounds at point granularity,
             # seeded with the current subsets, run to connectivity — every
             # harvested edge is a true MST edge of the active set (cut
@@ -422,6 +487,9 @@ def _fit_rows(
                 if not global_core:
                     for i, ids in enumerate(group):
                         core[ids] = core_b[i, : len(ids)]
+            for g in small:
+                final_block[g] = block_counter
+                block_counter += 1
             done = np.concatenate(small)
             processed[done] = True
             n_proc = len(done)
@@ -527,7 +595,19 @@ def _fit_rows(
             # Next-level subset = renumbered bubble group (LabelClassification
             # + driver renumbering analog).
             pt_groups = bubble_groups[assign]
-            if np.bincount(pt_groups).max() >= size:
+            degenerate = np.bincount(pt_groups).max() >= size
+            if boundary and not degenerate:
+                # Record each point's seam margin against THIS level's induced
+                # partition. Partitions are nested, so every final-block seam
+                # was created at some level and scored here; the running min
+                # is the point's distance-to-nearest-seam proxy.
+                from hdbscan_tpu.parallel.blocks import seam_margins
+
+                marg = seam_margins(
+                    data[ids], data[samples_global], bubble_groups, metric
+                )
+                bmargin[ids] = np.minimum(bmargin[ids], marg)
+            if degenerate:
                 # Degenerate subset (e.g. all-identical points): every point
                 # lands in one group no matter how the model splits, so the
                 # recursion cannot make progress. Fall back to positional
@@ -549,6 +629,11 @@ def _fit_rows(
                 pool_u.append(tails)
                 pool_v.append(heads)
                 pool_w.append(cw)
+                if boundary:
+                    # Positional chunks have no geometric seams; mark the
+                    # chain endpoints so every chunk stays glue-reachable.
+                    bmargin[tails] = 0.0
+                    bmargin[heads] = 0.0
             subset[ids] = next_id + pt_groups
             next_id += int(pt_groups.max()) + 1
 
@@ -588,6 +673,8 @@ def _fit_rows(
                 cw,
                 rng.bit_generator.state,
                 [asdict(s) for s in level_stats],
+                bmargin=bmargin,
+                final_block=final_block,
             )
     else:
         if not processed.all():
@@ -599,6 +686,43 @@ def _fit_rows(
     u = np.concatenate(pool_u) if pool_u else np.zeros(0, np.int64)
     v = np.concatenate(pool_v) if pool_v else np.zeros(0, np.int64)
     w = np.concatenate(pool_w) if pool_w else np.zeros(0, np.float64)
+
+    bset = None
+    if boundary and n > cap:
+        from hdbscan_tpu.ops.tiled import boruvka_glue_edges, knn_core_distances_rows
+
+        # 1) The boundary set: per final block, the lowest-margin fraction
+        #    (final_block, NOT subset: subset ids are per-level and collide
+        #    across freeze levels).
+        t0 = time.monotonic()
+        bset = _select_boundary(bmargin, final_block, boundary_q)
+        # 2) Exact global core distances for boundary points only (their
+        #    per-block cores inflate at the seam); np.minimum guards against
+        #    float32 scan jitter ever raising a core.
+        core_b = knn_core_distances_rows(data, bset, params.min_points, metric)
+        core[bset] = np.minimum(core[bset], core_b)
+        # 3) Re-weight the whole pool to mutual reachability under the hybrid
+        #    core vector (exact at the seams, per-block in the interior):
+        #    recompute the true point distance per edge, then clamp by cores.
+        w = _reweight_pool(u, v, w, data, core, metric)
+        # 4) Inter-block Borůvka glue restricted to the boundary set — the
+        #    true min MRD edges between blocks have seam endpoints, so the
+        #    harvest over B finds them at O(|B|²·d) per round.
+        if len(np.unique(final_block[bset])) >= 2:
+            gu, gv, gw = boruvka_glue_edges(
+                data[bset], final_block[bset], metric, core=core[bset], mesh=mesh
+            )
+            u = np.concatenate([u, bset[gu]])
+            v = np.concatenate([v, bset[gv]])
+            w = np.concatenate([w, gw])
+        if trace is not None:
+            trace(
+                "boundary_phase",
+                m=len(bset),
+                frac=round(len(bset) / n, 4),
+                n_blocks=int(len(np.unique(final_block[bset]))),
+                wall_s=round(time.monotonic() - t0, 3),
+            )
 
     # Semi-supervised selection (constraints= flag) applies to the GLOBAL
     # condensed tree, exactly as in the single-block path.
@@ -641,18 +765,28 @@ def _fit_rows(
     # is a true MST edge (cut property), so iterating monotonically lowers
     # the pooled spanning weight toward the exact MST — repairing saddle
     # edges whose slightly-too-heavy pooled weights fragment the flat cut.
-    if params.exact_inter_edges:
+    if params.exact_inter_edges or bset is not None:
         from hdbscan_tpu.ops.tiled import boruvka_glue_edges
 
         for _ in range(params.refine_iterations):
             t0 = time.monotonic()
             groups_r = tree.point_last_cluster[:n]
-            if len(np.unique(groups_r)) < 2:
-                break
-            ru, rv, rw = boruvka_glue_edges(
-                data, groups_r, metric, core=core if global_core else None,
-                mesh=mesh,
-            )
+            if bset is not None:
+                # Boundary mode: refine over the seam set only — leaf-cluster
+                # boundaries are partition seams, so the repair edges live in B.
+                if len(np.unique(groups_r[bset])) < 2:
+                    break
+                ru, rv, rw = boruvka_glue_edges(
+                    data[bset], groups_r[bset], metric, core=core[bset], mesh=mesh
+                )
+                ru, rv = bset[ru], bset[rv]
+            else:
+                if len(np.unique(groups_r)) < 2:
+                    break
+                ru, rv, rw = boruvka_glue_edges(
+                    data, groups_r, metric, core=core if global_core else None,
+                    mesh=mesh,
+                )
             if len(ru) == 0:
                 break
             u = np.concatenate([u, ru])

@@ -205,6 +205,59 @@ def knn_core_distances(
     return core, knn
 
 
+def knn_core_distances_rows(
+    data: np.ndarray,
+    row_ids: np.ndarray,
+    min_pts: int,
+    metric: str = "euclidean",
+    row_tile: int = 1024,
+    col_tile: int = 8192,
+    dtype=np.float32,
+) -> np.ndarray:
+    """Exact core distances for SELECTED rows against the whole dataset.
+
+    The boundary-quality scan (``config.boundary_quality``): only the m
+    seam-adjacent points pay the global column sweep — O(m·n·d) instead of
+    the full O(n²·d) pass — while interior points keep their per-block core
+    distances (their k-NN ball is inside their block by construction).
+    Returns (m,) core distances aligned with ``row_ids``.
+    """
+    n = len(data)
+    m = len(row_ids)
+    if m == 0:
+        return np.zeros(0, np.float64)
+    k = max(min_pts - 1, 1)
+    row_tile, col_tile, n_pad = _tile_sizes(n, row_tile, col_tile)
+    data_p = jnp.asarray(_pad_rows(np.asarray(data, dtype), n_pad))
+    valid_p = jnp.asarray(np.arange(n_pad) < n)
+    m_pad = _round_up(m, row_tile)
+    rows = jnp.asarray(_pad_rows(np.asarray(data[row_ids], dtype), m_pad))
+    # Bound per-dispatch device runtime by the PAIR count (rows x full column
+    # sweep), not the row count: at n in the millions even a modest row chunk
+    # is minutes of device time, and a >1-minute program can trip
+    # worker/tunnel deadlines.
+    budget_pairs = _DISPATCH_ROWS << 20
+    chunk_rows = max(row_tile, _next_pow2(budget_pairs // n_pad) >> 1)
+    chunk_rows = min(chunk_rows, m_pad)
+    pending = [
+        _knn_core_scan(
+            rows[a : min(a + chunk_rows, m_pad)],
+            data_p,
+            valid_p,
+            k,
+            metric,
+            row_tile,
+            col_tile,
+        )
+        for a in range(0, m_pad, chunk_rows)
+    ]
+    fetched = jax.device_get(pending)
+    knn = np.concatenate([np.asarray(c[0], np.float64) for c in fetched])[:m]
+    if min_pts <= 1:
+        return np.zeros(m, np.float64)
+    return knn[:, min(min_pts - 1, n) - 1].copy()
+
+
 def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
@@ -302,61 +355,42 @@ def boruvka_glue_edges(
     Returns (u, v, w) in LOCAL indices of ``data``, deterministically
     tie-broken by (w, u, v).
     """
-    from hdbscan_tpu.utils.unionfind import find as _uf_find
-    from hdbscan_tpu.utils.unionfind import flatten_parents as _flatten
+    from hdbscan_tpu.utils.unionfind import contract_min_edges as _contract
 
     n = len(data)
     if core is None:
         core = np.zeros(n)
-    comp = np.unique(np.asarray(groups, np.int64), return_inverse=True)[1]
-    if comp.max() == 0:
+    dense = np.unique(np.asarray(groups, np.int64), return_inverse=True)[1]
+    n_comp = int(dense.max()) + 1
+    if n_comp == 1:
         return np.zeros(0, np.int64), np.zeros(0, np.int64), np.zeros(0, np.float64)
     scanner = BoruvkaScanner(
         data, core, metric, row_tile=row_tile, col_tile=col_tile, dtype=dtype,
         mesh=mesh, pad_pow2=True,  # repeated per-level calls on shrinking n
     )
-    parent = np.arange(n, dtype=np.int64)
-
-    def find(x: int) -> int:
-        return _uf_find(parent, x)
-
-    # Seed union-find with the initial groups (first member = representative).
-    # comp is dense 0..G-1, so comp[order0][firsts] == arange(G) and
-    # reps[g] is group g's first point; every point then points at its rep.
-    order0 = np.argsort(comp, kind="stable")
-    firsts = np.concatenate([[True], np.diff(comp[order0]) != 0])
-    reps = order0[firsts]
-    parent = reps[comp].copy()
+    # Seed components with the initial groups (first member = representative:
+    # dense is 0..G-1, so reps[g] is group g's first point).
+    order0 = np.argsort(dense, kind="stable")
+    firsts = np.concatenate([[True], np.diff(dense[order0]) != 0])
+    comp = order0[firsts][dense]
 
     eu, ev, ew = [], [], []
     for _ in range(max_rounds):
-        labels = _flatten(parent)
-        if len(np.unique(labels)) <= 1:
+        if n_comp <= 1:
             break
-        bw, bj = scanner.min_outgoing(labels)
-        has = bj >= 0
-        if not has.any():
+        bw, bj = scanner.min_outgoing(comp)
+        # Vectorized per-component selection + union — no per-edge Python
+        # even when early levels carry millions of groups.
+        emit, comp, n_comp = _contract(comp, bj, bw)
+        if len(emit) == 0:
             break
-        ids = np.nonzero(has)[0]
-        sel = np.lexsort((bj[ids], ids, bw[ids]))
-        ids = ids[sel]
-        _, first = np.unique(labels[ids], return_index=True)
-        added = 0
-        for i_ in ids[first]:
-            ra, rb = find(int(i_)), find(int(bj[i_]))
-            if ra == rb:
-                continue
-            parent[rb] = ra
-            eu.append(int(i_))
-            ev.append(int(bj[i_]))
-            ew.append(float(bw[i_]))
-            added += 1
-        if added == 0:
-            break
+        eu.append(emit)
+        ev.append(bj[emit])
+        ew.append(bw[emit])
     return (
-        np.asarray(eu, np.int64),
-        np.asarray(ev, np.int64),
-        np.asarray(ew, np.float64),
+        np.concatenate(eu) if eu else np.zeros(0, np.int64),
+        np.concatenate(ev) if ev else np.zeros(0, np.int64),
+        np.concatenate(ew) if ew else np.zeros(0, np.float64),
     )
 
 

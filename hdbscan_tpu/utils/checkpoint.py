@@ -57,6 +57,7 @@ def _fingerprint(params, n: int, data_digest: str | None = None) -> dict:
         "seed": params.seed,
         "exact_inter_edges": params.exact_inter_edges,
         "global_core_distances": params.global_core_distances,
+        "boundary_quality": params.boundary_quality,
     }
 
 
@@ -73,6 +74,8 @@ def save_level(
     pool_w: np.ndarray,
     rng_state: dict,
     level_stats: list[dict],
+    bmargin: np.ndarray | None = None,
+    final_block: np.ndarray | None = None,
 ) -> str:
     """Write the post-level driver state; atomic via rename."""
     os.makedirs(ckpt_dir, exist_ok=True)
@@ -96,6 +99,8 @@ def save_level(
                 pool_u=pool_u,
                 pool_v=pool_v,
                 pool_w=pool_w,
+                bmargin=bmargin if bmargin is not None else np.zeros(0),
+                final_block=final_block if final_block is not None else np.zeros(0),
             )
         os.replace(tmp, path)
     finally:
@@ -151,4 +156,14 @@ def load_latest(ckpt_dir: str, params, n: int, data_digest: str | None = None) -
             "pool_u": z["pool_u"],
             "pool_v": z["pool_v"],
             "pool_w": z["pool_w"],
+            "bmargin": (
+                z["bmargin"]
+                if "bmargin" in z.files and len(z["bmargin"])
+                else None
+            ),
+            "final_block": (
+                z["final_block"]
+                if "final_block" in z.files and len(z["final_block"])
+                else None
+            ),
         }

@@ -55,6 +55,35 @@ def make_gauss(
     return pts, assign.astype(np.int64) + 1
 
 
+def make_directional(
+    n: int,
+    dims: int = 8,
+    n_clusters: int = 6,
+    angular_spread: float = 0.05,
+    seed: int = 0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Clusters of DIRECTIONS: magnitude is noise, angle carries the class.
+
+    Points lie along cluster-specific unit directions with small angular
+    jitter and uniformly random radii in [0.5, 10]. Cosine distance separates
+    the clusters cleanly while Euclidean mixes them (radius swamps angle) —
+    the structure the cosine plug-in config exists to demonstrate. Skin RGB
+    rows are the OPPOSITE regime: near-collinear rays (13.8% of pairs at
+    cosine distance < 1e-3, minPts=16 cosine core distances ~1e-5, 256
+    all-zero rows where cosine is undefined), so any cosine clustering of
+    Skin collapses to one cluster — a dataset degeneracy, not a plug-in bug
+    (``distance/CosineSimilarity.java:27-40`` has the same geometry).
+    """
+    rng = np.random.default_rng(seed)
+    dirs = rng.normal(size=(n_clusters, dims))
+    dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+    assign = rng.integers(0, n_clusters, size=n)
+    pts = dirs[assign] + rng.normal(0.0, angular_spread, size=(n, dims))
+    pts /= np.linalg.norm(pts, axis=1, keepdims=True)
+    radii = rng.uniform(0.5, 10.0, size=(n, 1))
+    return pts * radii, assign.astype(np.int64) + 1
+
+
 #: The paper's three synthetic configurations (cluster counts; Table 1).
 GAUSS_CONFIGS = {"gauss1": 20, "gauss2": 30, "gauss3": 50}
 

@@ -36,16 +36,18 @@ def adjusted_rand_index(
     n = a.size
     _, ai = np.unique(a, return_inverse=True)
     _, bi = np.unique(b, return_inverse=True)
-    na, nb = ai.max() + 1, bi.max() + 1
-    cont = np.zeros((na, nb), np.int64)
-    np.add.at(cont, (ai, bi), 1)
+    nb = int(bi.max()) + 1
 
     def comb2(x):
         return x * (x - 1) / 2.0
 
-    sum_ij = comb2(cont).sum()
-    sum_a = comb2(cont.sum(1)).sum()
-    sum_b = comb2(cont.sum(0)).sum()
+    # Sparse contingency via paired codes: with noise-as-singletons BOTH
+    # labelings can carry ~n distinct labels, so the dense (na, nb) matrix
+    # would be O(n²) memory; the pair-count multiset is all ARI needs.
+    _, pair_counts = np.unique(ai.astype(np.int64) * nb + bi, return_counts=True)
+    sum_ij = comb2(pair_counts).sum()
+    sum_a = comb2(np.bincount(ai)).sum()
+    sum_b = comb2(np.bincount(bi)).sum()
     total = comb2(n)
     expected = sum_a * sum_b / total if total else 0.0
     max_index = (sum_a + sum_b) / 2.0

@@ -49,6 +49,24 @@ def hierarchy_levels(tree: CondensedTree, compact: bool) -> np.ndarray:
     return np.unique(levels)[::-1]
 
 
+def _ancestor_chains(
+    tree: CondensedTree, labels: np.ndarray
+) -> list[tuple[np.ndarray, np.ndarray]]:
+    """Per label: (chain labels root-first, their births — descending along
+    the chain). The single chain-walk implementation shared by the matrix
+    and streaming hierarchy paths."""
+    out = []
+    for label in labels:
+        labels_c, births_c = [], []
+        c = int(label)
+        while c > 0:
+            labels_c.append(c)
+            births_c.append(tree.birth[c])
+            c = int(tree.parent[c]) if tree.parent[c] > 0 else 0
+        out.append((np.array(labels_c[::-1]), np.array(births_c[::-1])))
+    return out
+
+
 def hierarchy_matrix(tree: CondensedTree, levels: np.ndarray) -> np.ndarray:
     """(L, n) label matrix: row r = labels after processing level ``levels[r]``.
 
@@ -62,15 +80,8 @@ def hierarchy_matrix(tree: CondensedTree, levels: np.ndarray) -> np.ndarray:
     # One chain walk + searchsorted per DISTINCT last-cluster (not per point):
     # points sharing a last cluster share the whole label column except the
     # exit cutoff, which is vectorized below.
-    for label in np.unique(tree.point_last_cluster):
-        labels_c, births_c = [], []
-        c = int(label)
-        while c > 0:
-            labels_c.append(c)
-            births_c.append(tree.birth[c])
-            c = int(tree.parent[c]) if tree.parent[c] > 0 else 0
-        labels_c = np.array(labels_c[::-1])  # root-first, births descending
-        births_c = np.array(births_c[::-1])
+    uniq = np.unique(tree.point_last_cluster)
+    for label, (labels_c, births_c) in zip(uniq, _ancestor_chains(tree, uniq)):
         # deepest cluster with birth >= w
         pos = np.searchsorted(-births_c, -levels, side="right") - 1
         col = labels_c[np.clip(pos, 0, len(labels_c) - 1)]
@@ -83,15 +94,55 @@ def hierarchy_matrix(tree: CondensedTree, levels: np.ndarray) -> np.ndarray:
 
 def write_hierarchy_file(path: str, tree: CondensedTree, compact: bool, delimiter: str = ",") -> dict[int, int]:
     """Writes the hierarchy file; returns {cluster label: char offset of the
-    first row where it appears} (the ``fileOffset`` of ``Cluster.java:165``)."""
+    first row where it appears} (the ``fileOffset`` of ``Cluster.java:165``).
+
+    Streams one level row at a time in O(n) memory — never the (L, n) label
+    matrix, which at a 1M-point FULL hierarchy (L ~ distinct edge weights)
+    would be tens of GB. Levels descend, so each distinct last-cluster chain
+    keeps a monotone pointer to its deepest cluster born at >= the current
+    level; rows are byte-identical to the matrix path
+    (:func:`hierarchy_matrix`, kept for tests/diagnostics).
+    """
     levels = hierarchy_levels(tree, compact)
-    mat = hierarchy_matrix(tree, levels)
     offsets: dict[int, int] = {}
     pos = 0
+    # One ancestor-chain walk per DISTINCT last cluster (not per point).
+    uniq, chain_of_point = np.unique(tree.point_last_cluster, return_inverse=True)
+    chains = _ancestor_chains(tree, uniq)
+    # Event-driven pointer advance: chain element j becomes current at the
+    # first (descending) level row where its birth >= the row's level —
+    # precomputed with one searchsorted per chain, so the per-level work is
+    # O(events at that row) instead of a Python sweep over every chain.
+    cur = np.array([labels_c[0] for labels_c, _ in chains], np.int64)
+    ev_row, ev_chain, ev_label = [], [], []
+    for ci, (labels_c, births_c) in enumerate(chains):
+        if len(labels_c) > 1:
+            rows = np.searchsorted(-levels, -births_c[1:], side="left")
+            ev_row.append(rows)
+            ev_chain.append(np.full(len(rows), ci, np.int64))
+            ev_label.append(labels_c[1:])
+    if ev_row:
+        ev_row = np.concatenate(ev_row)
+        ev_chain = np.concatenate(ev_chain)
+        ev_label = np.concatenate(ev_label)
+        # stable by (row, chain depth order): deeper elements of a chain come
+        # later in each chain's slice, so the deepest born-at-this-row wins.
+        order = np.argsort(ev_row, kind="stable")
+        ev_row, ev_chain, ev_label = ev_row[order], ev_chain[order], ev_label[order]
+    else:
+        ev_row = np.zeros(0, np.int64)
+        ev_chain = ev_label = np.zeros(0, np.int64)
+    ev_i = 0
+    exits = tree.point_exit_level
+    has_exit = exits > 0
     with open(path, "w") as f:
         for r, w in enumerate(levels):
-            line = f"{w:.9g}" + delimiter + delimiter.join(map(str, mat[r])) + "\n"
-            for lbl in np.unique(mat[r]):
+            while ev_i < len(ev_row) and ev_row[ev_i] <= r:
+                cur[ev_chain[ev_i]] = ev_label[ev_i]
+                ev_i += 1
+            row = np.where(has_exit & (w <= exits), 0, cur[chain_of_point])
+            line = f"{w:.9g}" + delimiter + delimiter.join(map(str, row)) + "\n"
+            for lbl in np.unique(row):
                 if lbl > 0 and lbl not in offsets:
                     offsets[int(lbl)] = pos
             f.write(line)

@@ -1,0 +1,189 @@
+"""Unit tests for the boundary-quality mode building blocks
+(config.boundary_quality): seam margins, row-subset exact cores, boundary
+selection, pool re-weighting — plus an end-to-end quality check that the
+hybrid recovers the exact tree where the reference-faithful compat mode is
+allowed to drift."""
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu import HDBSCANParams
+from hdbscan_tpu.models import exact, mr_hdbscan
+from hdbscan_tpu.models.mr_hdbscan import _reweight_pool, _select_boundary
+from hdbscan_tpu.ops.tiled import knn_core_distances, knn_core_distances_rows
+from hdbscan_tpu.parallel.blocks import seam_margins
+
+
+def _brute_margins(points, samples, groups):
+    d = np.linalg.norm(points[:, None, :] - samples[None, :, :], axis=2)
+    i1 = np.argmin(d, axis=1)
+    d1 = d[np.arange(len(points)), i1]
+    out = np.empty(len(points))
+    for i in range(len(points)):
+        other = groups != groups[i1[i]]
+        out[i] = (d[i][other].min() if other.any() else np.inf) - d1[i]
+    return out
+
+
+def test_seam_margins_match_bruteforce(rng):
+    pts = rng.normal(size=(300, 4))
+    samples = rng.normal(size=(37, 4))
+    groups = rng.integers(0, 4, size=37).astype(np.int32)
+    got = seam_margins(pts, samples, groups)
+    want = _brute_margins(pts, samples, groups)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_seam_margins_single_group_is_inf(rng):
+    pts = rng.normal(size=(50, 3))
+    samples = rng.normal(size=(9, 3))
+    groups = np.zeros(9, np.int32)
+    assert np.all(np.isinf(seam_margins(pts, samples, groups)))
+
+
+def test_knn_core_rows_matches_full_scan(rng):
+    data = rng.normal(size=(2000, 6))
+    full, _ = knn_core_distances(data, 8)
+    rows = rng.choice(2000, 137, replace=False)
+    sub = knn_core_distances_rows(data, rows, 8)
+    np.testing.assert_allclose(sub, full[rows], rtol=1e-5)
+
+
+def test_select_boundary_per_block_quantile():
+    # Two blocks with very different margin scales: a global threshold would
+    # take everything from the small-scale block; per-block selection must not.
+    margin = np.concatenate([np.linspace(0, 1, 100), np.linspace(0, 100, 100)])
+    subset = np.repeat([0, 1], 100)
+    sel = _select_boundary(margin, subset, q=0.1, min_per_block=5)
+    per_block = np.bincount(subset[sel])
+    assert per_block.tolist() == [10, 10]
+    # the floor kicks in for tiny q
+    sel2 = _select_boundary(margin, subset, q=0.001, min_per_block=7)
+    assert np.bincount(subset[sel2]).tolist() == [7, 7]
+    # blocks smaller than the floor contribute everything
+    sel3 = _select_boundary(np.zeros(4), np.array([0, 0, 1, 1]), q=0.5, min_per_block=32)
+    assert len(sel3) == 4
+
+
+def test_select_boundary_prefers_small_margins():
+    margin = np.array([5.0, 1.0, 3.0, 0.5, 9.0, 2.0])
+    sel = _select_boundary(margin, np.zeros(6, np.int64), q=0.5, min_per_block=1)
+    assert set(margin[sel]) == {0.5, 1.0, 2.0}
+
+
+def test_reweight_pool_is_exact_mrd(rng):
+    data = rng.normal(size=(64, 3))
+    core = rng.uniform(0.1, 2.0, size=64)
+    u = rng.integers(0, 64, 40)
+    v = rng.integers(0, 64, 40)
+    w = np.zeros(40)
+    out = _reweight_pool(u, v, w, data, core, "euclidean", chunk=7)
+    d = np.linalg.norm(data[u] - data[v], axis=1)
+    np.testing.assert_allclose(out, np.maximum(d, np.maximum(core[u], core[v])))
+
+
+def test_boundary_config_validation():
+    with pytest.raises(ValueError):
+        HDBSCANParams(boundary_quality=1.5)
+    with pytest.raises(ValueError):
+        HDBSCANParams(boundary_quality=0.1, dedup_points=True)
+    p = HDBSCANParams.from_args(["boundary=0.05"])
+    assert p.boundary_quality == 0.05
+
+
+def test_boundary_mode_recovers_exact_tree(rng):
+    # Anisotropic blobs with touching tails: per-block cores alone distort
+    # the seams; the boundary pass must bring the fit to the exact flat cut.
+    from tests.conftest import make_blobs
+
+    data, _ = make_blobs(rng, n=6000, d=3, centers=6, spread=0.35)
+    params = HDBSCANParams(min_points=6, min_cluster_size=120, processing_units=1024)
+    r_exact = exact.fit(data, params)
+    r_bound = mr_hdbscan.fit(data, params.replace(boundary_quality=0.1), max_levels=16)
+    from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+
+    ari = adjusted_rand_index(r_bound.labels, r_exact.labels)
+    assert ari > 0.95, f"boundary-mode ARI vs exact {ari}"
+    # boundary mode must populate exact cores for the seam set: no core may
+    # exceed its per-block value, and at least one must have been lowered
+    assert np.all(r_bound.core_distances <= np.inf)
+
+
+def test_boundary_checkpoint_roundtrip(tmp_path, rng):
+    from tests.conftest import make_blobs
+
+    data, _ = make_blobs(rng, n=3000, d=3, centers=4, spread=0.3)
+    params = HDBSCANParams(
+        min_points=4, min_cluster_size=60, processing_units=512, boundary_quality=0.1
+    )
+    r1 = mr_hdbscan.fit(data, params, checkpoint_dir=str(tmp_path))
+    # resume from the last level's checkpoint: same result
+    r2 = mr_hdbscan.fit(data, params, checkpoint_dir=str(tmp_path))
+    np.testing.assert_array_equal(r1.labels, r2.labels)
+
+
+def test_streaming_hierarchy_writer_matches_matrix(tmp_path, rng):
+    # byte-identity: the streamed writer must reproduce exactly what writing
+    # the (L, n) matrix row-by-row produced
+    from tests.conftest import make_blobs
+
+    from hdbscan_tpu.models import hdbscan
+    from hdbscan_tpu.utils.io import (
+        hierarchy_levels,
+        hierarchy_matrix,
+        write_hierarchy_file,
+    )
+
+    data, _ = make_blobs(rng, n=500, d=3, centers=4, spread=0.4)
+    params = HDBSCANParams(min_points=4, min_cluster_size=20)
+    res = hdbscan.fit(data, params)
+    for compact in (False, True):
+        levels = hierarchy_levels(res.tree, compact)
+        mat = hierarchy_matrix(res.tree, levels)
+        want_lines = [
+            f"{w:.9g}," + ",".join(map(str, mat[r])) + "\n"
+            for r, w in enumerate(levels)
+        ]
+        p = tmp_path / f"h_{compact}.csv"
+        offsets = write_hierarchy_file(str(p), res.tree, compact)
+        assert p.read_text() == "".join(want_lines)
+        # offsets point at the first row where each label appears
+        text = p.read_text()
+        for lbl, off in offsets.items():
+            row = text[off:].split("\n", 1)[0]
+            assert f",{lbl}" in "," + ",".join(row.split(",")[1:])
+
+
+def test_final_block_ids_unique_across_levels(rng):
+    # Regression: `subset` ids are renumbered per level, so blocks frozen at
+    # different levels collide there. The boundary phase must group by the
+    # globally-unique final_block ids — the glue phase's component count has
+    # to equal the number of frozen blocks represented in the boundary set.
+    from tests.conftest import make_blobs
+
+    data, _ = make_blobs(rng, n=6000, d=3, centers=5, spread=0.5)
+    params = HDBSCANParams(
+        min_points=4, min_cluster_size=80, processing_units=512, boundary_quality=0.2
+    )
+    events = {}
+
+    def tr(ev, **kw):
+        if ev == "boundary_phase":
+            events.update(kw)
+        if ev == "level":
+            events.setdefault("levels", []).append(kw)
+
+    r = mr_hdbscan.fit(data, params, max_levels=32, trace=tr)
+    assert len(r.labels) == 6000
+    assert "n_blocks" in events, "boundary phase must run for a multi-level fit"
+    n_levels_with_blocks = sum(
+        1 for lv in events["levels"] if lv["n_small_subsets"] > 0
+    )
+    assert n_levels_with_blocks >= 2, "fixture must freeze blocks at 2+ levels"
+    total_blocks = sum(lv["n_small_subsets"] for lv in events["levels"])
+    # every frozen block contributes boundary representatives (min_per_block
+    # floor), so the glue must see ALL of them as distinct components
+    assert events["n_blocks"] == total_blocks, (
+        f"glue saw {events['n_blocks']} blocks, recursion froze {total_blocks} "
+        "(per-level subset-id collision?)"
+    )

@@ -118,3 +118,74 @@ class TestEndToEnd:
         res2 = hdbscan.fit(pts, params)
         # Constrained extraction still labels both endpoints together.
         assert res2.labels[same[0]] == res2.labels[same[1]] != 0
+
+
+class TestVectorizedParity:
+    """The LCA-vectorized counting must match a per-constraint ancestor-chain
+    walk (the reference's HDBSCANStar.java:738-789 shape) exactly."""
+
+    @staticmethod
+    def _chain_walk_oracle(tree, constraints):
+        c = tree.n_clusters
+        chains = [set() for _ in range(c + 1)]
+        for label in range(1, c + 1):
+            par = int(tree.parent[label])
+            chains[label] = {label} | (chains[par] if par > 0 else set())
+        num = np.zeros(c + 1, np.int64)
+        vnum = np.zeros(c + 1, np.int64)
+        last = tree.point_last_cluster
+        for con in constraints:
+            ca = chains[int(last[con.point_a])]
+            cb = chains[int(last[con.point_b])]
+            if con.kind == "ml":
+                for lbl in ca & cb:
+                    num[lbl] += 2
+            else:
+                for lbl in ca ^ cb:
+                    num[lbl] += 1
+                for p in (con.point_a, con.point_b):
+                    lbl = int(last[p])
+                    if tree.has_children[lbl]:
+                        vnum[lbl] += 1
+        return num, vnum
+
+    def test_random_fit_parity(self, rng):
+        from tests.conftest import make_blobs
+
+        from hdbscan_tpu import HDBSCANParams
+        from hdbscan_tpu.models import hdbscan
+
+        data, _ = make_blobs(rng, n=900, d=3, centers=7, spread=0.5)
+        res = hdbscan.fit(data, HDBSCANParams(min_points=4, min_cluster_size=15))
+        n = len(data)
+        cons = [
+            Constraint(int(a), int(b), "ml" if rng.random() < 0.5 else "cl")
+            for a, b in rng.integers(0, n, size=(400, 2))
+        ]
+        got = count_constraints_satisfied(res.tree, cons)
+        want = self._chain_walk_oracle(res.tree, cons)
+        np.testing.assert_array_equal(got[0], want[0])
+        np.testing.assert_array_equal(got[1], want[1])
+
+    def test_million_constraints_fast(self, rng):
+        # VERDICT item 9's bar: 1M constraints in seconds, not minutes.
+        import time
+
+        from tests.conftest import make_blobs
+
+        from hdbscan_tpu import HDBSCANParams
+        from hdbscan_tpu.models import hdbscan
+
+        data, _ = make_blobs(rng, n=2000, d=3, centers=8, spread=0.4)
+        res = hdbscan.fit(data, HDBSCANParams(min_points=4, min_cluster_size=25))
+        pairs = rng.integers(0, len(data), size=(1_000_000, 2))
+        kinds = rng.random(1_000_000) < 0.5
+        cons = [
+            Constraint(int(a), int(b), "ml" if k else "cl")
+            for (a, b), k in zip(pairs, kinds)
+        ]
+        t0 = time.monotonic()
+        num, vnum = count_constraints_satisfied(res.tree, cons)
+        wall = time.monotonic() - t0
+        assert wall < 20.0, f"1M constraints took {wall:.1f}s"
+        assert num.sum() > 0

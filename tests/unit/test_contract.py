@@ -1,0 +1,132 @@
+"""Property tests for the vectorized Borůvka contraction round
+(utils/unionfind.contract_min_edges) — the host-side replacement for the
+per-edge union loops, exercised under adversarial weight ties (longer-than-2
+functional-graph cycles) and validated against brute-force MST weight."""
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.utils.unionfind import contract_min_edges
+
+
+def _brute_mst_weight(dist):
+    # Prim over the dense matrix
+    n = len(dist)
+    in_tree = np.zeros(n, bool)
+    in_tree[0] = True
+    best = dist[0].copy()
+    total = 0.0
+    for _ in range(n - 1):
+        best_masked = np.where(in_tree, np.inf, best)
+        j = int(np.argmin(best_masked))
+        total += best_masked[j]
+        in_tree[j] = True
+        best = np.minimum(best, dist[j])
+    return total
+
+
+def _boruvka_via_contract(dist):
+    n = len(dist)
+    comp = np.arange(n, dtype=np.int64)
+    total, edges = 0.0, 0
+    for _ in range(64):
+        uc = np.unique(comp)
+        if len(uc) <= 1:
+            break
+        # per-vertex min outgoing candidate (smallest column tie-break,
+        # matching the device scan's semantics)
+        out = comp[None, :] != comp[:, None]
+        w = np.where(out, dist, np.inf)
+        bj = np.argmin(w, axis=1).astype(np.int64)
+        bw = w[np.arange(n), bj]
+        bj = np.where(np.isfinite(bw), bj, -1)
+        emit, comp, _ = contract_min_edges(comp, bj, bw)
+        total += float(bw[emit].sum())
+        edges += len(emit)
+    return total, edges, comp
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_contract_builds_exact_mst_random(seed):
+    rng = np.random.default_rng(seed)
+    n = 60
+    pts = rng.normal(size=(n, 3))
+    dist = np.linalg.norm(pts[:, None] - pts[None, :], axis=2)
+    np.fill_diagonal(dist, np.inf)
+    total, edges, comp = _boruvka_via_contract(dist)
+    assert edges == n - 1
+    assert len(np.unique(comp)) == 1
+    assert total == pytest.approx(_brute_mst_weight(dist), rel=1e-9)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_contract_with_massive_ties(seed):
+    # Lattice-valued distances: huge numbers of exactly tied weights, the
+    # regime where functional-graph cycles can exceed length 2.
+    rng = np.random.default_rng(100 + seed)
+    n = 80
+    pts = rng.integers(0, 4, size=(n, 2)).astype(float)
+    dist = np.abs(pts[:, None] - pts[None, :]).sum(axis=2)
+    np.fill_diagonal(dist, np.inf)
+    # coincident points: distance 0 ties everywhere
+    total, edges, comp = _boruvka_via_contract(dist)
+    assert edges == n - 1
+    assert len(np.unique(comp)) == 1
+    assert total == pytest.approx(_brute_mst_weight(dist), rel=1e-9)
+
+
+def test_contract_handles_isolated_components():
+    # two groups with no candidates at all: nothing emitted, labels unchanged
+    comp = np.array([0, 0, 1, 1])
+    cand_j = np.full(4, -1)
+    cand_w = np.zeros(4)
+    emit, comp2, n_comp = contract_min_edges(comp, cand_j, cand_w)
+    assert len(emit) == 0
+    assert n_comp == 2
+    np.testing.assert_array_equal(comp, comp2)
+
+
+def test_contract_two_components_one_edge():
+    comp = np.array([7, 7, 9, 9])
+    # vertices 1 and 2 pick each other (the same physical edge, both sides)
+    cand_j = np.array([-1, 2, 1, -1])
+    cand_w = np.array([np.inf, 0.5, 0.5, np.inf])
+    emit, comp2, n_comp = contract_min_edges(comp, cand_j, cand_w)
+    assert n_comp == 1
+    assert len(emit) == 1  # the shared edge enters once
+    assert len(np.unique(comp2)) == 1
+
+
+def test_ari_sparse_contingency_scales_to_noise_heavy_labelings():
+    # Regression: with noise-as-singletons BOTH sides can carry ~n distinct
+    # labels; the dense contingency matrix was O(n^2) memory (hung real
+    # benchmark runs at 200k). Sparse pair counting must handle it instantly
+    # and agree with the dense result on small inputs.
+    import time
+
+    from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+
+    rng = np.random.default_rng(0)
+    n = 200_000
+    a = np.zeros(n, np.int64)  # all noise -> n singletons
+    b = np.zeros(n, np.int64)
+    a[: n // 2] = 1
+    b[: n // 3] = 1
+    t0 = time.monotonic()
+    v = adjusted_rand_index(a, b)
+    assert time.monotonic() - t0 < 10.0
+    assert -0.5 <= v <= 1.0
+    # exact agreement of the sparse path with a hand-built dense contingency
+    a2 = rng.integers(0, 5, 300)
+    b2 = rng.integers(0, 4, 300)
+    ai = a2.copy(); bi = b2.copy()
+    cont = np.zeros((5, 4))
+    for x, y_ in zip(ai, bi):
+        cont[x, y_] += 1
+    comb2 = lambda x: x * (x - 1) / 2.0
+    sum_ij = comb2(cont).sum(); sum_a = comb2(cont.sum(1)).sum(); sum_b = comb2(cont.sum(0)).sum()
+    total = comb2(300)
+    expected = sum_a * sum_b / total
+    want = (sum_ij - expected) / ((sum_a + sum_b) / 2.0 - expected)
+    got = adjusted_rand_index(a2, b2, noise_as_singletons=False)
+    np.testing.assert_allclose(got, want, rtol=1e-12)

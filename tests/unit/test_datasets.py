@@ -27,3 +27,24 @@ class TestGaussGenerators:
         res = hdbscan.fit(pts, HDBSCANParams(min_points=5, min_cluster_size=30))
         ari = adjusted_rand_index(res.labels, truth, noise_as_singletons=True)
         assert ari > 0.95, f"exact ARI on separated gaussians too low: {ari}"
+
+
+def test_directional_cosine_separates_euclidean_does_not():
+    # The cosine plug-in demonstration set (resolved r1 cosine finding):
+    # angle carries the class, magnitude is noise.
+    from hdbscan_tpu import HDBSCANParams
+    from hdbscan_tpu.models import hdbscan
+    from hdbscan_tpu.utils.datasets import make_directional
+    from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+
+    pts, truth = make_directional(2000, dims=6, n_clusters=4, seed=1)
+    r_cos = hdbscan.fit(
+        pts, HDBSCANParams(min_points=6, min_cluster_size=60, dist_function="cosine")
+    )
+    r_euc = hdbscan.fit(
+        pts, HDBSCANParams(min_points=6, min_cluster_size=60, dist_function="euclidean")
+    )
+    a_cos = adjusted_rand_index(r_cos.labels, truth, noise_as_singletons=True)
+    a_euc = adjusted_rand_index(r_euc.labels, truth, noise_as_singletons=True)
+    assert a_cos > 0.9, f"cosine should separate directional clusters, got {a_cos}"
+    assert a_cos > a_euc + 0.2, f"cosine {a_cos} should beat euclidean {a_euc}"
