@@ -29,7 +29,9 @@ def main() -> None:
     sep = float(sys.argv[2]) if len(sys.argv) > 2 else 5.0
     modes = (sys.argv[3] if len(sys.argv) > 3 else "exact,compat,bound05,fullq").split(",")
     dims, n_clusters = 10, 30
-    cap = 65536 if n > 500_000 else 16384
+    # Dense per-block MST needs cap^2 x ~8 f32 temps in HBM: 16384 (~8.6 GB)
+    # is the single-chip ceiling; 32768+ OOMs a v5e (15.75 GB).
+    cap = 16384
     mcs = max(64, n // 200)
     data, y = make_gauss(n, dims=dims, n_clusters=n_clusters, separation=sep, seed=2)
     base = dict(
