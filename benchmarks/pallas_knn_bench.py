@@ -1,0 +1,95 @@
+"""Pallas k-NN kernel vs the XLA ``lax.top_k`` scan, one JSON line per leg.
+
+Run on the real TPU chip:
+
+    python benchmarks/pallas_knn_bench.py [--datasets skin,gauss200k,gauss1m]
+
+Measures the round-2 kernel schedule (Morton row sort + near-diagonal-first
+column visit order, ``order="diag"``) against both the round-1 schedule
+(``order="scan"``) and the default XLA streaming scan, and checks the three
+agree numerically. Wall times include the kernel's host-side Morton sort and
+permutations (that is the honest drop-in cost).
+
+The adoption rule (VERDICT r1 item 8): the kernel becomes the default
+euclidean core-distance backend only where it measurably wins; otherwise the
+numbers below get recorded in ROADMAP.md as the negative result.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+SKIN = "/root/reference/数据集/Skin_NonSkin.txt"
+
+
+def bench(fn, reps: int = 3):
+    fn()  # warm / compile
+    walls = []
+    for _ in range(reps):
+        t0 = time.monotonic()
+        out = fn()
+        walls.append(time.monotonic() - t0)
+    return min(walls), out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--datasets", default="skin,gauss200k,gauss1m")
+    ap.add_argument("--min-pts", type=int, default=16)
+    args = ap.parse_args()
+
+    from hdbscan_tpu.ops.pallas_knn import knn_core_distances_pallas
+    from hdbscan_tpu.ops.tiled import knn_core_distances
+    from hdbscan_tpu.utils.datasets import make_gauss
+
+    sets = {}
+    for name in args.datasets.split(","):
+        if name == "skin":
+            sets[name] = np.loadtxt(SKIN)[:, :3]
+        elif name.startswith("gauss"):
+            n = int(name[5:].replace("k", "000").replace("m", "000000"))
+            sets[name], _ = make_gauss(n, dims=10, n_clusters=30, seed=0)
+        else:
+            raise SystemExit(f"unknown dataset {name}")
+
+    mp = args.min_pts
+    for name, data in sets.items():
+        legs = {
+            "xla_scan": lambda d=data: knn_core_distances(d, mp)[0],
+            "pallas_scan": lambda d=data: knn_core_distances_pallas(
+                d, mp, order="scan"
+            )[0],
+            "pallas_diag": lambda d=data: knn_core_distances_pallas(
+                d, mp, order="diag"
+            )[0],
+        }
+        cores = {}
+        for leg, fn in legs.items():
+            wall, cores[leg] = bench(fn)
+            print(
+                json.dumps(
+                    {
+                        "metric": f"knn_{name}_{leg}",
+                        "value": round(wall, 3),
+                        "unit": "s",
+                        "n": len(data),
+                        "d": data.shape[1],
+                        "min_pts": mp,
+                    }
+                ),
+                flush=True,
+            )
+        for leg in ("pallas_scan", "pallas_diag"):
+            err = float(np.abs(cores[leg] - cores["xla_scan"]).max())
+            assert err < 1e-4, f"{name} {leg} diverges from XLA by {err}"
+
+
+if __name__ == "__main__":
+    main()

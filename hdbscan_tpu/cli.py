@@ -6,7 +6,9 @@ Usage mirrors the reference's documented contract (``main/Main.java:534-614``)::
         [processing_units=N] [k=0.2] [constraints=<csv>] [compact={true,false}] \
         [dist_function={euclidean,cosine,pearson,manhattan,supremum}] \
         [out_dir=DIR] [seed=N] [variant={db,rs}] [dedup={true,false}] \
-        [exact_inter_edges={true,false}] [global_cores={true,false}] [refine=N]
+        [exact_inter_edges={true,false}] [global_cores={true,false}] [refine=N] \
+        [boundary=F] [compat_cf={true,false}] \
+        [clusterName={local,auto,<host:port>,<pid>,<np>}]
 
 Unlike the reference, argv is actually honored (the reference shadows it with
 hard-coded args, ``main/Main.java:71`` — treated as a bug, SURVEY.md §7), and
@@ -37,6 +39,31 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     if not params.input_file:
         print("error: file=<input> is required", file=sys.stderr)
+        return 2
+
+    # Multi-controller wiring BEFORE any device use (the reference's Spark
+    # master flag, re-mapped: clusterName=local|auto|<host:port>,<pid>,<np>).
+    from hdbscan_tpu.parallel.distributed import (
+        initialize_from_cluster_name,
+        process_count,
+    )
+
+    try:
+        initialize_from_cluster_name(params.cluster_name)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if process_count() > 1:
+        # The CLI pipeline is single-controller today: letting every process
+        # run it would redundantly recompute everything and race on the
+        # output files. Multi-host execution goes through the library
+        # primitives (parallel/distributed.py, ROADMAP "Misc").
+        print(
+            "error: the CLI driver does not run multi-process yet; "
+            "clusterName wires the processes but the pipeline must be "
+            "driven via hdbscan_tpu.parallel.distributed (see ROADMAP.md)",
+            file=sys.stderr,
+        )
         return 2
 
     import numpy as np

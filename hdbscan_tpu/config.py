@@ -83,6 +83,15 @@ class HDBSCANParams:
     #: 4.8x fewer rows (23x less scan work) on the lattice-valued north-star
     #: set. Off by default for strict row-level reference parity.
     dedup_points: bool = False
+    #: Reproduce the reference's LIVE integer-math CF behaviors instead of
+    #: the correct double math (``core/compat.py``): CombineStep's
+    #: mean-of-per-dim-sqrt extent and collapsed nnDist exponent
+    #: (``CombineStep.java:28,42-57``) and the bubble core-distance walk with
+    #: its stale shared ``indexBubbles`` buffer, i-vs-index confusion and
+    #: integer-division exponents (``HdbscanDataBubbles.java:75-146``). For
+    #: output parity with a reference RUN rather than with the paper. Off by
+    #: default (SURVEY.md §7 parity-vs-bug decisions).
+    compat_cf_int_math: bool = False
     # Output file names derived from the input path (main/Main.java:516-526):
 
     def __post_init__(self):
@@ -146,6 +155,7 @@ class HDBSCANParams:
             "global_cores": ("global_core_distances", lambda s: s.lower() == "true"),
             "refine": ("refine_iterations", int),
             "boundary": ("boundary_quality", float),
+            "compat_cf": ("compat_cf_int_math", lambda s: s.lower() == "true"),
         }
         kwargs = {}
         for arg in argv:

@@ -34,6 +34,25 @@ DEFAULT_METRIC = "euclidean"  # reference default: main/Main.java:419
 _DIFF_FORM_BUDGET = 1 << 25
 
 
+def _cross_f32(x: jax.Array, y: jax.Array) -> jax.Array:
+    """x @ y.T at FULL input precision on the MXU.
+
+    TPU matmuls default to bf16 passes, which is a ~0.8% relative error on
+    the cross term — at production tile shapes (where the dot form is
+    selected) that surfaced as ~1e-2 absolute error on 10-d core distances
+    (caught by the Pallas kernel's exact diff-form cross-check, round 2).
+    ``Precision.HIGHEST`` keeps the MXU but runs enough passes for full f32;
+    the cross matmul is a small share of scan cost next to top-k selection.
+    """
+    return jax.lax.dot_general(
+        x,
+        y,
+        (((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=x.dtype,
+    )
+
+
 def _sq_euclidean(x: jax.Array, y: jax.Array) -> jax.Array:
     """Squared Euclidean distances; picks the accurate or the MXU form by shape."""
     if x.shape[0] * y.shape[0] * x.shape[-1] <= _DIFF_FORM_BUDGET:
@@ -41,7 +60,7 @@ def _sq_euclidean(x: jax.Array, y: jax.Array) -> jax.Array:
         return jnp.sum(diff * diff, axis=-1)
     x_sq = jnp.sum(x * x, axis=-1)
     y_sq = jnp.sum(y * y, axis=-1)
-    cross = x @ y.T
+    cross = _cross_f32(x, y)
     d2 = x_sq[:, None] + y_sq[None, :] - 2.0 * cross
     return jnp.maximum(d2, 0.0)
 
@@ -63,7 +82,7 @@ def supremum(x: jax.Array, y: jax.Array) -> jax.Array:
 
 def cosine(x: jax.Array, y: jax.Array) -> jax.Array:
     """1 - X.Y / (|X||Y|) — reference ``CosineSimilarity.java:27-40``."""
-    cross = x @ y.T
+    cross = _cross_f32(x, y)
     nx = jnp.sqrt(jnp.sum(x * x, axis=-1))
     ny = jnp.sqrt(jnp.sum(y * y, axis=-1))
     denom = nx[:, None] * ny[None, :]

@@ -60,15 +60,27 @@ def _bubble_device_block(rep, extent, nn_dist, n_b, num_valid, min_pts: int, dim
     valid = jnp.arange(m, dtype=jnp.int32) < num_valid
     dist = bubble_distance_matrix(rep, extent, nn_dist, metric)
     core = bubble_core_distances(dist, n_b, extent, min_pts, dims, valid=valid)
+    return _bubble_device_block_given_core(dist, core, n_b, num_valid)
+
+
+@jax.jit
+def _bubble_device_block_given_core(dist, core, n_b, num_valid):
+    """MRD + Borůvka over a corrected-distance matrix and core vector — the
+    shared tail of :func:`_bubble_device_block`, also entered directly by the
+    compat path (``core/compat.py`` computes cores host-side with the
+    reference's buggy walk, then rejoins the device pipeline here).
+
+    Packs everything the host fetches into ONE leaf (each fetched array pays
+    a full tunnel round trip): [u, v, w, mask | core, n_b], in w's dtype —
+    the layout :func:`unpack_edge_leaf` decodes. u/v/mask are ALSO returned
+    as device arrays so the follow-up reassign call reuses them without a
+    host->device upload.
+    """
     mrd = bubble_mutual_reachability(dist, core)
     u, v, w, mask, _ = boruvka_mst(mrd, num_valid)
-    # Pack everything the host fetches into ONE leaf (each fetched array pays
-    # a full tunnel round trip): [u, v, w, mask | core, n_b], in w's dtype.
-    # u/v/mask are ALSO returned as device arrays so the follow-up reassign
-    # call reuses them without a host->device upload.
     dt = w.dtype
     packed = jnp.concatenate(
-        [u.astype(dt), v.astype(dt), w, mask.astype(dt), core, n_b.astype(dt)]
+        [u.astype(dt), v.astype(dt), w, mask.astype(dt), core.astype(dt), n_b.astype(dt)]
     )
     return dist, u, v, mask, packed
 
@@ -122,11 +134,14 @@ def fit_bubbles(
     min_cluster_size: int,
     metric: str = "euclidean",
     num_valid: int | None = None,
+    compat_cf_int_math: bool = False,
 ) -> BubbleModel:
     """Cluster one subset's bubbles; returns flat labels + inter-cluster edges.
 
     ``num_valid``: real bubble count when the inputs are shape-padded; all
-    returned arrays are sliced back to it.
+    returned arrays are sliced back to it. ``compat_cf_int_math`` swaps the
+    core-distance step for the reference's faithful buggy walk
+    (``core/compat.reference_bubble_core_distances``).
     """
     rep = jnp.asarray(rep)
     m_pad, dims = rep.shape
@@ -151,16 +166,42 @@ def fit_bubbles(
             inter_edges=(empty, empty, np.zeros(0)),
             weights=w1,
         )
-    dist, u_d, v_d, mask_d, packed_d = _bubble_device_block(
-        rep,
-        jnp.asarray(extent),
-        jnp.asarray(nn_dist),
-        jnp.asarray(n_b, rep.dtype),
-        jnp.int32(m),
-        min_pts,
-        dims,
-        metric,
-    )
+    if compat_cf_int_math:
+        dist = bubble_distance_matrix(
+            rep, jnp.asarray(extent), jnp.asarray(nn_dist), metric
+        )
+        from hdbscan_tpu.core import compat
+
+        # The reference only ever builds CFs for samples that received
+        # points; our padded pipeline also carries zero-member bubbles, a
+        # shape the Java walk would crash on (its covering loop runs off the
+        # k-1 slot buffer). Compact to live bubbles — the same exclusion the
+        # default path's `ok` mask applies — and walk those faithfully.
+        nb_h = np.asarray(n_b, np.float64)[:m]
+        ext_h = np.asarray(extent, np.float64)[:m]
+        live = np.flatnonzero(nb_h > 0)
+        dist_h = np.asarray(jax.device_get(dist), np.float64)[:m, :m]
+        core_p = np.full(m_pad, np.inf)
+        core_p[live] = compat.reference_bubble_core_distances(
+            dist_h[np.ix_(live, live)], nb_h[live], ext_h[live], min_pts, dims
+        )
+        dist, u_d, v_d, mask_d, packed_d = _bubble_device_block_given_core(
+            dist,
+            jnp.asarray(core_p, rep.dtype),
+            jnp.asarray(n_b, rep.dtype),
+            jnp.int32(m),
+        )
+    else:
+        dist, u_d, v_d, mask_d, packed_d = _bubble_device_block(
+            rep,
+            jnp.asarray(extent),
+            jnp.asarray(nn_dist),
+            jnp.asarray(n_b, rep.dtype),
+            jnp.int32(m),
+            min_pts,
+            dims,
+            metric,
+        )
     # One single-leaf fetch for everything the host tree extraction needs.
     u_p, v_p, w_p, mask, core_p, n_b_h = _unpack_bubble_block(
         jax.device_get(packed_d), m_pad

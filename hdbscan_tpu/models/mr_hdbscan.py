@@ -529,7 +529,18 @@ def _fit_rows(
                 pts_p[:size] = data[ids]
                 asg_p = np.full(n_pad, s_pad, np.int32)
                 asg_p[:size] = assign
-                if weights is not None:
+                if params.compat_cf_int_math:
+                    from hdbscan_tpu.core.compat import combinestep_bubble_stats
+
+                    w_p = None
+                    if weights is not None:
+                        w_p = np.zeros(n_pad, np.float64)
+                        w_p[:size] = weights[ids]
+                    rep, extent, nn_dist, n_b = combinestep_bubble_stats(
+                        pts_p, asg_p, s_pad, weights=w_p
+                    )
+                    rep = jnp.asarray(rep)
+                elif weights is not None:
                     from hdbscan_tpu.core.bubbles import bubble_stats_weighted
 
                     w_p = np.zeros(n_pad, np.float64)
@@ -552,6 +563,7 @@ def _fit_rows(
                     params.min_cluster_size,
                     metric,
                     num_valid=s_count,
+                    compat_cf_int_math=params.compat_cf_int_math,
                 )
                 labels_s = model.labels
                 mu, mv, mw = model.mst

@@ -8,14 +8,36 @@ feature at a time (an outer difference per feature), so there is no float32
 catastrophic cancellation; the column operand is a host-transposed copy so
 each feature is a clean 2-D row slice.
 
-Measured on the 245k north-star set (one v5e chip): this kernel runs the
-full scan in ~16 s vs ~6 s for the XLA ``lax.top_k`` scan after the
-difference-form distance fix — the per-grid-step merge/reduction overhead
-dominates at these tiny k, and XLA's pipelined fused scan wins. The kernel
-is therefore NOT the default; it is kept as the Pallas substrate for future
-per-row-compaction selection (and as the reference implementation for
-exact-duplicate-safe distance tiles), with interpreter-mode unit tests
-guarding its semantics against the XLA path.
+Round-1 result (kept for the record): the naive column-ascending sweep ran
+the 245k north-star scan in ~16 s vs ~6 s for the XLA ``lax.top_k`` scan —
+the per-tile k-pass extraction merge dominates, and the whole-tile skip
+almost never fires because each row's k nearest columns are spread uniformly
+over the column tiles, so *some* row in every (256-row) tile always has a
+candidate.
+
+Round-2 schedule (this version): make the skip actually fire. The host
+pre-sorts points into Morton (z-curve) order so each row's nearest
+neighbors live in nearby *rows*, and the kernel visits column tiles in
+near-diagonal-first order (0, +1, −1, +2, −2, … around the row tile's own
+diagonal tile, via a custom BlockSpec index map — Pallas's automatic
+pipeline double-buffers the revisited output block and the permuted column
+stream). The running k-best then tightens to near-final values within the
+first few diagonal tiles, and the off-diagonal majority of tiles reduces to
+distance + one min + one compare with the merge skipped entirely.
+
+Round-2 measured outcome (one v5e chip, min_pts=16): the schedule helps
+where locality exists (gauss 200k×10d: 20.0 s diag vs 22.8 s scan) and not
+on Skin (21.5 vs 19.4 — lattice duplicates spread Morton keys), but the
+XLA ``lax.top_k`` scan stays 2–3× ahead (9.4 s / 7.2 s). A no-merge floor
+probe pinned the cause: the diff-form VPU distance loop ALONE costs
+14.9 s / 13.0 s — above XLA's entire fused scan — so merge frequency was
+never the binding constraint. The MXU dot-form variant (``form="dot"``)
+lost harder (31 s / 19–25 s): with the feature axis padded to 128 lanes the
+systolic K dimension does ~42× useful work at d ≤ 10, ×~6 for the full-f32
+passes. The kernel therefore stays NON-default (see ROADMAP "Pallas").
+The hunt's real payoff: its exact diff-form cross-check caught the XLA dot
+form running the cross matmul at default (bf16-pass) precision — ~1e-2
+core-distance error at d ≥ 9 shapes — fixed in ``core/distances._cross_f32``.
 
 Grid: (row_tiles, col_tiles), column-fastest; the output block for a row
 tile is revisited across the column sweep and accumulates the running k-best
@@ -38,6 +60,33 @@ COL_TILE = 2048
 LANES = 128  # TPU lane count: feature and k axes pad to this
 
 
+def morton_order(data: np.ndarray, max_dims: int = 21) -> np.ndarray:
+    """Host z-curve (Morton) sort permutation.
+
+    Quantizes each feature to ``b = 63 // d`` bits and interleaves them into
+    one uint64 key, so points close in space get close key values. Used to
+    pre-sort rows before the diagonal-order kernel sweep: after the sort a
+    row's k nearest neighbors are (mostly) in nearby rows, which is what
+    makes the kernel's whole-tile merge skip effective. High-d data keeps
+    only the first ``max_dims`` features for the key (1 bit/dim at d=63 is
+    already almost structureless; locality decays with d regardless).
+    """
+    x = np.asarray(data, np.float64)
+    d = min(x.shape[1], max_dims)
+    x = x[:, :d]
+    b = max(1, 63 // d)
+    lo, hi = x.min(axis=0), x.max(axis=0)
+    span = np.where(hi > lo, hi - lo, 1.0)
+    q = ((x - lo) / span * ((1 << b) - 1)).astype(np.uint64)
+    code = np.zeros(len(x), np.uint64)
+    for bit in range(b):
+        for dim in range(d):
+            code |= ((q[:, dim] >> np.uint64(bit)) & np.uint64(1)) << np.uint64(
+                bit * d + dim
+            )
+    return np.argsort(code, kind="stable")
+
+
 def _shift_insert(best, t: int, new_t, take):
     """Merged slot t gets ``new_t``; where the tile won, old slots shift right."""
     slot_iota = jax.lax.broadcasted_iota(jnp.int32, best.shape, 1)
@@ -46,21 +95,45 @@ def _shift_insert(best, t: int, new_t, take):
     return jnp.where(slot_iota == t, new_t[:, None], out)
 
 
-def _knn_kernel(xr_ref, xct_ref, colmask_ref, out_ref, *, d_real: int, k: int):
+def _knn_kernel(
+    xr_ref, xct_ref, colmask_ref, out_ref, *, d_real: int, k: int, form: str = "diff"
+):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
     def _():
         out_ref[:] = jnp.full_like(out_ref, jnp.inf)
 
-    # Exact difference-form squared distances, one feature at a time:
-    # d2 += (xr[:, f] - xcT[f, :])^2 as a (R, 1) x (1, C) outer difference.
     r = xr_ref.shape[0]
     c = xct_ref.shape[1]
-    d2 = jnp.zeros((r, c), jnp.float32)
-    for f in range(d_real):
-        diff = xr_ref[:, f : f + 1] - xct_ref[f : f + 1, :]
-        d2 = d2 + diff * diff
+    if form == "diff":
+        # Exact difference-form squared distances, one feature at a time:
+        # d2 += (xr[:, f] - xcT[f, :])^2 as a (R, 1) x (1, C) outer
+        # difference. Exact for duplicates, but VPU-bound: the measured
+        # no-merge floor of this form alone exceeds the whole XLA scan
+        # (ROADMAP "Pallas"), which is why the dot form exists.
+        d2 = jnp.zeros((r, c), jnp.float32)
+        for f in range(d_real):
+            diff = xr_ref[:, f : f + 1] - xct_ref[f : f + 1, :]
+            d2 = d2 + diff * diff
+    else:
+        # MXU dot form at full f32 (HIGHEST = enough bf16 passes for f32 —
+        # the default precision's ~0.8% error is what round 2 caught in the
+        # XLA path). Norms are recomputed per tile from the padded operands
+        # (feature padding is zeros, so lane/sublane sums are exact); the
+        # cancellation profile matches the fixed XLA dot form: absolute
+        # error ~eps * |x|^2, so near-duplicate distances are approximate —
+        # selection-grade, not duplicate-exact.
+        cross = jax.lax.dot_general(
+            xr_ref[:],
+            xct_ref[:],
+            (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )
+        nr = jnp.sum(xr_ref[:] * xr_ref[:], axis=1)
+        nc = jnp.sum(xct_ref[:] * xct_ref[:], axis=0)
+        d2 = jnp.maximum(nr[:, None] + nc[None, :] - 2.0 * cross, 0.0)
     d2 = d2 + colmask_ref[:]  # +inf on padding columns
 
     # Whole-tile skip: once the running k-best tightens (after the first col
@@ -91,7 +164,10 @@ def _knn_kernel(xr_ref, xct_ref, colmask_ref, out_ref, *, d_real: int, k: int):
 
 
 @partial(
-    jax.jit, static_argnames=("d_real", "k", "row_tile", "col_tile", "interpret")
+    jax.jit,
+    static_argnames=(
+        "d_real", "k", "row_tile", "col_tile", "order", "form", "interpret",
+    ),
 )
 def knn_smallest_pallas(
     data: jax.Array,
@@ -101,21 +177,61 @@ def knn_smallest_pallas(
     k: int,
     row_tile: int = ROW_TILE,
     col_tile: int = COL_TILE,
+    order: str = "diag",
+    form: str = "diff",
     interpret: bool = False,
 ) -> jax.Array:
     """(n_pad, LANES) padded data (+ its transpose) -> (n_pad, LANES) with the
     k smallest squared distances per row ascending in the first k lanes (self
-    included; padding columns must carry ``colmask`` = +inf)."""
+    included; padding columns must carry ``colmask`` = +inf).
+
+    ``order="diag"`` visits column tiles near-diagonal-first (0, +1, −1, …
+    offsets from the row tile's own column tile, wrapping): with
+    Morton-sorted rows the k-best tightens immediately and far tiles skip
+    their merge. ``order="scan"`` is the plain ascending sweep (round 1).
+    """
     n_pad = data.shape[0]
     assert n_pad % row_tile == 0 and n_pad % col_tile == 0
-    grid = (n_pad // row_tile, n_pad // col_tile)
+    if col_tile % row_tile != 0:
+        raise ValueError(
+            f"col_tile ({col_tile}) must be a multiple of row_tile "
+            f"({row_tile}) so the diagonal column tile of a row tile is "
+            "well-defined"
+        )
+    n_col_tiles = n_pad // col_tile
+    grid = (n_pad // row_tile, n_col_tiles)
+    ratio = col_tile // row_tile
+
+    if order == "diag":
+        # j-th visit for row tile i: offset 0, +1, -1, +2, -2, ... from the
+        # diagonal column tile i // ratio, wrapping mod n_col_tiles. For any
+        # tile count this enumerates each column tile exactly once.
+        def col_at(i, j):
+            half = (j + 1) // 2
+            sign = 2 * (j % 2) - 1  # odd j -> +half, even j -> -half (j=0 -> 0)
+            return (i // ratio + sign * half) % n_col_tiles
+
+    elif order == "scan":
+
+        def col_at(i, j):
+            return j
+
+    else:  # pragma: no cover - guarded by callers
+        raise ValueError(f"unknown column order {order!r}")
+
     return pl.pallas_call(
-        partial(_knn_kernel, d_real=d_real, k=k),
+        partial(_knn_kernel, d_real=d_real, k=k, form=form),
         grid=grid,
         in_specs=[
             pl.BlockSpec((row_tile, LANES), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((LANES, col_tile), lambda i, j: (0, j), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, col_tile), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (LANES, col_tile),
+                lambda i, j: (0, col_at(i, j)),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, col_tile), lambda i, j: (0, col_at(i, j)), memory_space=pltpu.VMEM
+            ),
         ],
         out_specs=pl.BlockSpec(
             (row_tile, LANES), lambda i, j: (i, 0), memory_space=pltpu.VMEM
@@ -131,13 +247,22 @@ def knn_core_distances_pallas(
     k: int | None = None,
     row_tile: int = ROW_TILE,
     col_tile: int = COL_TILE,
+    order: str = "diag",
+    form: str = "diff",
     interpret: bool = False,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Drop-in for ``ops.tiled.knn_core_distances`` (euclidean only).
 
     Returns ``(core, knn)`` with the same semantics: ``knn`` holds the k
     smallest distances per point ascending with self included; ``core`` is
-    the ``min_pts``-th smallest (self included).
+    the ``min_pts``-th smallest (self included). With ``order="diag"``
+    (default) rows are Morton-sorted host-side before the sweep and the
+    results permuted back — the sort only affects the *schedule* (which
+    tiles get to skip their merge), never the values. ``form="dot"`` moves
+    the distance tiles onto the MXU (full-f32 passes) — faster, but
+    near-duplicate distances become approximate (~eps·|x|² absolute), the
+    same profile as the XLA dot form; keep ``"diff"`` when duplicate
+    exactness matters.
     """
     n, d = data.shape
     if d > LANES:
@@ -145,6 +270,10 @@ def knn_core_distances_pallas(
     k = max(k or 0, max(min_pts - 1, 1))
     if k > LANES:
         raise ValueError(f"pallas knn kernel supports k <= {LANES}, got {k}")
+    perm = None
+    if order == "diag":
+        perm = morton_order(data)
+        data = np.asarray(data)[perm]
     n_pad = max(col_tile, row_tile)
     while n_pad < n:
         n_pad *= 2
@@ -154,9 +283,15 @@ def knn_core_distances_pallas(
     colmask[0, :n] = 0.0
     xj, xtj, mj = jax.device_put((x, np.ascontiguousarray(x.T), colmask))
     d2 = knn_smallest_pallas(
-        xj, xtj, mj, d, k, row_tile=row_tile, col_tile=col_tile, interpret=interpret
+        xj, xtj, mj, d, k,
+        row_tile=row_tile, col_tile=col_tile, order=order, form=form,
+        interpret=interpret,
     )
     knn = np.sqrt(np.maximum(np.asarray(d2, np.float64)[:n, :k], 0.0))
+    if perm is not None:
+        inv = np.empty_like(perm)
+        inv[perm] = np.arange(n)
+        knn = knn[inv]
     if min_pts <= 1:
         core = np.zeros(n, np.float64)
     else:

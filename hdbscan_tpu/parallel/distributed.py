@@ -1,0 +1,119 @@
+"""Multi-host (multi-controller) runtime — the DCN half of the L0 story.
+
+The reference's Spark cluster is inherently multi-host: the driver talks to
+executors over the network and every stage boundary round-trips HDFS
+(``main/Main.java:89-95``; SURVEY.md §2.C "communication backend"). The
+TPU-native equivalent is JAX's multi-controller model: one Python process
+per host, ``jax.distributed.initialize`` wiring them into a single logical
+device set, a ``Mesh`` spanning every chip, ICI collectives within a slice
+and DCN between hosts — all emitted by XLA from sharding annotations, never
+hand-written sends.
+
+This module carries the three pieces a multi-host run needs on top of the
+single-host code (which is multi-controller-clean already: everything device
+side is mesh-sharded, everything host-side orchestrates through numpy):
+
+- :func:`initialize_from_cluster_name` — process wiring, mapped onto the
+  reference's ``clusterName=`` flag (``local`` = single process, the
+  reference's ``local`` Spark master; ``auto`` = TPU-pod env autodetection;
+  explicit ``coordinator:port,process_id,num_processes`` otherwise).
+- :func:`host_row_slab` — per-host dataset ingest: each host loads only its
+  contiguous row slab (the analog of HDFS blocks feeding Spark partitions).
+- :func:`global_rows_from_local` — assembly of per-host slabs into one
+  globally-sharded device array over a mesh, via
+  ``jax.make_array_from_process_local_data`` (DCN touches data only when a
+  later resharding demands it).
+
+Single-process behavior is the identity (slab = whole set, assembly = plain
+``device_put``), which is what the tests pin; real multi-host runs need a
+TPU pod (ROADMAP "Misc" tracks that this is scaffolded, not yet demonstrated
+on hardware we don't have).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+__all__ = [
+    "initialize_from_cluster_name",
+    "host_row_slab",
+    "global_rows_from_local",
+    "process_count",
+    "process_index",
+]
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def initialize_from_cluster_name(cluster_name: str) -> bool:
+    """Wire this process into a multi-controller run per ``clusterName=``.
+
+    - ``"local"`` (the reference default, ``main/Main.java:71``): no-op.
+    - ``"auto"``: ``jax.distributed.initialize()`` with environment
+      autodetection (TPU pods publish coordinator/process info in the
+      runtime environment).
+    - ``"<coordinator_host:port>,<process_id>,<num_processes>"``: explicit
+      wiring for CPU/GPU clusters or manual pod bring-up.
+
+    Returns True if distributed init ran. Idempotence: calling again after a
+    successful init raises in JAX; callers gate on the return value.
+    """
+    if cluster_name in ("", "local"):
+        return False
+    if cluster_name == "auto":
+        jax.distributed.initialize()
+        return True
+    try:
+        coordinator, pid, nproc = cluster_name.rsplit(",", 2)
+        pid, nproc = int(pid), int(nproc)
+    except ValueError as e:
+        raise ValueError(
+            f"clusterName must be 'local', 'auto', or "
+            f"'<host:port>,<process_id>,<num_processes>'; got {cluster_name!r}"
+        ) from e
+    # Outside the except: init's own errors (bad ranks, unreachable
+    # coordinator) must surface as themselves, not as a format complaint.
+    jax.distributed.initialize(
+        coordinator_address=coordinator, process_id=pid, num_processes=nproc
+    )
+    return True
+
+
+def host_row_slab(n_rows: int, index: int | None = None, count: int | None = None):
+    """This host's contiguous row range [start, stop) of an n-row dataset.
+
+    Slabs are balanced to within one row (first ``n % count`` hosts get the
+    extra), covering all rows exactly once across processes — each host
+    loads only its slab (the HDFS-block analog; SURVEY.md §2.C P6).
+    """
+    index = process_index() if index is None else index
+    count = process_count() if count is None else count
+    base, extra = divmod(n_rows, count)
+    start = index * base + min(index, extra)
+    stop = start + base + (1 if index < extra else 0)
+    return start, stop
+
+
+def global_rows_from_local(
+    local_rows: np.ndarray, mesh, n_global: int
+) -> jax.Array:
+    """Assemble per-host row slabs into one row-sharded global device array.
+
+    ``mesh`` must span all processes' devices with its (single) axis over
+    rows; ``n_global`` is the full dataset length (the slabs' sum). With one
+    process this degenerates to a sharded ``device_put`` of the whole set.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = NamedSharding(mesh, PartitionSpec(mesh.axis_names[0]))
+    global_shape = (n_global, *local_rows.shape[1:])
+    return jax.make_array_from_process_local_data(
+        sharding, np.ascontiguousarray(local_rows), global_shape
+    )

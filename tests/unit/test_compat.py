@@ -1,0 +1,209 @@
+"""Tests for the reference bug-compat CF math (``core/compat.py``).
+
+The oracle here is a deliberately literal, list-based transliteration of the
+Java control flow (the ``tests/oracle`` pattern: shared semantics, no shared
+code) — the shipped implementation refactors the buffer shifts into NumPy
+slicing, so the two agreeing on random inputs checks the refactor kept the
+reference's exact (buggy) behavior.
+"""
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.core.bubbles import bubble_stats
+from hdbscan_tpu.core.compat import (
+    combinestep_bubble_stats,
+    reference_bubble_core_distances,
+)
+
+JMAX = np.finfo(np.float64).max
+
+
+def _java_core_walk(dist, n_b, e_b, k, dims):
+    """Literal transliteration of HdbscanDataBubbles.java:75-146."""
+    m = len(n_b)
+    num_neighbors = k - 1
+    core = [0.0] * m
+    if k == 1:
+        return np.array(core)
+    index_bubbles = [0] * num_neighbors
+    for point in range(m):
+        knn = [JMAX] * num_neighbors
+        for neighbor in range(m):
+            if point == neighbor:
+                continue
+            distance = dist[point][neighbor]
+            ni = num_neighbors
+            while ni >= 1 and distance < knn[ni - 1]:
+                ni -= 1
+            if ni < num_neighbors:
+                for shift in range(num_neighbors - 1, ni, -1):
+                    knn[shift] = knn[shift - 1]
+                knn[ni] = distance
+                index_bubbles[ni] = neighbor
+        if n_b[point] >= num_neighbors:
+            core[point] = (num_neighbors // n_b[point]) ** (1 // dims) * e_b[point]
+        else:
+            n_x = n_b[point]
+            i = 0
+            while n_x < num_neighbors:
+                n_x += n_b[index_bubbles[i]]
+                i += 1
+            s = n_b[point]
+            aux = 0
+            for j in range(i):
+                distance_c = dist[index_bubbles[j]][i]
+                if s < num_neighbors and knn[j] < distance_c:
+                    aux = num_neighbors - s
+                s += n_b[index_bubbles[j]]
+            core[point] = knn[i] + (aux // n_b[i]) ** (1 // dims) * e_b[i]
+    return np.array(core)
+
+
+class TestCombineStepStats:
+    def test_hand_computed_square(self):
+        """4 corners of a square in one bubble: per-dim var = 32/12."""
+        pts = np.array([[0.0, 0.0], [2.0, 0.0], [0.0, 2.0], [2.0, 2.0]])
+        rep, extent, nn_dist, n = combinestep_bubble_stats(
+            pts, np.zeros(4, np.int32), 1
+        )
+        np.testing.assert_allclose(rep, [[1.0, 1.0]])
+        np.testing.assert_allclose(extent, [np.sqrt(32.0 / 12.0)])
+        # d > 1: the int-division exponent collapses, nnDist == extent.
+        np.testing.assert_allclose(nn_dist, extent)
+        np.testing.assert_allclose(n, [4.0])
+
+    def test_diverges_from_correct_math(self, rng):
+        pts = rng.normal(size=(200, 3))
+        asg = rng.integers(0, 4, size=200).astype(np.int32)
+        rep_c, ext_c, nnd_c, n_c = map(np.asarray, bubble_stats(pts, asg, 4))
+        rep_b, ext_b, nnd_b, n_b = combinestep_bubble_stats(pts, asg, 4)
+        np.testing.assert_allclose(rep_b, rep_c, rtol=1e-5)  # rep/n agree
+        np.testing.assert_allclose(n_b, n_c, rtol=1e-6)
+        # extent: mean-of-sqrts < sqrt-of-sum (strictly, for generic data)
+        assert np.all(ext_b < np.asarray(ext_c) - 1e-9)
+        # nnDist: compat equals its extent; correct carries (1/n)^(1/d).
+        np.testing.assert_allclose(nnd_b, ext_b)
+        assert np.all(np.asarray(nnd_c) < np.asarray(ext_c))
+
+    def test_one_dimensional_nn_dist(self):
+        pts = np.linspace(0, 1, 10)[:, None]
+        _, extent, nn_dist, n = combinestep_bubble_stats(
+            pts, np.zeros(10, np.int32), 1
+        )
+        np.testing.assert_allclose(nn_dist, extent / 10.0)
+
+    def test_weighted_matches_repeated_rows(self, rng):
+        base = rng.normal(size=(30, 2))
+        w = rng.integers(1, 5, size=30)
+        asg = rng.integers(0, 3, size=30).astype(np.int32)
+        full = np.repeat(base, w, axis=0)
+        asg_full = np.repeat(asg, w)
+        a = combinestep_bubble_stats(base, asg, 3, weights=w.astype(np.float64))
+        b = combinestep_bubble_stats(full, asg_full, 3)
+        for x, y in zip(a, b):
+            np.testing.assert_allclose(x, y, rtol=1e-9)
+
+
+class TestReferenceCoreWalk:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_matches_literal_transliteration(self, seed):
+        rng = np.random.default_rng(seed)
+        m = 12
+        d = rng.uniform(0.1, 5.0, size=(m, m))
+        d = (d + d.T) / 2
+        np.fill_diagonal(d, 0.0)
+        n_b = rng.integers(1, 6, size=m)
+        e_b = rng.uniform(0.0, 1.0, size=m)
+        k = 5
+        got = reference_bubble_core_distances(d, n_b, e_b, k)
+        want = _java_core_walk(d.tolist(), n_b.tolist(), e_b.tolist(), k, 2)
+        np.testing.assert_allclose(got, want, rtol=0, atol=0)
+
+    def test_big_bubble_gets_extent(self):
+        d = np.array([[0.0, 1.0], [1.0, 0.0]])
+        core = reference_bubble_core_distances(d, [10, 10], [0.3, 0.7], 4)
+        np.testing.assert_allclose(core, [0.3, 0.7])
+
+    def test_stale_index_buffer_carries_across_points(self):
+        """A point whose scan encounters neighbors in decreasing-distance
+        order only ever writes slot 0 of the shared indexBubbles (insertions
+        at position 0 shift kNNDistances but NOT indexBubbles), so its
+        covering walk reads earlier points' leftovers; when the stale entry
+        names a bubble with a different member count the walk stops at a
+        different slot and the core distance changes — the reference bug the
+        compat mode must reproduce. Instance found by search (seed 79 below):
+        point 4's compat core differs from the intended fresh-buffer walk."""
+        rng = np.random.default_rng(79)
+        m = 8
+        d = rng.uniform(0.1, 5.0, size=(m, m))
+        d = (d + d.T) / 2
+        np.fill_diagonal(d, 0.0)
+        n_b = rng.integers(1, 8, size=m)
+        e_b = rng.uniform(0.0, 1.0, size=m)
+        got = reference_bubble_core_distances(d, n_b, e_b, 6)
+        want = _java_core_walk(d.tolist(), n_b.tolist(), e_b.tolist(), 6, 2)
+        np.testing.assert_allclose(got, want, rtol=0, atol=0)
+        fresh = _fresh_buffer_walk(d, n_b, e_b, 6)
+        assert not np.allclose(got, fresh)
+
+    def test_min_pts_one(self):
+        d = np.zeros((3, 3))
+        core = reference_bubble_core_distances(d, [1, 1, 1], [1.0, 1.0, 1.0], 1)
+        np.testing.assert_allclose(core, 0.0)
+
+
+def _fresh_buffer_walk(dist, n_b, e_b, k):
+    """The walk as it was presumably INTENDED (buffer reset per point) — used
+    only to demonstrate the stale-buffer test actually exercises the bug."""
+    m = len(n_b)
+    num_neighbors = k - 1
+    core = np.zeros(m)
+    for point in range(m):
+        index_bubbles = [0] * num_neighbors
+        knn = [JMAX] * num_neighbors
+        for neighbor in range(m):
+            if point == neighbor:
+                continue
+            distance = dist[point][neighbor]
+            ni = num_neighbors
+            while ni >= 1 and distance < knn[ni - 1]:
+                ni -= 1
+            if ni < num_neighbors:
+                for shift in range(num_neighbors - 1, ni, -1):
+                    knn[shift] = knn[shift - 1]
+                knn[ni] = distance
+                index_bubbles[ni] = neighbor
+        if n_b[point] >= num_neighbors:
+            core[point] = e_b[point]
+        else:
+            n_x = n_b[point]
+            i = 0
+            while n_x < num_neighbors:
+                n_x += n_b[index_bubbles[i]]
+                i += 1
+            core[point] = knn[i] + e_b[i]
+    return core
+
+
+class TestPipelineFlag:
+    def test_mr_pipeline_runs_with_compat(self, rng):
+        from hdbscan_tpu.config import HDBSCANParams
+        from hdbscan_tpu.models import mr_hdbscan
+        from hdbscan_tpu.utils.datasets import make_gauss
+
+        data, _ = make_gauss(2000, dims=3, n_clusters=4, seed=0)
+        params = HDBSCANParams(
+            min_points=4,
+            min_cluster_size=50,
+            processing_units=600,
+            k=0.05,
+            seed=0,
+            compat_cf_int_math=True,
+        )
+        r = mr_hdbscan.fit(data, params)
+        assert r.labels.shape == (2000,)
+        assert r.labels.min() >= 0
+        # The flag must actually change the CF statistics feeding the model.
+        r2 = mr_hdbscan.fit(data, params.replace(compat_cf_int_math=False))
+        assert r2.labels.shape == (2000,)

@@ -25,3 +25,29 @@ def test_self_matrix_symmetric_zero_diag(rng, metric):
 def test_unknown_metric_raises(rng):
     with pytest.raises(ValueError):
         D.pairwise_distance(np.zeros((2, 2)), np.zeros((2, 2)), "hamming")
+
+
+def test_dot_form_accuracy_past_budget(rng):
+    """Shapes past the diff-form budget take the MXU dot form; its cross
+    matmul must run at full input precision. On TPU the default precision is
+    bf16 passes (~1e-2 absolute core-distance error at 10-d — the round-2
+    regression this test pins); the fixed path is accurate to cancellation
+    level everywhere."""
+    d = 10
+    x = rng.normal(size=(1024, d)).astype(np.float32)
+    y = rng.normal(size=(4096, d)).astype(np.float32)
+    assert x.shape[0] * y.shape[0] * d > D._DIFF_FORM_BUDGET  # dot form selected
+    got = np.asarray(D.pairwise_distance(x, y, "euclidean"))
+    want = np.sqrt(
+        ((x.astype(np.float64)[:, None, :] - y.astype(np.float64)[None, :, :]) ** 2).sum(-1)
+    )
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=1e-4)
+
+
+def test_cross_f32_uses_highest_precision():
+    """The precision request must survive tracing (guards against the bf16
+    default sneaking back in a refactor)."""
+    import jax
+
+    jaxpr = jax.make_jaxpr(D._cross_f32)(np.zeros((8, 4), np.float32), np.zeros((8, 4), np.float32))
+    assert "HIGHEST" in str(jaxpr)

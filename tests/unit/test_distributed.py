@@ -1,0 +1,62 @@
+"""Single-process tests for the multi-host scaffolding (``parallel/distributed``).
+
+Real multi-host needs a pod; these pin the single-process degenerate
+behaviors (identity slab, sharded assembly on the virtual mesh) and the slab
+arithmetic for arbitrary process counts.
+"""
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.parallel.distributed import (
+    global_rows_from_local,
+    host_row_slab,
+    initialize_from_cluster_name,
+)
+from hdbscan_tpu.parallel.mesh import get_mesh
+
+
+class TestHostRowSlab:
+    def test_single_process_is_identity(self):
+        assert host_row_slab(1000, index=0, count=1) == (0, 1000)
+
+    @pytest.mark.parametrize("n,count", [(10, 3), (1000, 8), (7, 8), (0, 4)])
+    def test_slabs_partition_the_rows(self, n, count):
+        stops = [host_row_slab(n, index=i, count=count) for i in range(count)]
+        assert stops[0][0] == 0
+        assert stops[-1][1] == n
+        for (a, b), (c, d) in zip(stops, stops[1:]):
+            assert b == c  # contiguous, non-overlapping
+        sizes = [b - a for a, b in stops]
+        assert max(sizes) - min(sizes) <= 1  # balanced within one row
+
+    def test_live_process_defaults(self):
+        start, stop = host_row_slab(100)
+        assert (start, stop) == (0, 100)  # single-process run
+
+
+class TestClusterNameWiring:
+    def test_local_is_noop(self):
+        assert initialize_from_cluster_name("local") is False
+        assert initialize_from_cluster_name("") is False
+
+    def test_malformed_spec_raises(self):
+        with pytest.raises(ValueError, match="clusterName"):
+            initialize_from_cluster_name("not-a-spec-without-commas,x")
+
+
+class TestGlobalAssembly:
+    def test_row_sharded_assembly_on_mesh(self):
+        """Per-host slab -> globally row-sharded array; one process owns all
+        shards, so the assembled array must equal the local rows and be laid
+        out over every mesh device."""
+        import jax
+
+        mesh = get_mesh()
+        n = 8 * 5
+        local = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+        arr = global_rows_from_local(local, mesh, n)
+        assert arr.shape == (n, 3)
+        np.testing.assert_array_equal(np.asarray(arr), local)
+        assert len(arr.sharding.device_set) == len(mesh.devices.ravel())
+        assert len(jax.devices()) >= 1

@@ -30,3 +30,64 @@ class TestPallasKnnKernel:
     def test_dimension_limit(self, rng):
         with pytest.raises(ValueError):
             knn_core_distances_pallas(rng.normal(size=(10, 200)), 4, interpret=True)
+
+    def test_diag_order_matches_scan_order(self, rng):
+        """The near-diagonal-first visit order (+ Morton row sort) is pure
+        schedule: values must match the plain ascending sweep exactly."""
+        data = rng.normal(size=(700, 5))
+        core_d, knn_d = knn_core_distances_pallas(
+            data, 8, order="diag", row_tile=64, col_tile=128, interpret=True
+        )
+        core_s, knn_s = knn_core_distances_pallas(
+            data, 8, order="scan", row_tile=64, col_tile=128, interpret=True
+        )
+        np.testing.assert_allclose(core_d, core_s, rtol=0, atol=0)
+        np.testing.assert_allclose(knn_d, knn_s, rtol=0, atol=0)
+
+    def test_dot_form_matches_within_cancellation(self, rng):
+        """form="dot" trades duplicate-exactness for MXU distances; values
+        must agree with the diff form to dot-form cancellation error."""
+        data = rng.normal(size=(600, 10))
+        core_d, knn_d = knn_core_distances_pallas(
+            data, 8, form="dot", row_tile=64, col_tile=128, interpret=True
+        )
+        core_f, knn_f = knn_core_distances_pallas(
+            data, 8, form="diff", row_tile=64, col_tile=128, interpret=True
+        )
+        # atol: cancellation turns the exact-zero self distances into
+        # ~sqrt(eps * |x|^2) ~ 2e-3 at 10-d unit-scale data.
+        np.testing.assert_allclose(core_d, core_f, atol=5e-3, rtol=1e-4)
+        np.testing.assert_allclose(knn_d, knn_f, atol=5e-3, rtol=1e-4)
+
+    def test_diag_order_matches_xla(self, rng):
+        data = rng.normal(size=(500, 3))
+        core_p, knn_p = knn_core_distances_pallas(data, 8, order="diag", interpret=True)
+        core_x, knn_x = knn_core_distances(data, 8)
+        np.testing.assert_allclose(core_p, core_x, rtol=1e-5)
+        np.testing.assert_allclose(
+            knn_p, knn_x[:, : knn_p.shape[1]], rtol=1e-5, atol=1e-7
+        )
+
+
+class TestMortonOrder:
+    def test_is_permutation(self, rng):
+        from hdbscan_tpu.ops.pallas_knn import morton_order
+
+        data = rng.normal(size=(333, 7))
+        perm = morton_order(data)
+        assert sorted(perm.tolist()) == list(range(333))
+
+    def test_locality(self, rng):
+        """Points in the same tight spatial cluster should land in one
+        contiguous key range: mean index distance between same-cluster points
+        must be far below the random-order expectation."""
+        from hdbscan_tpu.ops.pallas_knn import morton_order
+
+        centers = rng.uniform(-100, 100, size=(20, 3))
+        data = np.repeat(centers, 50, axis=0) + rng.normal(scale=0.01, size=(1000, 3))
+        perm = morton_order(data)
+        inv = np.empty(1000, np.int64)
+        inv[perm] = np.arange(1000)
+        spread = [np.ptp(inv[i * 50 : (i + 1) * 50]) for i in range(20)]
+        # Random placement would give ptp ~ n; clustered keys give ~ cluster size.
+        assert np.median(spread) < 120
