@@ -20,6 +20,8 @@ import time
 
 import numpy as np
 
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
 from hdbscan_tpu import HDBSCANParams
 from hdbscan_tpu.models import mr_hdbscan
 from hdbscan_tpu.utils.datasets import make_gauss

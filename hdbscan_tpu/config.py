@@ -83,6 +83,19 @@ class HDBSCANParams:
     #: 4.8x fewer rows (23x less scan work) on the lattice-valued north-star
     #: set. Off by default for strict row-level reference parity.
     dedup_points: bool = False
+    #: Cap on samples drawn per oversized subset (``k`` gives the fraction;
+    #: this bounds the absolute count). The bubble model holds a dense
+    #: (m, m) corrected-distance matrix plus ~6 same-shape temps on device:
+    #: 16384 ≈ 1 GB per matrix ≈ 8 GB peak — the single-chip HBM budget.
+    #: At 4M+ points an uncapped k=0.01 draw (40k+ samples, pow2-padded to
+    #: 65536) compiles a 17 GB matrix and OOMs a 15.75 GB chip; the cap
+    #: trades first-level partition granularity (more recursion levels)
+    #: for bounded memory. The reference has the same cliff un-handled: its
+    #: sampleByKeyExact fraction is unbounded per worker. The sample axis is
+    #: pow2-PADDED on device, so the effective cap is rounded DOWN to a
+    #: power of two (a non-pow2 value would silently bound memory at up to
+    #: 2x the configured footprint).
+    max_samples: int = 16384
     #: Reproduce the reference's LIVE integer-math CF behaviors instead of
     #: the correct double math (``core/compat.py``): CombineStep's
     #: mean-of-per-dim-sqrt extent and collapsed nnDist exponent
@@ -105,6 +118,8 @@ class HDBSCANParams:
             raise ValueError("k (sample fraction) must be in (0, 1]")
         if self.processing_units < 1:
             raise ValueError("processing_units must be >= 1")
+        if self.max_samples < 2:
+            raise ValueError("max_samples must be >= 2")
         if self.variant not in ("db", "rs"):
             raise ValueError(f"variant must be 'db' or 'rs', got {self.variant!r}")
         if not (0.0 <= self.boundary_quality < 1.0):
@@ -155,6 +170,7 @@ class HDBSCANParams:
             "global_cores": ("global_core_distances", lambda s: s.lower() == "true"),
             "refine": ("refine_iterations", int),
             "boundary": ("boundary_quality", float),
+            "max_samples": ("max_samples", int),
             "compat_cf": ("compat_cf_int_math", lambda s: s.lower() == "true"),
         }
         kwargs = {}

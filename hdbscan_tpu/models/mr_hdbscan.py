@@ -93,19 +93,43 @@ class MRHDBSCANResult:
     dedup_inverse: np.ndarray | None = None
 
 
+#: Adaptive boundary criterion: a point's per-block core distance is damaged
+#: iff its k-NN ball reaches across a partition seam, i.e. seam distance <=
+#: ball radius. ``margin`` upper-bounds the seam distance and the per-block
+#: core upper-bounds the true ball radius, so ``margin <= ALPHA * core``
+#: with ALPHA = 1 captures the at-risk set directly. Measured on Gauss
+#: 200k x 10-d, sep 7 (26 blocks): 99.8% of the actually-inflated cores
+#: selected at 21.5% of n, where the round-2 fixed 5%-fraction rule covered
+#: only 25% of them — and missing them is what let seam-inflated interior
+#: weights erase the intra/inter-cluster contrast (clusters merged, ARI vs
+#: exact 0.70; adaptive selection restores 0.99 — ROADMAP "Scaling").
+_BOUNDARY_ALPHA = 1.0
+
+#: Hard cap on the boundary-set fraction. The adaptive criterion is
+#: open-ended by design (it selects whatever the data's seam population
+#: demands), but past ~half the dataset the boundary phase's O(m·n·d) scan
+#: approaches the full exact scan the mode exists to avoid — at that point
+#: exact/fullq is the right tool, so the selection truncates (most-at-risk
+#: first, floor preserved) and warns instead of silently paying ~n².
+_BOUNDARY_MAX_FRAC = 0.5
+
+
 def _select_boundary(
     margin: np.ndarray,
     subset: np.ndarray,
     q: float,
+    core: np.ndarray | None = None,
     min_per_block: int = 32,
 ) -> np.ndarray:
-    """Boundary-point ids: per final block, the smallest-margin members.
+    """Boundary-point ids: the adaptive at-risk set plus a per-block floor.
 
-    Per-block quantile selection (the lowest ``q`` fraction, floored at
-    ``min_per_block``) is density-adaptive — a global margin threshold would
-    mix distance scales across blocks of different density — and guarantees
-    every block contributes glue representatives, keeping the inter-block
-    harvest connected.
+    Selected = { margin <= ALPHA * per-block core } ∪ { per final block, the
+    lowest-``q``-fraction margins, floored at ``min_per_block`` }. The
+    adaptive term is the correctness criterion (see ``_BOUNDARY_ALPHA``);
+    the per-block quantile floor guarantees every block contributes glue
+    representatives — keeping the inter-block harvest connected — and is
+    density-adaptive where a global margin threshold would mix distance
+    scales across blocks.
     """
     n = len(margin)
     _, inv = np.unique(subset, return_inverse=True)
@@ -117,7 +141,31 @@ def _select_boundary(
     starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
     rank = np.empty(n, np.int64)
     rank[order] = np.arange(n) - np.repeat(starts, counts)
-    return np.nonzero(rank < take[inv])[0]
+    sel = rank < take[inv]
+    if core is not None:
+        adaptive = margin <= _BOUNDARY_ALPHA * core
+        max_n = int(np.ceil(_BOUNDARY_MAX_FRAC * n))
+        if int((sel | adaptive).sum()) > max_n:
+            import warnings
+
+            extras = np.nonzero(adaptive & ~sel)[0]
+            budget = max(0, max_n - int(sel.sum()))
+            # Most-at-risk first: smallest margin-to-ball-radius slack.
+            score = margin[extras] - _BOUNDARY_ALPHA * core[extras]
+            keep = extras[np.argsort(score, kind="stable")[:budget]]
+            sel = sel.copy()
+            sel[keep] = True
+            warnings.warn(
+                f"boundary set capped at {_BOUNDARY_MAX_FRAC:.0%} of points "
+                f"({int(adaptive.sum())} at-risk by the margin<=core "
+                "criterion); quality may degrade toward the fixed-fraction "
+                "mode — at this seam density the exact or fullq path is "
+                "the better tool",
+                stacklevel=3,
+            )
+        else:
+            sel = sel | adaptive
+    return np.nonzero(sel)[0]
 
 
 def _reweight_pool(
@@ -498,7 +546,12 @@ def _fit_rows(
         for ids in large:
             size = len(ids)
             forced_before = forced
-            s_count = min(size, max(2, math.ceil(params.k * size)))
+            # max_samples bounds the dense (m, m) bubble program's HBM
+            # footprint (config.max_samples); the fraction k applies below
+            # it. Rounded down to pow2 because the sample axis pow2-pads on
+            # device — the configured footprint must be the compiled one.
+            cap_s = 1 << (params.max_samples.bit_length() - 1)
+            s_count = min(size, max(2, math.ceil(params.k * size)), cap_s)
             samp_local = rng.choice(size, s_count, replace=False)
             samples_global = ids[samp_local]
             assign = nearest_sample_assign(data[ids], data[samples_global], metric)
@@ -707,7 +760,7 @@ def _fit_rows(
         #    (final_block, NOT subset: subset ids are per-level and collide
         #    across freeze levels).
         t0 = time.monotonic()
-        bset = _select_boundary(bmargin, final_block, boundary_q)
+        bset = _select_boundary(bmargin, final_block, boundary_q, core=core)
         # 2) Exact global core distances for boundary points only (their
         #    per-block cores inflate at the seam); np.minimum guards against
         #    float32 scan jitter ever raising a core.

@@ -182,17 +182,20 @@ def knn_core_distances(
     # Bound per-dispatch device runtime: one huge program (minutes at n >= 1M)
     # can trip worker/tunnel deadlines. Row blocks of <= _DISPATCH_ROWS rows
     # scan against the full column set; dispaches pipeline (JAX async).
-    chunk_rows = max(row_tile, min(_DISPATCH_ROWS, n_pad))
-    pending = []
-    for a in range(0, n_pad, chunk_rows):
-        b = min(a + chunk_rows, n_pad)
-        pending.append(
-            _knn_core_scan(
-                data_p[a:b], data_p, valid_p, k, metric, row_tile, col_tile,
-                with_indices=return_indices,
-            )
+    chunk_rows = _chunk_rows(n_pad, row_tile, n_pad)
+    fetched = _drain_window(
+        _knn_core_scan(
+            data_p[a : min(a + chunk_rows, n_pad)],
+            data_p,
+            valid_p,
+            k,
+            metric,
+            row_tile,
+            col_tile,
+            with_indices=return_indices,
         )
-    fetched = jax.device_get(pending)
+        for a in range(0, n_pad, chunk_rows)
+    )
     knn = np.concatenate([np.asarray(c[0], np.float64) for c in fetched])[:n]
     if return_indices:
         idx = np.concatenate([np.asarray(c[1]) for c in fetched])[:n]
@@ -236,22 +239,21 @@ def knn_core_distances_rows(
     # sweep), not the row count: at n in the millions even a modest row chunk
     # is minutes of device time, and a >1-minute program can trip
     # worker/tunnel deadlines.
-    budget_pairs = _DISPATCH_ROWS << 20
-    chunk_rows = max(row_tile, _next_pow2(budget_pairs // n_pad) >> 1)
-    chunk_rows = min(chunk_rows, m_pad)
-    pending = [
-        _knn_core_scan(
-            rows[a : min(a + chunk_rows, m_pad)],
-            data_p,
-            valid_p,
-            k,
-            metric,
-            row_tile,
-            col_tile,
-        )
-        for a in range(0, m_pad, chunk_rows)
-    ]
-    fetched = jax.device_get(pending)
+    chunk_rows = _chunk_rows(n_pad, row_tile, m_pad)
+    fetched = _drain_window(
+        (
+            _knn_core_scan(
+                rows[a : min(a + chunk_rows, m_pad)],
+                data_p,
+                valid_p,
+                k,
+                metric,
+                row_tile,
+                col_tile,
+            )
+            for a in range(0, m_pad, chunk_rows)
+        ),
+    )
     knn = np.concatenate([np.asarray(c[0], np.float64) for c in fetched])[:m]
     if min_pts <= 1:
         return np.zeros(m, np.float64)
@@ -260,6 +262,40 @@ def knn_core_distances_rows(
 
 def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
+
+
+def _chunk_rows(n_cols_pad: int, row_tile: int, m_pad: int, shift: int = 20) -> int:
+    """Rows per dispatch so one program stays under the PAIR budget
+    (``_DISPATCH_ROWS << shift`` row·column pairs against ``n_cols_pad``
+    columns). The result is a pow2 multiple of ``row_tile`` (or ``m_pad``
+    itself, which every caller pads to a row_tile multiple), so every chunk
+    including the remainder divides by ``row_tile`` — the invariant the scan
+    kernels' reshapes rely on. One copy of this arithmetic; the three
+    chunked scans all call it.
+    """
+    budget_pairs = _DISPATCH_ROWS << shift
+    chunk = max(row_tile, _next_pow2(budget_pairs // n_cols_pad) >> 1)
+    return min(chunk, m_pad)
+
+
+def _drain_window(dispatch_iter, max_inflight: int = 4) -> list:
+    """Fetch results of a lazy dispatch stream with a bounded in-flight window.
+
+    Long chunked scans (hundreds of programs at multi-M rows) must NOT
+    enqueue every dispatch up front: a deep async queue holds every pending
+    output device-resident and keeps the tunnel saturated for the scan's
+    whole duration — measured to drop the TPU backend connection outright
+    during the 4M boundary scan (round 2). A window of a few programs keeps
+    compute/transfer overlapped while the host drains results as they land.
+    """
+    out: list = []
+    window: list = []
+    for item in dispatch_iter:
+        window.append(item)
+        if len(window) >= max_inflight:
+            out.append(jax.device_get(window.pop(0)))
+    out.extend(jax.device_get(window))
+    return out
 
 
 def _min_out_row_block(
@@ -301,27 +337,31 @@ def _min_out_row_block(
     return jax.lax.fori_loop(0, n_col_tiles, col_step, (bw0, bj0))
 
 
-@partial(jax.jit, static_argnames=("metric", "row_tile", "col_tile"))
+@partial(jax.jit, static_argnames=("metric", "row_tile", "col_tile", "n_rows"))
 def _min_outgoing_scan(
-    data, core, comp, valid, metric: str, row_tile: int, col_tile: int
+    data, core, comp, valid, start, metric: str, row_tile: int, col_tile: int,
+    n_rows: int,
 ):
-    """One full Borůvka scan: per-point min mutual-reachability outgoing edge.
+    """Borůvka scan of rows [start, start+n_rows): per-point min
+    mutual-reachability outgoing edge against the FULL column set.
 
     ``comp``: (n_pad,) int32 component labels. Returns (best_w, best_j) with
     ``best_j = -1`` / ``best_w = +inf`` where no outgoing edge exists.
     Deterministic tie-break: smallest column index j wins (argmin first-hit
-    over ascending j), making round output independent of tiling.
+    over ascending j), making round output independent of tiling. Callers
+    dispatch row chunks so no single device program exceeds the pair budget
+    (a multi-minute program trips the tunnel worker deadline — the 4M
+    boundary-glue failure mode, round 2).
     """
-    n_pad = data.shape[0]
 
     def row_step(r):
         return _min_out_row_block(
-            data, core, comp, valid, r * row_tile, metric, row_tile, col_tile
+            data, core, comp, valid, start + r * row_tile, metric, row_tile,
+            col_tile,
         )
 
-    n_row_tiles = n_pad // row_tile
-    bw, bj = jax.lax.map(row_step, jnp.arange(n_row_tiles))
-    return bw.reshape(n_pad), bj.reshape(n_pad)
+    bw, bj = jax.lax.map(row_step, jnp.arange(n_rows // row_tile))
+    return bw.reshape(n_rows), bj.reshape(n_rows)
 
 
 def boruvka_glue_edges(
@@ -528,15 +568,27 @@ class BoruvkaScanner:
         else:
             comp_p = jnp.asarray(comp_p)
         if self.mesh is None:
-            out = _min_outgoing_scan(
-                self._data,
-                self._core,
-                comp_p,
-                self._valid,
-                self.metric,
-                self.row_tile,
-                self.col_tile,
+            # Chunked dispatch by PAIR budget (rows x full column sweep):
+            # one giant program at large n is minutes of device time and
+            # trips the tunnel worker deadline. Smaller budget than the knn
+            # scans (shift 19): a Borůvka round re-dispatches every round.
+            chunk = _chunk_rows(self.n_pad, self.row_tile, self.n_pad, shift=19)
+            parts = _drain_window(
+                _min_outgoing_scan(
+                    self._data,
+                    self._core,
+                    comp_p,
+                    self._valid,
+                    jnp.int32(a),
+                    self.metric,
+                    self.row_tile,
+                    self.col_tile,
+                    min(chunk, self.n_pad - a),
+                )
+                for a in range(0, self.n_pad, chunk)
             )
+            bw = np.concatenate([p[0] for p in parts])
+            bj = np.concatenate([p[1] for p in parts])
         else:
             out = _min_outgoing_scan_sharded(
                 self.mesh,
@@ -549,7 +601,7 @@ class BoruvkaScanner:
                 self.row_tile,
                 self.col_tile,
             )
-        bw, bj = jax.device_get(out)
+            bw, bj = jax.device_get(out)
         return (
             np.asarray(bw, np.float64)[: self.n],
             np.asarray(bj, np.int64)[: self.n],

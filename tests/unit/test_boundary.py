@@ -71,6 +71,46 @@ def test_select_boundary_prefers_small_margins():
     assert set(margin[sel]) == {0.5, 1.0, 2.0}
 
 
+def test_select_boundary_adaptive_core_criterion():
+    """A point whose k-NN ball reaches the seam (margin <= core) must be
+    selected even when its margin RANK is outside the q-fraction — the
+    round-2 regression: fixed-fraction selection covered only 25% of the
+    actually-damaged cores and the hybrid tree merged clusters."""
+    n = 1000
+    margin = np.linspace(0.0, 10.0, n)  # ranks = positions
+    subset = np.zeros(n, np.int64)
+    core = np.zeros(n)
+    core[700] = margin[700] + 1.0  # damaged: ball radius > seam distance
+    sel = set(_select_boundary(margin, subset, q=0.01, core=core, min_per_block=1))
+    assert 700 in sel  # adaptive term catches it despite rank 700/1000
+    assert 699 not in sel  # neighbors with small cores stay unselected
+    # Without the core vector the old fixed-fraction behavior is unchanged.
+    sel_fixed = _select_boundary(margin, subset, q=0.01, min_per_block=1)
+    assert 700 not in sel_fixed
+    assert len(sel_fixed) == 10
+
+
+def test_select_boundary_caps_runaway_adaptive_set():
+    """When the adaptive criterion would select (almost) everything, the set
+    truncates at the max fraction — most-at-risk first, floor kept — and
+    warns instead of silently paying a ~full exact scan."""
+    import warnings
+
+    from hdbscan_tpu.models.mr_hdbscan import _BOUNDARY_MAX_FRAC
+
+    n = 1000
+    margin = np.linspace(0.0, 1.0, n)
+    subset = np.zeros(n, np.int64)
+    core = np.full(n, 10.0)  # every ball "reaches" the seam
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        sel = _select_boundary(margin, subset, q=0.01, core=core, min_per_block=1)
+    assert len(sel) == int(np.ceil(_BOUNDARY_MAX_FRAC * n))
+    assert any("boundary set capped" in str(x.message) for x in w)
+    # Truncation keeps the smallest-slack (here: smallest-margin) points.
+    assert margin[sel].max() < margin[np.setdiff1d(np.arange(n), sel)].min()
+
+
 def test_reweight_pool_is_exact_mrd(rng):
     data = rng.normal(size=(64, 3))
     core = rng.uniform(0.1, 2.0, size=64)
