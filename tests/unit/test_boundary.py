@@ -111,6 +111,25 @@ def test_select_boundary_caps_runaway_adaptive_set():
     assert margin[sel].max() < margin[np.setdiff1d(np.arange(n), sel)].min()
 
 
+def test_chunk_rows_divisibility_invariant():
+    """Every chunk (including the remainder) must divide by row_tile — the
+    invariant the scan kernels' reshapes rely on — at any padded size."""
+    from hdbscan_tpu.ops.tiled import _chunk_rows
+
+    for n_pad in (8192, 245760, 1 << 20, 4194304):
+        for row_tile in (128, 1024):
+            for shift in (19, 20):
+                # m_pad == n_pad (self-scans) AND m_pad != n_pad (the
+                # row-subset scan): non-pow2 m_pad multiples of row_tile
+                # exercise the partial final chunk.
+                for m_pad in (n_pad, 3 * row_tile, 165 * row_tile):
+                    chunk = _chunk_rows(n_pad, row_tile, m_pad, shift=shift)
+                    assert chunk >= row_tile
+                    assert chunk % row_tile == 0 or chunk == m_pad
+                    for a in range(0, m_pad, chunk):
+                        assert min(chunk, m_pad - a) % row_tile == 0
+
+
 def test_reweight_pool_is_exact_mrd(rng):
     data = rng.normal(size=(64, 3))
     core = rng.uniform(0.1, 2.0, size=64)
