@@ -13,12 +13,13 @@ Usage: python benchmarks/boundary_eval.py [n] [separation] [modes_csv]
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from hdbscan_tpu import HDBSCANParams
 from hdbscan_tpu.models import exact, mr_hdbscan

@@ -182,9 +182,23 @@ def fit_bubbles(
         live = np.flatnonzero(nb_h > 0)
         dist_h = np.asarray(jax.device_get(dist), np.float64)[:m, :m]
         core_p = np.full(m_pad, np.inf)
-        core_p[live] = compat.reference_bubble_core_distances(
-            dist_h[np.ix_(live, live)], nb_h[live], ext_h[live], min_pts, dims
-        )
+        try:
+            core_p[live] = compat.reference_bubble_core_distances(
+                dist_h[np.ix_(live, live)], nb_h[live], ext_h[live], min_pts, dims
+            )
+        except IndexError as e:
+            # The Java walk's AIOOBE surfaces here when the covering loop runs
+            # off the k-1 slot buffer — duplicate-heavy subsets can collapse
+            # live bubbles below min_pts - 1. Re-raise with the run context so
+            # an opt-in compat run fails actionably instead of with a bare
+            # IndexError (ADVICE r2).
+            raise ValueError(
+                "compat_cf_int_math: the reference's covering walk overran "
+                f"its neighbor buffer ({m} bubbles, {len(live)} live, "
+                f"min_pts={min_pts}) — the Java code throws "
+                "ArrayIndexOutOfBoundsException on this shape. Lower "
+                "min_pts, raise k/processing_units, or disable compat_cf"
+            ) from e
         dist, u_d, v_d, mask_d, packed_d = _bubble_device_block_given_core(
             dist,
             jnp.asarray(core_p, rep.dtype),

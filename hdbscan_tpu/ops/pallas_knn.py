@@ -78,12 +78,22 @@ def morton_order(data: np.ndarray, max_dims: int = 21) -> np.ndarray:
     lo, hi = x.min(axis=0), x.max(axis=0)
     span = np.where(hi > lo, hi - lo, 1.0)
     q = ((x - lo) / span * ((1 << b) - 1)).astype(np.uint64)
-    code = np.zeros(len(x), np.uint64)
-    for bit in range(b):
-        for dim in range(d):
-            code |= ((q[:, dim] >> np.uint64(bit)) & np.uint64(1)) << np.uint64(
-                bit * d + dim
-            )
+    # Vectorized bit interleave (ADVICE r2: the former Python (bits x dims)
+    # double loop made up to 63 full-array passes): broadcast all (dim, bit)
+    # extractions at once, chunked over rows so the (chunk, d, b) temp stays
+    # bounded at multi-M rows.
+    bits = np.arange(b, dtype=np.uint64)
+    out_shift = (bits[None, :] * np.uint64(d) + np.arange(d, dtype=np.uint64)[:, None])
+    code = np.empty(len(x), np.uint64)
+    # Chunk sized off the (d*b) fan-out so the transient (chunk, d, b) uint64
+    # temp stays ~128 MB regardless of dimensionality.
+    chunk = max(1, (128 << 20) // (d * b * 8))
+    for lo_i in range(0, len(x), chunk):
+        qc = q[lo_i : lo_i + chunk]  # (c, d)
+        spread = ((qc[:, :, None] >> bits[None, None, :]) & np.uint64(1)) << out_shift
+        code[lo_i : lo_i + chunk] = np.bitwise_or.reduce(
+            spread.reshape(len(qc), -1), axis=1
+        )
     return np.argsort(code, kind="stable")
 
 

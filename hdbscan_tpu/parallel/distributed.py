@@ -36,6 +36,7 @@ import jax
 import numpy as np
 
 __all__ = [
+    "already_initialized",
     "initialize_from_cluster_name",
     "host_row_slab",
     "global_rows_from_local",
@@ -52,6 +53,22 @@ def process_index() -> int:
     return jax.process_index()
 
 
+def already_initialized() -> bool:
+    """True when ``jax.distributed.initialize`` has already run in-process.
+
+    JAX exposes no public predicate; the stable observable is the client
+    handle on the global distributed state (None until initialize, reset by
+    shutdown). Falls back to False if the private module moves — the worst
+    case is the original double-init error, never a wrong no-op.
+    """
+    try:
+        from jax._src import distributed as _dist
+
+        return getattr(_dist.global_state, "client", None) is not None
+    except Exception:
+        return False
+
+
 def initialize_from_cluster_name(cluster_name: str) -> bool:
     """Wire this process into a multi-controller run per ``clusterName=``.
 
@@ -62,11 +79,14 @@ def initialize_from_cluster_name(cluster_name: str) -> bool:
     - ``"<coordinator_host:port>,<process_id>,<num_processes>"``: explicit
       wiring for CPU/GPU clusters or manual pod bring-up.
 
-    Returns True if distributed init ran. Idempotence: calling again after a
-    successful init raises in JAX; callers gate on the return value.
+    Returns True if distributed init ran (or had already run — the call is
+    idempotent: an already-initialized runtime is detected and left as-is
+    rather than tripping JAX's double-initialize error, ADVICE r2).
     """
     if cluster_name in ("", "local"):
         return False
+    if already_initialized():
+        return True
     if cluster_name == "auto":
         jax.distributed.initialize()
         return True
