@@ -50,26 +50,35 @@ def main(argv: list[str] | None = None) -> int:
 
     try:
         initialize_from_cluster_name(params.cluster_name)
-    except ValueError as e:
+    except (ValueError, RuntimeError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
-    if process_count() > 1:
-        # The CLI pipeline is single-controller today: letting every process
-        # run it would redundantly recompute everything and race on the
-        # output files. Multi-host execution goes through the library
-        # primitives (parallel/distributed.py, ROADMAP "Misc").
-        print(
-            "error: the CLI driver does not run multi-process yet; "
-            "clusterName wires the processes but the pipeline must be "
-            "driven via hdbscan_tpu.parallel.distributed (see ROADMAP.md)",
-            file=sys.stderr,
-        )
-        return 2
 
+    import jax
     import numpy as np
 
     from hdbscan_tpu.models import hdbscan, mr_hdbscan
     from hdbscan_tpu.utils.io import load_points
+
+    # Multi-controller SPMD driving (the reference's Spark master+executors,
+    # main/Main.java:89-95, re-mapped): every process runs the SAME
+    # deterministic driver loop — host decisions replicate (same seed, same
+    # data), device scans shard over the GLOBAL mesh so each process computes
+    # only its row/block shard, and sharded results allgather over DCN
+    # (parallel/mesh.fetch). Process 0 alone writes outputs and prints.
+    n_proc = process_count()
+    is_main = n_proc == 1 or jax.process_index() == 0
+    mesh = None
+    if len(jax.devices()) > 1:
+        from hdbscan_tpu.parallel.mesh import get_mesh
+
+        mesh = get_mesh()
+        if is_main and n_proc > 1:
+            print(
+                f"hdbscan-tpu: {n_proc} processes, "
+                f"{len(jax.devices())} devices (global mesh)",
+                file=sys.stderr,
+            )
 
     data = load_points(params.input_file)
     if data.ndim == 1:
@@ -77,29 +86,38 @@ def main(argv: list[str] | None = None) -> int:
     n = len(data)
     t0 = time.monotonic()
     if n <= params.processing_units:
+        # Single-block exact path: dense local compute (no mesh to shard).
         result = hdbscan.fit(data, params)
         mode = "exact"
     else:
-        result = mr_hdbscan.fit(data, params)
+        result = mr_hdbscan.fit(data, params, mesh=mesh)
         mode = f"mr ({result.n_levels} levels)"
     wall = time.monotonic() - t0
 
-    paths = hdbscan.write_outputs(result, params)
-    n_clusters = len(set(result.labels[result.labels > 0].tolist()))
-    n_noise = int(np.sum(result.labels == 0))
-    print(
-        f"hdbscan-tpu: {n} points, {mode}, {n_clusters} clusters, "
-        f"{n_noise} noise, {wall:.2f}s"
-    )
-    if result.infinite_stability:
-        # The reference's canonical warning (HDBSCANStar.java:40-47 intent).
+    if is_main:
+        paths = hdbscan.write_outputs(result, params)
+        n_clusters = len(set(result.labels[result.labels > 0].tolist()))
+        n_noise = int(np.sum(result.labels == 0))
         print(
-            "WARNING: some clusters have infinite stability (duplicate points "
-            "denser than minPts); results may be unreliable at those clusters.",
-            file=sys.stderr,
+            f"hdbscan-tpu: {n} points, {mode}, {n_clusters} clusters, "
+            f"{n_noise} noise, {wall:.2f}s"
         )
-    for kind, path in paths.items():
-        print(f"  {kind}: {path}")
+        if result.infinite_stability:
+            # The reference's canonical warning (HDBSCANStar.java:40-47 intent).
+            print(
+                "WARNING: some clusters have infinite stability (duplicate "
+                "points denser than minPts); results may be unreliable at "
+                "those clusters.",
+                file=sys.stderr,
+            )
+        for kind, path in paths.items():
+            print(f"  {kind}: {path}")
+    if n_proc > 1:
+        # Barrier before exit: a process tearing down the coordinator while
+        # peers still fetch would surface as opaque RPC errors.
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("hdbscan_tpu_cli_done")
     return 0
 
 

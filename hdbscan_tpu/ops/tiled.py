@@ -590,6 +590,8 @@ class BoruvkaScanner:
             bw = np.concatenate([p[0] for p in parts])
             bj = np.concatenate([p[1] for p in parts])
         else:
+            from hdbscan_tpu.parallel.mesh import fetch
+
             out = _min_outgoing_scan_sharded(
                 self.mesh,
                 self._rows,
@@ -601,7 +603,7 @@ class BoruvkaScanner:
                 self.row_tile,
                 self.col_tile,
             )
-            bw, bj = jax.device_get(out)
+            bw, bj = fetch(out)
         return (
             np.asarray(bw, np.float64)[: self.n],
             np.asarray(bj, np.int64)[: self.n],

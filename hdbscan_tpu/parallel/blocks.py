@@ -358,8 +358,11 @@ def run_packed_blocks(
 
     def drain_one(start, real, out):
         # One batched fetch of one packed leaf per launch (each fetched leaf
-        # pays a full host<->device round trip over the tunnel).
-        pk = jax.device_get(out)
+        # pays a full host<->device round trip over the tunnel). fetch()
+        # allgathers across controllers when the mesh spans processes.
+        from hdbscan_tpu.parallel.mesh import fetch
+
+        pk = fetch(out)
         if with_core:
             u, v, w, mask = unpack_block_mst_edges(pk, cap)
         else:

@@ -37,6 +37,7 @@ import numpy as np
 
 __all__ = [
     "already_initialized",
+    "hermetic_child_env",
     "initialize_from_cluster_name",
     "host_row_slab",
     "global_rows_from_local",
@@ -69,6 +70,25 @@ def already_initialized() -> bool:
         return False
 
 
+def _backend_already_touched() -> bool:
+    """True when some XLA backend initialized before distributed wiring.
+
+    ``jax.distributed.initialize`` only takes effect when it runs BEFORE the
+    first backend touch; afterwards it is a silent no-op and every process
+    believes it is the single controller (they then race on outputs). A
+    sitecustomize that imports jax AND asks for devices at interpreter start
+    is the observed trigger. Best-effort probe of the bridge's backend cache;
+    False on private-API drift (the explicit path still has the
+    ``process_count`` post-check as a backstop).
+    """
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False
+
+
 def initialize_from_cluster_name(cluster_name: str) -> bool:
     """Wire this process into a multi-controller run per ``clusterName=``.
 
@@ -81,29 +101,92 @@ def initialize_from_cluster_name(cluster_name: str) -> bool:
 
     Returns True if distributed init ran (or had already run — the call is
     idempotent: an already-initialized runtime is detected and left as-is
-    rather than tripping JAX's double-initialize error, ADVICE r2).
+    rather than tripping JAX's double-initialize error, ADVICE r2; a prior
+    init whose process count contradicts the requested wiring raises).
+    Raises RuntimeError when a JAX backend initialized before this call
+    (which would make ``initialize`` a silent no-op) or when the resulting
+    process count does not match the requested one.
     """
     if cluster_name in ("", "local"):
         return False
+    nproc = None
+    if cluster_name != "auto":
+        try:
+            coordinator, pid, nproc = cluster_name.rsplit(",", 2)
+            pid, nproc = int(pid), int(nproc)
+        except ValueError as e:
+            raise ValueError(
+                f"clusterName must be 'local', 'auto', or "
+                f"'<host:port>,<process_id>,<num_processes>'; got {cluster_name!r}"
+            ) from e
     if already_initialized():
+        if nproc is not None and jax.process_count() != nproc:
+            raise RuntimeError(
+                f"jax.distributed was already initialized with "
+                f"{jax.process_count()} processes, but clusterName="
+                f"{cluster_name!r} requests {nproc} — conflicting wiring"
+            )
         return True
+    if _backend_already_touched():
+        raise RuntimeError(
+            "a JAX backend was initialized before distributed wiring "
+            "(e.g. by a sitecustomize that imports jax and touches devices "
+            "at interpreter start); jax.distributed.initialize would be a "
+            "silent no-op. Initialize distributed before any jax device use."
+        )
     if cluster_name == "auto":
         jax.distributed.initialize()
         return True
-    try:
-        coordinator, pid, nproc = cluster_name.rsplit(",", 2)
-        pid, nproc = int(pid), int(nproc)
-    except ValueError as e:
-        raise ValueError(
-            f"clusterName must be 'local', 'auto', or "
-            f"'<host:port>,<process_id>,<num_processes>'; got {cluster_name!r}"
-        ) from e
-    # Outside the except: init's own errors (bad ranks, unreachable
-    # coordinator) must surface as themselves, not as a format complaint.
+    # Init's own errors (bad ranks, unreachable coordinator) surface as
+    # themselves, not as a format complaint.
     jax.distributed.initialize(
         coordinator_address=coordinator, process_id=pid, num_processes=nproc
     )
+    if jax.process_count() != nproc:
+        # Backstop for the silent-no-op case the probe above missed.
+        raise RuntimeError(
+            f"jax.distributed.initialize ran but process_count() == "
+            f"{jax.process_count()} != {nproc}: a JAX backend was "
+            "initialized before distributed wiring. Initialize distributed "
+            "before any jax device use."
+        )
     return True
+
+
+def hermetic_child_env(
+    n_local_devices: int, repo_root: str | None = None
+) -> dict:
+    """Environment for spawning a hermetic CPU-JAX child process.
+
+    Used by every harness that launches real OS processes for
+    multi-controller runs (2-process tests, ``dryrun_multichip``): forces the
+    CPU platform with ``n_local_devices`` virtual devices and strips
+    ``PYTHONPATH`` entries that carry a ``sitecustomize.py`` — those hooks
+    import jax and touch a backend at interpreter start, which would turn
+    the child's ``jax.distributed.initialize`` into a silent no-op (see
+    :func:`_backend_already_touched`). One copy of these rules; the callers
+    must not re-implement them.
+    """
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_ENABLE_X64"] = "1"
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n_local_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    keep = [
+        p
+        for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and not os.path.isfile(os.path.join(p, "sitecustomize.py"))
+    ]
+    paths = ([repo_root] if repo_root else []) + keep
+    env["PYTHONPATH"] = os.pathsep.join(paths)
+    return env
 
 
 def host_row_slab(n_rows: int, index: int | None = None, count: int | None = None):

@@ -44,3 +44,22 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def pad_batch(batch_size: int, num_devices: int) -> int:
     """Blocks are padded so the batch axis divides the mesh evenly."""
     return -(-batch_size // num_devices) * num_devices
+
+
+def fetch(tree):
+    """Device->host fetch that works across process boundaries.
+
+    Single-controller arrays (fully addressable) take the plain
+    ``device_get`` path. Arrays sharded over a multi-process mesh are not
+    fully addressable — each controller holds only its shards — so they
+    gather over DCN first (``process_allgather(tiled=True)``: shard axes
+    concatenate back to the global shape, the multi-host analog of the
+    shuffle-read half of a Spark stage boundary, SURVEY.md §2.C). Every
+    process returns the same full numpy tree.
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    if all(getattr(x, "is_fully_addressable", True) for x in leaves):
+        return jax.device_get(tree)
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(tree, tiled=True)
