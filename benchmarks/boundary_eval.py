@@ -63,14 +63,19 @@ def main() -> None:
 
     cache = f"/tmp/beval_exact_{n}_{sep}_{mcs}.npy"
     exact_labels = np.load(cache) if os.path.exists(cache) else None
+    from hdbscan_tpu.utils.tracing import Tracer
+
     for mode in modes:
+        tracer = Tracer(stream=sys.stderr)  # per-stage walls for the record
         t0 = time.time()
         if mode == "exact":
-            r = exact.fit(data, HDBSCANParams(**base))
+            r = exact.fit(data, HDBSCANParams(**base), trace=tracer)
             exact_labels = r.labels
             np.save(cache, exact_labels)
         else:
-            r = mr_hdbscan.fit(data, HDBSCANParams(**base, **configs[mode]))
+            r = mr_hdbscan.fit(
+                data, HDBSCANParams(**base, **configs[mode]), trace=tracer
+            )
         wall = time.time() - t0
         rec = {
             "config": mode,

@@ -7,7 +7,7 @@ Usage mirrors the reference's documented contract (``main/Main.java:534-614``)::
         [dist_function={euclidean,cosine,pearson,manhattan,supremum}] \
         [out_dir=DIR] [seed=N] [variant={db,rs}] [dedup={true,false}] \
         [exact_inter_edges={true,false}] [global_cores={true,false}] [refine=N] \
-        [boundary=F] [compat_cf={true,false}] \
+        [boundary=F] [block_pruning={true,false}] [compat_cf={true,false}] \
         [clusterName={local,auto,<host:port>,<pid>,<np>}]
 
 Unlike the reference, argv is actually honored (the reference shadows it with
@@ -80,44 +80,48 @@ def main(argv: list[str] | None = None) -> int:
                 file=sys.stderr,
             )
 
-    data = load_points(params.input_file)
-    if data.ndim == 1:
-        data = data[:, None]
-    n = len(data)
-    t0 = time.monotonic()
-    if n <= params.processing_units:
-        # Single-block exact path: dense local compute (no mesh to shard).
-        result = hdbscan.fit(data, params)
-        mode = "exact"
-    else:
-        result = mr_hdbscan.fit(data, params, mesh=mesh)
-        mode = f"mr ({result.n_levels} levels)"
-    wall = time.monotonic() - t0
+    try:
+        data = load_points(params.input_file)
+        if data.ndim == 1:
+            data = data[:, None]
+        n = len(data)
+        t0 = time.monotonic()
+        if n <= params.processing_units:
+            # Single-block exact path: dense local compute (no mesh to shard).
+            result = hdbscan.fit(data, params)
+            mode = "exact"
+        else:
+            result = mr_hdbscan.fit(data, params, mesh=mesh)
+            mode = f"mr ({result.n_levels} levels)"
+        wall = time.monotonic() - t0
 
-    if is_main:
-        paths = hdbscan.write_outputs(result, params)
-        n_clusters = len(set(result.labels[result.labels > 0].tolist()))
-        n_noise = int(np.sum(result.labels == 0))
-        print(
-            f"hdbscan-tpu: {n} points, {mode}, {n_clusters} clusters, "
-            f"{n_noise} noise, {wall:.2f}s"
-        )
-        if result.infinite_stability:
-            # The reference's canonical warning (HDBSCANStar.java:40-47 intent).
+        if is_main:
+            paths = hdbscan.write_outputs(result, params)
+            n_clusters = len(set(result.labels[result.labels > 0].tolist()))
+            n_noise = int(np.sum(result.labels == 0))
             print(
-                "WARNING: some clusters have infinite stability (duplicate "
-                "points denser than minPts); results may be unreliable at "
-                "those clusters.",
-                file=sys.stderr,
+                f"hdbscan-tpu: {n} points, {mode}, {n_clusters} clusters, "
+                f"{n_noise} noise, {wall:.2f}s"
             )
-        for kind, path in paths.items():
-            print(f"  {kind}: {path}")
-    if n_proc > 1:
-        # Barrier before exit: a process tearing down the coordinator while
-        # peers still fetch would surface as opaque RPC errors.
-        from jax.experimental import multihost_utils
+            if result.infinite_stability:
+                # Reference's canonical warning (HDBSCANStar.java:40-47 intent).
+                print(
+                    "WARNING: some clusters have infinite stability (duplicate "
+                    "points denser than minPts); results may be unreliable at "
+                    "those clusters.",
+                    file=sys.stderr,
+                )
+            for kind, path in paths.items():
+                print(f"  {kind}: {path}")
+    finally:
+        if n_proc > 1:
+            # Barrier before exit — in a finally so a rank that fails (e.g.
+            # unwritable out_dir on process 0) still joins before teardown;
+            # peers stuck at the barrier would otherwise die on opaque
+            # coordinator RPC errors that mask the real cause.
+            from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices("hdbscan_tpu_cli_done")
+            multihost_utils.sync_global_devices("hdbscan_tpu_cli_done")
     return 0
 
 

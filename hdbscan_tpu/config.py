@@ -76,6 +76,17 @@ class HDBSCANParams:
     #: per-level full-set glue scans, replacing every O(n²·d) quality pass —
     #: the scale mode for the paper's 8-11.6M-row datasets (BASELINE.md).
     boundary_quality: float = 0.0
+    #: Block-adjacency-aware candidate columns for the boundary phase
+    #: (``ops/blockscan.py``): each boundary point's exact-core rescan and
+    #: the inter-block glue/refinement rounds scan only the blocks its k-NN
+    #: ball (bounded by the per-block core distance) or the per-component
+    #: edge bounds can reach — O(m · seam-degree · cap) instead of O(m·n)
+    #: and O(m²) — with exactness preserved by conservative f64
+    #: centroid/radius bounds (same results as the full sweeps; pinned by
+    #: tests/unit/test_blockscan.py). Auto-falls back to the full sweeps on
+    #: non-triangle-inequality metrics (cosine/pearson). Set False to force
+    #: the full sweeps everywhere.
+    boundary_block_pruning: bool = True
     #: Collapse duplicate rows into weighted unique points before the exact
     #: pipeline (``core/dedup.py``). Semantics-preserving (a duplicate group
     #: is a zero-extent bubble; the member-weighted tree equals the full-row
@@ -170,6 +181,7 @@ class HDBSCANParams:
             "global_cores": ("global_core_distances", lambda s: s.lower() == "true"),
             "refine": ("refine_iterations", int),
             "boundary": ("boundary_quality", float),
+            "block_pruning": ("boundary_block_pruning", lambda s: s.lower() == "true"),
             "max_samples": ("max_samples", int),
             "compat_cf": ("compat_cf_int_math", lambda s: s.lower() == "true"),
         }

@@ -27,6 +27,7 @@ def finalize_clustering(
     num_constraints_satisfied: np.ndarray | None = None,
     point_weights: np.ndarray | None = None,
     constraint_index_map: np.ndarray | None = None,
+    trace=None,
 ) -> tuple[tree_mod.CondensedTree, np.ndarray, np.ndarray, bool]:
     """Edge pool + core distances -> (tree, labels, outlier_scores, infinite).
 
@@ -35,14 +36,34 @@ def finalize_clustering(
     ``point_weights``: member count per vertex (deduplicated pipelines).
     ``constraint_index_map``: row id -> vertex id translation for constraint
     files when vertices are deduplicated points.
+    ``trace``: optional per-stage event callable — isolates the host tree
+    layers (merge forest / condense / propagate+labels/GLOSH) so the
+    multi-M-row runs can tell scan wall from tree wall.
     """
+    import time as _time
+
+    t0 = _time.monotonic()
     forest = tree_mod.build_merge_forest(n, u, v, w, point_weights=point_weights)
+    if trace is not None:
+        trace(
+            "tree_merge_forest",
+            n=n,
+            edges=len(u),
+            wall_s=round(_time.monotonic() - t0, 3),
+        )
+    t0 = _time.monotonic()
     tree = tree_mod.condense_forest(
         forest,
         params.min_cluster_size,
         point_weights=point_weights,
         self_levels=core if params.self_edges else None,
     )
+    if trace is not None:
+        trace(
+            "tree_condense",
+            clusters=len(tree.parent) - 1,
+            wall_s=round(_time.monotonic() - t0, 3),
+        )
     virtual_child_constraints = None
     if params.constraints_file and num_constraints_satisfied is None:
         from hdbscan_tpu.core.constraints import (
@@ -64,9 +85,12 @@ def finalize_clustering(
         num_constraints_satisfied, virtual_child_constraints = (
             count_constraints_satisfied(tree, cons)
         )
+    t0 = _time.monotonic()
     infinite = tree_mod.propagate_tree(
         tree, num_constraints_satisfied, virtual_child_constraints
     )
     labels = tree_mod.flat_labels(tree)
     scores = tree_mod.outlier_scores(tree, core)
+    if trace is not None:
+        trace("tree_extract", wall_s=round(_time.monotonic() - t0, 3))
     return tree, labels, scores, infinite

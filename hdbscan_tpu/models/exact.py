@@ -302,7 +302,7 @@ def fit(
     from hdbscan_tpu.models._finalize import finalize_clustering
 
     tree, labels, scores, infinite = finalize_clustering(
-        n, u, v, w, core, params, num_constraints_satisfied
+        n, u, v, w, core, params, num_constraints_satisfied, trace=trace
     )
     return HDBSCANResult(
         labels=labels,

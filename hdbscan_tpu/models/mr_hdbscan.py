@@ -120,6 +120,7 @@ def _select_boundary(
     q: float,
     core: np.ndarray | None = None,
     min_per_block: int = 32,
+    max_frac: float = _BOUNDARY_MAX_FRAC,
 ) -> np.ndarray:
     """Boundary-point ids: the adaptive at-risk set plus a per-block floor.
 
@@ -144,7 +145,7 @@ def _select_boundary(
     sel = rank < take[inv]
     if core is not None:
         adaptive = margin <= _BOUNDARY_ALPHA * core
-        max_n = int(np.ceil(_BOUNDARY_MAX_FRAC * n))
+        max_n = int(np.ceil(max_frac * n))
         if int((sel | adaptive).sum()) > max_n:
             import warnings
 
@@ -156,7 +157,7 @@ def _select_boundary(
             sel = sel.copy()
             sel[keep] = True
             warnings.warn(
-                f"boundary set capped at {_BOUNDARY_MAX_FRAC:.0%} of points "
+                f"boundary set capped at {max_frac:.0%} of points "
                 f"({int(adaptive.sum())} at-risk by the margin<=core "
                 "criterion); quality may degrade toward the fixed-fraction "
                 "mode — at this seam density the exact or fullq path is "
@@ -753,30 +754,117 @@ def _fit_rows(
     w = np.concatenate(pool_w) if pool_w else np.zeros(0, np.float64)
 
     bset = None
+    bset_knn = None  # (knn_d, knn_j_local) boundary k-NN graph, pruned path
+    bset_pos = None  # global id -> boundary-local index (or -1)
+    geom_bset = None  # BlockGeometry over the boundary subset (glue + refine)
     if boundary and n > cap:
+        from hdbscan_tpu.ops.blockscan import PRUNABLE_METRICS
         from hdbscan_tpu.ops.tiled import boruvka_glue_edges, knn_core_distances_rows
+
+        pruned = params.boundary_block_pruning and metric in PRUNABLE_METRICS
 
         # 1) The boundary set: per final block, the lowest-margin fraction
         #    (final_block, NOT subset: subset ids are per-level and collide
         #    across freeze levels).
         t0 = time.monotonic()
-        bset = _select_boundary(bmargin, final_block, boundary_q, core=core)
+        # With block pruning the boundary rescan costs O(candidate windows),
+        # not O(m·n) — a large at-risk set is affordable, so the truncation
+        # cap (which existed to keep the full-sweep scan from approaching
+        # n², and whose truncation is the suspected 4M sep-7 quality
+        # collapse) relaxes substantially. Worst case (cluster overlap so
+        # heavy that k-NN balls rival block radii) degrades toward the
+        # full-sweep cost AND quality — i.e. toward fullq, which is the
+        # right behavior at that difficulty; the cap warning still fires.
+        bset = _select_boundary(
+            bmargin,
+            final_block,
+            boundary_q,
+            core=core,
+            max_frac=0.9 if pruned else _BOUNDARY_MAX_FRAC,
+        )
+        if trace is not None:
+            trace(
+                "boundary_select",
+                m=len(bset),
+                frac=round(len(bset) / n, 4),
+                pruned=pruned,
+                wall_s=round(time.monotonic() - t0, 3),
+            )
         # 2) Exact global core distances for boundary points only (their
         #    per-block cores inflate at the seam); np.minimum guards against
-        #    float32 scan jitter ever raising a core.
-        core_b = knn_core_distances_rows(data, bset, params.min_points, metric)
+        #    float32 scan jitter ever raising a core. With block pruning each
+        #    boundary point scans only the blocks its k-NN ball (bounded by
+        #    its per-block core) can reach — O(m·seam-degree·cap), not
+        #    O(m·n) — and the scan's neighbor lists double as the k-NN graph
+        #    seeding the glue's edge bounds.
+        t0 = time.monotonic()
+        if pruned:
+            from hdbscan_tpu.ops.blockscan import (
+                BlockGeometry,
+                knn_rows_blockpruned,
+            )
+
+            geom_blocks = BlockGeometry.build(data, final_block, metric)
+            core_b, knn_d_b, knn_j_b = knn_rows_blockpruned(
+                geom_blocks,
+                bset,
+                core[bset],
+                params.min_points,
+                return_neighbors=True,
+            )
+            # The full-dataset device copy is only needed for this rescan —
+            # release it before the glue/tree stages pin more HBM.
+            del geom_blocks
+            # Map neighbor ids into boundary-local space for the glue (a
+            # neighbor outside the boundary set is not a glue vertex).
+            bset_pos = np.full(n, -1, np.int64)
+            bset_pos[bset] = np.arange(len(bset))
+            knn_j_local = np.where(knn_j_b >= 0, bset_pos[np.maximum(knn_j_b, 0)], -1)
+            bset_knn = (knn_d_b, knn_j_local)
+        else:
+            core_b = knn_core_distances_rows(data, bset, params.min_points, metric)
         core[bset] = np.minimum(core[bset], core_b)
+        if trace is not None:
+            trace("boundary_cores", wall_s=round(time.monotonic() - t0, 3))
         # 3) Re-weight the whole pool to mutual reachability under the hybrid
         #    core vector (exact at the seams, per-block in the interior):
         #    recompute the true point distance per edge, then clamp by cores.
+        t0 = time.monotonic()
         w = _reweight_pool(u, v, w, data, core, metric)
+        if trace is not None:
+            trace("boundary_reweight", edges=len(w), wall_s=round(time.monotonic() - t0, 3))
         # 4) Inter-block Borůvka glue restricted to the boundary set — the
         #    true min MRD edges between blocks have seam endpoints, so the
-        #    harvest over B finds them at O(|B|²·d) per round.
+        #    harvest over B finds them; block pruning restricts each round's
+        #    columns to the blocks the per-component edge bounds can reach.
+        t0 = time.monotonic()
         if len(np.unique(final_block[bset])) >= 2:
-            gu, gv, gw = boruvka_glue_edges(
-                data[bset], final_block[bset], metric, core=core[bset], mesh=mesh
-            )
+            if pruned:
+                from hdbscan_tpu.ops.blockscan import (
+                    BlockGeometry,
+                    boruvka_glue_edges_blockpruned,
+                )
+
+                # One geometry serves the glue AND every refinement round.
+                geom_bset = BlockGeometry.build(
+                    data[bset], final_block[bset], metric
+                )
+                gu, gv, gw = boruvka_glue_edges_blockpruned(
+                    data[bset],
+                    final_block[bset],
+                    core[bset],
+                    metric,
+                    knn_d=bset_knn[0],
+                    knn_j=bset_knn[1],
+                    geom=geom_bset,
+                    mesh=mesh,
+                    trace=trace,
+                )
+            else:
+                gu, gv, gw = boruvka_glue_edges(
+                    data[bset], final_block[bset], metric, core=core[bset],
+                    mesh=mesh,
+                )
             u = np.concatenate([u, bset[gu]])
             v = np.concatenate([v, bset[gv]])
             w = np.concatenate([w, gw])
@@ -819,6 +907,7 @@ def _fit_rows(
             n2, u2, v2, w2, core2, params,
             point_weights=weights2,
             constraint_index_map=constraint_index_map,
+            trace=trace,
         )
         # Pseudo-leaves alias their base vertex: slice back to vertex space.
         return tree, labels[:n], scores[:n], infinite
@@ -841,9 +930,32 @@ def _fit_rows(
                 # boundaries are partition seams, so the repair edges live in B.
                 if len(np.unique(groups_r[bset])) < 2:
                     break
-                ru, rv, rw = boruvka_glue_edges(
-                    data[bset], groups_r[bset], metric, core=core[bset], mesh=mesh
-                )
+                if bset_knn is not None:
+                    # Pruned refinement: components = leaf clusters, geometry
+                    # = partition blocks (tight radii; leaf-cluster spreads
+                    # are useless bounding volumes) — ops/blockscan.py
+                    # decoupled-init mode, exact per test_blockscan.
+                    from hdbscan_tpu.ops.blockscan import (
+                        boruvka_glue_edges_blockpruned,
+                    )
+
+                    ru, rv, rw = boruvka_glue_edges_blockpruned(
+                        data[bset],
+                        final_block[bset],
+                        core[bset],
+                        metric,
+                        knn_d=bset_knn[0],
+                        knn_j=bset_knn[1],
+                        init_comp=groups_r[bset],
+                        geom=geom_bset,
+                        mesh=mesh,
+                        trace=trace,
+                    )
+                else:
+                    ru, rv, rw = boruvka_glue_edges(
+                        data[bset], groups_r[bset], metric, core=core[bset],
+                        mesh=mesh,
+                    )
                 ru, rv = bset[ru], bset[rv]
             else:
                 if len(np.unique(groups_r)) < 2:

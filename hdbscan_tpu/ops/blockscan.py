@@ -1,0 +1,678 @@
+"""Block-adjacency-aware windowed scans — sub-quadratic boundary phase.
+
+The boundary-quality mode (``config.boundary_quality``) pays two quadratic
+terms at scale (ROADMAP "Scaling"): the exact-core rescan of the m boundary
+points against ALL n columns (O(m·n·d), ``ops/tiled.knn_core_distances_rows``)
+and the inter-block Borůvka glue over the boundary set (O(m²·d) per round).
+Past ~4M rows those terms dominate the whole pipeline — the reference's
+broadcast-everything scan shape (``mappers/CoreDistanceMapper.java:57-112``)
+re-emerging at a different layer.
+
+This module removes both via one geometric fact: a point's k-NN ball has a
+known radius bound (its per-block core distance — block-restricted k-NN can
+only overestimate), so any block ``B`` whose nearest possible member is
+farther than that bound (``d(i, c_B) - r_B > ub_i`` by the triangle
+inequality) cannot contribute to the point's exact core distance. Each
+boundary point therefore scans only the handful of blocks its ball
+intersects — its own and the seam neighbors — instead of the whole dataset.
+
+TPU shape discipline (the round-1 tile-pruning lessons, ROADMAP "Remaining
+options" #2): no per-row control flow on device. The host computes candidate
+(row, block) pairs from f64 bounds, coalesces them into fixed-width column
+WINDOWS on a block-sorted device copy (every job = pow2 rows x W·col_tile
+columns — a handful of compiled shapes), and merges per-row results. Columns
+inside a window that belong to other blocks are scanned anyway: scanning a
+SUPERSET of the candidate set is free correctness (extra true distances can
+never displace the k nearest), and it is what keeps the schedule static.
+
+Exactness contract (tested in ``tests/unit/test_blockscan.py``): results
+match the full-sweep scans bit-for-bit up to f32 scan jitter — the bounds are
+computed in f64 with a relative slack, so exclusion is conservative.
+
+Triangle-inequality metrics only (euclidean / manhattan / supremum); callers
+fall back to the full sweeps for cosine / pearson.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hdbscan_tpu.core.distances import pairwise_distance
+
+#: Metrics whose triangle inequality makes the centroid/radius bound valid.
+PRUNABLE_METRICS = ("euclidean", "manhattan", "supremum")
+
+#: Relative slack applied to every exclusion bound: the device scans run in
+#: f32 (with f32-accumulated distance kernels), the bounds in f64 — a
+#: candidate kept "too generously" costs a few extra columns, one excluded
+#: wrongly costs exactness.
+_BOUND_RTOL = 1e-4
+_BOUND_ATOL = 1e-9
+
+
+def _chunked_centroid_distances(
+    rows: np.ndarray, centroids: np.ndarray, metric: str, chunk: int = 1 << 16
+) -> np.ndarray:
+    """(m, G) f64 row->block-centroid distances on host.
+
+    Euclidean rides BLAS (one gemm per chunk); manhattan/supremum fall back
+    to broadcast abs-diff chunks (G is at most a few thousand blocks).
+    """
+    m, _ = rows.shape
+    g = len(centroids)
+    out = np.empty((m, g), np.float64)
+    if metric == "euclidean":
+        c2 = np.einsum("gd,gd->g", centroids, centroids)
+        for lo in range(0, m, chunk):
+            r = rows[lo : lo + chunk]
+            d2 = np.einsum("md,md->m", r, r)[:, None] + c2[None, :] - 2.0 * (r @ centroids.T)
+            np.sqrt(np.maximum(d2, 0.0), out=out[lo : lo + chunk])
+        return out
+    red = np.sum if metric == "manhattan" else np.max
+    for lo in range(0, m, max(1, chunk // 8)):
+        r = rows[lo : lo + max(1, chunk // 8)]
+        out[lo : lo + len(r)] = red(
+            np.abs(r[:, None, :] - centroids[None, :, :]), axis=2
+        )
+    return out
+
+
+@dataclass
+class BlockGeometry:
+    """Block-sorted device copy of a dataset plus per-block f64 geometry.
+
+    ``perm`` sorts rows by block; ``starts/ends`` are each block's span in
+    sorted space; ``centroid/radius`` bound every member's position
+    (``d(x, centroid) <= radius`` for all members, in ``metric``);
+    ``win_start`` is each block's fixed column-window origin and
+    ``win_tiles`` the shared static window width (tiles) covering any block.
+    """
+
+    metric: str
+    col_tile: int
+    n: int
+    n_pad: int
+    perm: np.ndarray  # (n,) sorted-order -> original row id
+    inv_perm: np.ndarray  # (n,) original row id -> sorted position
+    block_ids: np.ndarray  # (G,) dense block id per group
+    starts: np.ndarray  # (G,) sorted-space start
+    ends: np.ndarray  # (G,) sorted-space end
+    centroid: np.ndarray  # (G, d) f64
+    radius: np.ndarray  # (G,) f64
+    win_start: np.ndarray  # (G,) col_tile-aligned window origin per block
+    win_tiles: int  # static tiles per window
+    data_sorted: jax.Array  # (n_pad, d) device, scan dtype
+    valid_sorted: jax.Array  # (n_pad,) device bool
+    data_host: np.ndarray  # (n, d) f64 original rows (unsorted)
+
+    @staticmethod
+    def build(
+        data: np.ndarray,
+        block_of: np.ndarray,
+        metric: str = "euclidean",
+        col_tile: int = 8192,
+        dtype=np.float32,
+    ) -> "BlockGeometry":
+        if metric not in PRUNABLE_METRICS:
+            raise ValueError(
+                f"block pruning needs a triangle-inequality metric, got {metric!r}"
+            )
+        data = np.ascontiguousarray(np.asarray(data, np.float64))
+        n = len(data)
+        block_of = np.asarray(block_of)
+        perm = np.argsort(block_of, kind="stable")
+        inv_perm = np.empty(n, np.int64)
+        inv_perm[perm] = np.arange(n)
+        sorted_blocks = block_of[perm]
+        uniq, first = np.unique(sorted_blocks, return_index=True)
+        starts = first
+        ends = np.concatenate([first[1:], [n]])
+        # f64 geometry: centroid = mean (any interior point works — the bound
+        # only needs d(x, c) <= r for all members), radius = max member
+        # distance to it under ``metric``.
+        g = len(uniq)
+        d = data.shape[1]
+        centroid = np.empty((g, d), np.float64)
+        radius = np.empty(g, np.float64)
+        from hdbscan_tpu.core.distances import rowwise_distance_np
+
+        data_s = data[perm]
+        for i in range(g):
+            seg = data_s[starts[i] : ends[i]]
+            c = seg.mean(axis=0)
+            centroid[i] = c
+            radius[i] = rowwise_distance_np(
+                seg, np.broadcast_to(c, seg.shape), metric
+            ).max()
+
+        col_tile = 1 << max(7, (min(col_tile, max(n, 128)) - 1).bit_length())
+        n_pad = -(-n // col_tile) * col_tile
+        n_tiles = n_pad // col_tile
+        span_tiles = (
+            (ends - 1) // col_tile - starts // col_tile + 1
+        )  # tiles each block touches
+        win_tiles = min(n_tiles, 1 << int(span_tiles.max() - 1).bit_length())
+        win_start = np.minimum(starts // col_tile, n_tiles - win_tiles) * col_tile
+        win_start = np.maximum(win_start, 0)
+
+        pad = np.zeros((n_pad - n, d), np.float64)
+        data_dev = jax.device_put(
+            np.concatenate([data_s, pad]).astype(dtype)
+        )
+        valid_dev = jax.device_put(np.arange(n_pad) < n)
+        return BlockGeometry(
+            metric=metric,
+            col_tile=col_tile,
+            n=n,
+            n_pad=n_pad,
+            perm=perm,
+            inv_perm=inv_perm,
+            block_ids=uniq,
+            starts=starts,
+            ends=ends,
+            centroid=centroid,
+            radius=radius,
+            win_start=win_start,
+            win_tiles=win_tiles,
+            data_sorted=data_dev,
+            valid_sorted=valid_dev,
+            data_host=data,
+        )
+
+    def candidate_pairs(
+        self, rows: np.ndarray, ub: np.ndarray, chunk: int = 1 << 16
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """(row_idx, block_idx) pairs whose block can intersect the row's ball.
+
+        ``rows``: (m, d) query coordinates; ``ub``: (m,) f64 ball-radius upper
+        bounds. Exclusion: ``d(row, c_B) - r_B > ub`` implies every member of
+        B is outside the ball (triangle inequality), with f64 slack. Chunked
+        over rows so the (chunk, G) bound matrix — never the full (m, G) —
+        is the only dense temporary.
+        """
+        prs, pbs = [], []
+        for lo in range(0, len(rows), chunk):
+            r = rows[lo : lo + chunk]
+            dc = _chunked_centroid_distances(r, self.centroid, self.metric)
+            keep = (
+                dc - self.radius[None, :]
+                <= ub[lo : lo + chunk, None] * (1 + _BOUND_RTOL) + _BOUND_ATOL
+            )
+            pr, pb = np.nonzero(keep)
+            prs.append(pr + lo)
+            pbs.append(pb)
+        return np.concatenate(prs), np.concatenate(pbs)
+
+
+def _window_jobs(
+    geom: BlockGeometry, pair_rows: np.ndarray, pair_blocks: np.ndarray
+) -> list[tuple[int, np.ndarray]]:
+    """Coalesce candidate pairs into per-window row lists.
+
+    Every block maps to ONE fixed-width window (``geom.win_start``); rows are
+    deduplicated per window. Returns [(col_start, row_idx_array), ...] sorted
+    by window for deterministic dispatch order.
+    """
+    ws = geom.win_start[pair_blocks]
+    order = np.lexsort((pair_rows, ws))
+    ws, rs = ws[order], pair_rows[order]
+    jobs = []
+    cuts = np.nonzero(np.diff(ws))[0] + 1
+    for seg_r, seg_w in zip(
+        np.split(rs, cuts), np.split(ws, cuts)
+    ):
+        jobs.append((int(seg_w[0]), np.unique(seg_r)))
+    return jobs
+
+
+@partial(
+    jax.jit,
+    static_argnames=("k", "metric", "row_tile", "col_tile", "n_win_tiles"),
+)
+def _knn_window_scan(
+    rows, data, valid, col_start, k: int, metric: str, row_tile: int,
+    col_tile: int, n_win_tiles: int,
+):
+    """k smallest distances (+ sorted-space ids) of ``rows`` against the
+    window ``[col_start, col_start + n_win_tiles * col_tile)`` of ``data``.
+
+    Same tile discipline as ``ops.tiled._knn_core_scan`` — fori over column
+    tiles, top_k merge — but over a fixed-width window at a dynamic origin:
+    the static shape axis is (row_tile, col_tile, n_win_tiles), so every job
+    of one row-count class shares a compile regardless of which blocks it
+    scans. Pad rows produce garbage; callers slice.
+    """
+    n_rows = rows.shape[0]
+    inf = jnp.array(jnp.inf, data.dtype)
+
+    def row_step(r):
+        xr = jax.lax.dynamic_slice_in_dim(rows, r * row_tile, row_tile)
+
+        def col_step(c, carry):
+            best, bidx = carry
+            base = col_start + c * col_tile
+            xc = jax.lax.dynamic_slice_in_dim(data, base, col_tile)
+            vc = jax.lax.dynamic_slice_in_dim(valid, base, col_tile)
+            dmat = pairwise_distance(xr, xc, metric)
+            dmat = jnp.where(vc[None, :], dmat, inf)
+            cols = base + jax.lax.broadcasted_iota(
+                jnp.int32, (row_tile, col_tile), 1
+            )
+            merged = jnp.concatenate([best, -dmat], axis=1)
+            merged_i = jnp.concatenate([bidx, cols], axis=1)
+            new_best, sel = jax.lax.top_k(merged, k)
+            return new_best, jnp.take_along_axis(merged_i, sel, axis=1)
+
+        init = (
+            jnp.full((row_tile, k), -jnp.inf, data.dtype),
+            jnp.full((row_tile, k), -1, jnp.int32),
+        )
+        best, bidx = jax.lax.fori_loop(0, n_win_tiles, col_step, init)
+        return -best, bidx
+
+    out, out_i = jax.lax.map(row_step, jnp.arange(n_rows // row_tile))
+    return out.reshape(n_rows, k), out_i.reshape(n_rows, k)
+
+
+def _merge_knn(
+    best_d: np.ndarray, best_i: np.ndarray, new_d: np.ndarray, new_i: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Rowwise k-way merge of two (r, k) ascending neighbor lists.
+
+    Deduplicates by column id first: two jobs whose fixed-width windows
+    overlap legitimately scan the overlap columns twice, and a duplicated
+    neighbor would displace a real one from the k-list (measured: it drove
+    core distances BELOW the full-sweep truth).
+    """
+    cat_d = np.concatenate([best_d, new_d], axis=1)
+    cat_i = np.concatenate([best_i, new_i], axis=1)
+    k = best_d.shape[1]
+    order = np.argsort(cat_i, axis=1, kind="stable")
+    ci = np.take_along_axis(cat_i, order, axis=1)
+    cd = np.take_along_axis(cat_d, order, axis=1)
+    dup = (ci[:, 1:] == ci[:, :-1]) & (ci[:, 1:] >= 0)
+    cd[:, 1:][dup] = np.inf
+    sel = np.argsort(cd, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(cd, sel, axis=1), np.take_along_axis(
+        ci, sel, axis=1
+    )
+
+
+def knn_rows_blockpruned(
+    geom: BlockGeometry,
+    row_ids: np.ndarray,
+    ub: np.ndarray,
+    min_pts: int,
+    return_neighbors: bool = False,
+    row_tile: int = 256,
+    dtype=np.float32,
+):
+    """Exact core distances of selected rows via block-candidate windows.
+
+    Drop-in for ``ops.tiled.knn_core_distances_rows`` on triangle-inequality
+    metrics: ``ub`` (each row's per-block core distance) bounds its k-NN ball
+    radius, blocks outside the ball are excluded by f64 geometry, and the
+    surviving windows are scanned exactly. Work is O(sum of candidate-window
+    sizes) ≈ O(m · seam-degree · cap) instead of O(m · n).
+
+    Returns ``core`` (m,) — and with ``return_neighbors`` the (m, k) global
+    neighbor ids + distances backing it (the boundary k-NN graph the pruned
+    glue seeds its upper bounds with).
+    """
+    m = len(row_ids)
+    k = max(min_pts - 1, 1)
+    if m == 0:
+        empty = np.zeros(0, np.float64)
+        if return_neighbors:
+            return empty, np.zeros((0, k)), np.zeros((0, k), np.int64)
+        return empty
+    rows = geom.data_host[row_ids]
+    pair_rows, pair_blocks = geom.candidate_pairs(rows, np.asarray(ub, np.float64))
+    jobs = _window_jobs(geom, pair_rows, pair_blocks)
+
+    best_d = np.full((m, k), np.inf, np.float64)
+    best_i = np.full((m, k), -1, np.int64)
+    rows_f = rows.astype(dtype)
+
+    from hdbscan_tpu.ops.tiled import _drain_window
+
+    def dispatches():
+        for col_start, ridx in jobs:
+            r_pad = max(row_tile, 1 << int(len(ridx) - 1).bit_length())
+            xr = np.zeros((r_pad, rows_f.shape[1]), dtype)
+            xr[: len(ridx)] = rows_f[ridx]
+            out = _knn_window_scan(
+                jnp.asarray(xr),
+                geom.data_sorted,
+                geom.valid_sorted,
+                jnp.int32(col_start),
+                k,
+                geom.metric,
+                row_tile,
+                geom.col_tile,
+                geom.win_tiles,
+            )
+            yield ridx, out
+
+    fetched = _drain_window((d for d in dispatches()))
+    for ridx, (jd, ji) in fetched:
+        jd = np.asarray(jd, np.float64)[: len(ridx)]
+        ji = np.asarray(ji, np.int64)[: len(ridx)]
+        best_d[ridx], best_i[ridx] = _merge_knn(
+            best_d[ridx], best_i[ridx], jd, ji
+        )
+
+    core = best_d[:, min(k, geom.n) - 1].copy() if min_pts > 1 else np.zeros(m)
+    if return_neighbors:
+        ids = np.where(best_i >= 0, geom.perm[np.maximum(best_i, 0)], -1)
+        return core, best_d, ids
+    return core
+
+
+# --------------------------------------------------------------------------
+# Windowed exact Borůvka glue
+# --------------------------------------------------------------------------
+
+
+@partial(
+    jax.jit, static_argnames=("metric", "row_tile", "col_tile", "n_win_tiles")
+)
+def _min_out_window_scan(
+    xr, cr, kr, data, core, comp, valid, col_start, metric: str, row_tile: int,
+    col_tile: int, n_win_tiles: int,
+):
+    """Min outgoing mutual-reachability edge per row against one window.
+
+    Windowed twin of ``ops.tiled._min_out_row_block``: MRD weights, the
+    other-component mask, smallest-column tie-break — columns restricted to
+    ``[col_start, col_start + n_win_tiles * col_tile)`` of the block-sorted
+    arrays. Returns ((R,) best_w, (R,) best_j sorted-space, -1/inf if none).
+    """
+    n_rows = xr.shape[0]
+    inf = jnp.array(jnp.inf, data.dtype)
+
+    def row_step(r):
+        x = jax.lax.dynamic_slice_in_dim(xr, r * row_tile, row_tile)
+        c = jax.lax.dynamic_slice_in_dim(cr, r * row_tile, row_tile)
+        kk = jax.lax.dynamic_slice_in_dim(kr, r * row_tile, row_tile)
+
+        def col_step(t, carry):
+            bw, bj = carry
+            base = col_start + t * col_tile
+            xc = jax.lax.dynamic_slice_in_dim(data, base, col_tile)
+            cc = jax.lax.dynamic_slice_in_dim(core, base, col_tile)
+            kc = jax.lax.dynamic_slice_in_dim(comp, base, col_tile)
+            vc = jax.lax.dynamic_slice_in_dim(valid, base, col_tile)
+            dmat = pairwise_distance(x, xc, metric)
+            w = jnp.maximum(dmat, jnp.maximum(c[:, None], cc[None, :]))
+            out = (kk[:, None] != kc[None, :]) & vc[None, :]
+            w = jnp.where(out, w, inf)
+            tw = jnp.min(w, axis=1)
+            tj = jnp.argmin(w, axis=1).astype(jnp.int32) + base
+            upd = tw < bw
+            return jnp.where(upd, tw, bw), jnp.where(upd, tj, bj)
+
+        init = (
+            jnp.full((row_tile,), jnp.inf, data.dtype),
+            jnp.full((row_tile,), -1, jnp.int32),
+        )
+        return jax.lax.fori_loop(0, n_win_tiles, col_step, init)
+
+    bw, bj = jax.lax.map(row_step, jnp.arange(n_rows // row_tile))
+    return bw.reshape(n_rows), bj.reshape(n_rows)
+
+
+def _segment_min(values: np.ndarray, segments: np.ndarray, n_seg: int) -> np.ndarray:
+    out = np.full(n_seg, np.inf)
+    np.minimum.at(out, segments, values)
+    return out
+
+
+def boruvka_glue_edges_blockpruned(
+    data: np.ndarray,
+    groups: np.ndarray,
+    core: np.ndarray,
+    metric: str = "euclidean",
+    knn_d: np.ndarray | None = None,
+    knn_j: np.ndarray | None = None,
+    col_tile: int = 8192,
+    row_tile: int = 256,
+    max_rounds: int = 64,
+    dense_pair_frac: float = 0.35,
+    init_comp: np.ndarray | None = None,
+    geom: BlockGeometry | None = None,
+    mesh=None,
+    trace=None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Exact inter-group MST glue with block-candidate column windows.
+
+    Semantics of ``ops.tiled.boruvka_glue_edges`` (every emitted edge is a
+    true MST edge of ``data`` under MRD weights — cut property) at a fraction
+    of the pairs. Per Borůvka round, for each component C:
+
+    1. **Upper bound** ``threshold_C`` on its min outgoing weight: the best
+       real outgoing k-NN-graph edge of any member (``knn_d/knn_j`` — the
+       (m, k) neighbor lists the boundary core scan already produced, ids
+       LOCAL to ``data``), tightened/backstopped by the geometric bound
+       ``max(d(i, c_B) + r_B, core_i, maxcore_B)`` — which upper-bounds an
+       actual edge into B, so the threshold is always achievable.
+    2. **Candidate pairs**: (i, B) with ``max(d(i,c_B) - r_B, core_i,
+       mincore_B) <= threshold_C`` — every pair that could beat the bound.
+       Rows with no surviving pair scan nothing this round (their component's
+       min edge provably lives elsewhere).
+    3. Candidate pairs coalesce into fixed-width window scans; the per-row
+       minimum of (k-NN candidate, window results) feeds the shared
+       vectorized contraction (``utils.unionfind.contract_min_edges``).
+
+    If the surviving pair count exceeds ``dense_pair_frac`` of m·G, the round
+    falls back to the dense scan (same result, better schedule).
+
+    ``init_comp`` decouples the INITIAL components from the geometry blocks
+    (the refinement pass starts from leaf clusters, whose spreads are useless
+    as bounding volumes, while the partition blocks keep tight radii): blocks
+    that mix several components are treated as foreign-bearing for every
+    component, and the device scans mask per COLUMN by component, so the
+    result stays exact.
+
+    ``geom``: pre-built :class:`BlockGeometry` over (``data``, ``groups``) —
+    the glue + every refinement round share one build (sort, centroid loop,
+    device copy) instead of rebuilding per call. ``mesh`` shards the DENSE
+    fallback rounds across devices; the window jobs themselves are
+    single-device by design (each is a small pow2-rows x fixed-window
+    program — sharding them would cost more in dispatch than it saves).
+    """
+    from hdbscan_tpu.ops.tiled import _drain_window
+    from hdbscan_tpu.utils.unionfind import contract_min_edges
+
+    m = len(data)
+    if m == 0:
+        return (np.zeros(0, np.int64), np.zeros(0, np.int64), np.zeros(0))
+    core = np.asarray(core, np.float64)
+    if geom is None:
+        geom = BlockGeometry.build(data, groups, metric, col_tile=col_tile)
+    g = len(geom.block_ids)
+    if g <= 1:
+        return (np.zeros(0, np.int64), np.zeros(0, np.int64), np.zeros(0))
+
+    # Device-resident per-row state in sorted space, padded to the device
+    # column length (pad columns are masked via valid_sorted).
+    core_pad = np.zeros(geom.n_pad, np.float32)
+    core_pad[:m] = core[geom.perm]
+    core_sorted = jax.device_put(core_pad)
+    rows_all = geom.data_host  # original order
+    # Per-block core extrema for the achievable-edge / exclusion bounds.
+    maxcore_b = np.full(g, -np.inf)
+    mincore_b = np.full(g, np.inf)
+    np.maximum.at(maxcore_b, np.searchsorted(geom.block_ids, groups), core)
+    np.minimum.at(mincore_b, np.searchsorted(geom.block_ids, groups), core)
+    dense_block = np.searchsorted(geom.block_ids, groups)  # (m,) dense block idx
+
+    # Initial components: block representative per row (or caller-provided).
+    order0 = np.argsort(dense_block, kind="stable")
+    firsts = np.concatenate([[True], np.diff(dense_block[order0]) != 0])
+    if init_comp is None:
+        comp = order0[firsts][dense_block]
+    else:
+        comp = np.asarray(init_comp, np.int64).copy()
+        if len(np.unique(comp)) <= 1:
+            return (np.zeros(0, np.int64), np.zeros(0, np.int64), np.zeros(0))
+
+    if knn_j is not None:
+        knn_j = np.asarray(knn_j, np.int64)
+        knn_d = np.asarray(knn_d, np.float64)
+        # MRD weights of the k-NN candidates (fixed across rounds).
+        knn_w = np.maximum(
+            knn_d, np.maximum(core[:, None], core[np.maximum(knn_j, 0)])
+        )
+        knn_w = np.where(knn_j >= 0, knn_w, np.inf)
+
+    eu, ev, ew = [], [], []
+    slack = lambda x: x * (1 + _BOUND_RTOL) + _BOUND_ATOL  # noqa: E731
+    rows_f = rows_all.astype(np.float32)
+    _dense_scanner = [None]
+    n_comp = len(np.unique(comp))
+    for rnd in range(max_rounds):
+        if n_comp <= 1:
+            break
+        _, cidx = np.unique(comp, return_inverse=True)
+        ncomp_dense = cidx.max() + 1
+        # Per-block component, purity-aware: a block whose members span
+        # several components (possible with decoupled ``init_comp``) is
+        # foreign-bearing for EVERY component — encoded as -2, which never
+        # equals a dense component index.
+        cs = cidx[geom.perm]
+        bmin = np.minimum.reduceat(cs, geom.starts)
+        bmax = np.maximum.reduceat(cs, geom.starts)
+        block_comp = np.where(bmin == bmax, bmin, -2)
+
+        # --- pass A: k-NN-graph candidates + per-component upper bounds ----
+        bestA_w = np.full(m, np.inf)
+        bestA_j = np.full(m, -1, np.int64)
+        if knn_j is not None:
+            foreign = (knn_j >= 0) & (cidx[np.maximum(knn_j, 0)] != cidx[:, None])
+            wA = np.where(foreign, knn_w, np.inf)
+            sel = np.argmin(wA, axis=1)
+            bestA_w = np.take_along_axis(wA, sel[:, None], axis=1)[:, 0]
+            bestA_j = np.where(
+                np.isfinite(bestA_w),
+                np.take_along_axis(knn_j, sel[:, None], axis=1)[:, 0],
+                -1,
+            )
+        upper = _segment_min(bestA_w, cidx, ncomp_dense)
+
+        # --- geometric backstop + pass-B pair extraction, chunked over rows
+        # so only a (chunk, G) bound matrix ever materializes. Two sweeps:
+        # first tighten the per-component achievable-edge upper bound
+        # (``max(d(i,c_B)+r_B, core_i, maxcore_B)`` upper-bounds a REAL edge
+        # into B, so thresholds are always attainable), then keep the (i, B)
+        # pairs whose lower bound could beat the threshold.
+        chunk = max(1, (256 << 20) // (8 * g))
+        for lo in range(0, m, chunk):
+            r = slice(lo, lo + chunk)
+            dcc = _chunked_centroid_distances(rows_all[r], geom.centroid, metric)
+            foreign_c = block_comp[None, :] != cidx[r, None]
+            ub2 = np.maximum(
+                dcc + geom.radius[None, :],
+                np.maximum(core[r, None], maxcore_b[None, :]),
+            )
+            ub2 = np.where(foreign_c, ub2, np.inf)
+            np.minimum.at(upper, cidx[r], ub2.min(axis=1))
+        pair_rows_l, pair_blocks_l = [], []
+        for lo in range(0, m, chunk):
+            r = slice(lo, lo + chunk)
+            dcc = _chunked_centroid_distances(rows_all[r], geom.centroid, metric)
+            foreign_c = block_comp[None, :] != cidx[r, None]
+            lb = np.maximum(
+                dcc - geom.radius[None, :],
+                np.maximum(core[r, None], mincore_b[None, :]),
+            )
+            keep = foreign_c & (lb <= slack(upper[cidx[r]])[:, None])
+            pr, pb = np.nonzero(keep)
+            pair_rows_l.append(pr + lo)
+            pair_blocks_l.append(pb)
+        pair_rows = np.concatenate(pair_rows_l)
+        pair_blocks = np.concatenate(pair_blocks_l)
+        n_pairs = len(pair_rows)
+        bestB_w = np.full(m, np.inf, np.float64)
+        bestB_j = np.full(m, -1, np.int64)
+        if n_pairs:
+            if n_pairs > dense_pair_frac * m * g:
+                # Dense round: same result, better schedule at this density.
+                if _dense_scanner[0] is None:
+                    from hdbscan_tpu.ops.tiled import BoruvkaScanner
+
+                    _dense_scanner[0] = BoruvkaScanner(
+                        data, core, metric, pad_pow2=True, mesh=mesh
+                    )
+                bw, bj = _dense_scanner[0].min_outgoing(comp)
+                bestB_w = bw
+                bestB_j = bj
+            else:
+                jobs = _window_jobs(geom, pair_rows, pair_blocks)
+                comp_pad = np.full(geom.n_pad, -3, np.int32)
+                comp_pad[:m] = cs
+                comp_sorted = jax.device_put(comp_pad)
+
+                def dispatches():
+                    for col_start, ridx in jobs:
+                        r_pad = max(
+                            row_tile, 1 << int(len(ridx) - 1).bit_length()
+                        )
+                        xr = np.zeros((r_pad, rows_f.shape[1]), np.float32)
+                        xr[: len(ridx)] = rows_f[ridx]
+                        cr = np.zeros(r_pad, np.float32)
+                        cr[: len(ridx)] = core[ridx]
+                        kr = np.full(r_pad, -1, np.int32)
+                        kr[: len(ridx)] = cidx[ridx]
+                        out = _min_out_window_scan(
+                            jnp.asarray(xr),
+                            jnp.asarray(cr),
+                            jnp.asarray(kr),
+                            geom.data_sorted,
+                            core_sorted,
+                            comp_sorted,
+                            geom.valid_sorted,
+                            jnp.int32(col_start),
+                            metric,
+                            row_tile,
+                            geom.col_tile,
+                            geom.win_tiles,
+                        )
+                        yield ridx, out
+
+                for ridx, (jw, jj) in _drain_window((x for x in dispatches())):
+                    jw = np.asarray(jw, np.float64)[: len(ridx)]
+                    jj = np.asarray(jj, np.int64)[: len(ridx)]
+                    valid_j = jj >= 0
+                    jg = np.where(valid_j, geom.perm[np.maximum(jj, 0)], -1)
+                    upd = jw < bestB_w[ridx]
+                    bestB_w[ridx] = np.where(upd, jw, bestB_w[ridx])
+                    bestB_j[ridx] = np.where(upd & valid_j, jg, bestB_j[ridx])
+
+        take_b = bestB_w < bestA_w
+        best_w = np.where(take_b, bestB_w, bestA_w)
+        best_j = np.where(take_b, bestB_j, bestA_j)
+        if trace is not None:
+            trace(
+                "glue_round",
+                round=rnd,
+                n_comp=int(n_comp),
+                pairs=int(n_pairs),
+                pair_frac=round(n_pairs / (m * g), 5),
+            )
+        emit, comp, n_comp = contract_min_edges(comp, best_j, best_w)
+        if len(emit) == 0:
+            break
+        eu.append(emit)
+        ev.append(best_j[emit])
+        ew.append(best_w[emit])
+    return (
+        np.concatenate(eu) if eu else np.zeros(0, np.int64),
+        np.concatenate(ev) if ev else np.zeros(0, np.int64),
+        np.concatenate(ew) if ew else np.zeros(0, np.float64),
+    )

@@ -37,6 +37,8 @@ import numpy as np
 
 __all__ = [
     "already_initialized",
+    "communicate_all",
+    "free_local_port",
     "hermetic_child_env",
     "initialize_from_cluster_name",
     "host_row_slab",
@@ -187,6 +189,43 @@ def hermetic_child_env(
     paths = ([repo_root] if repo_root else []) + keep
     env["PYTHONPATH"] = os.pathsep.join(paths)
     return env
+
+
+def free_local_port() -> int:
+    """An OS-assigned free TCP port for a local coordinator.
+
+    TOCTOU caveat: the port is released before the coordinator binds it —
+    callers pair this with :func:`communicate_all`'s kill-the-set timeout
+    handling so a lost race cannot leak ranks blocked on a dead port.
+    """
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def communicate_all(procs, timeout: int = 300):
+    """``communicate()`` every subprocess; kill the whole set on any timeout.
+
+    A hung rank (e.g. coordinator-port race) must not leak its peers blocked
+    at a distributed barrier holding the port. Returns [(stdout, stderr)]
+    in order; re-raises TimeoutExpired after the cleanup.
+    """
+    import subprocess
+
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=timeout))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.communicate()
+        raise
+    return outs
 
 
 def host_row_slab(n_rows: int, index: int | None = None, count: int | None = None):

@@ -14,7 +14,6 @@ multi-controller SPMD correct (every process must take the same decisions).
 """
 
 import os
-import socket
 import subprocess
 import sys
 
@@ -25,37 +24,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)
 OUTPUT_KINDS = ("hierarchy", "tree", "partition", "outlier_scores", "visualization")
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+# One copy of the spawn/cleanup rules, shared with dryrun_multichip
+# (parallel/distributed.py owns them).
+from hdbscan_tpu.parallel.distributed import (  # noqa: E402
+    communicate_all as _communicate_all,
+    free_local_port as _free_port,
+    hermetic_child_env,
+)
 
 
 def _child_env(n_local_devices: int) -> dict:
-    # One copy of the hermeticization rules, shared with dryrun_multichip.
-    from hdbscan_tpu.parallel.distributed import hermetic_child_env
-
     return hermetic_child_env(n_local_devices, repo_root=REPO)
-
-
-def _communicate_all(procs, timeout: int = 300):
-    """communicate() every proc; on timeout kill the whole set first.
-
-    A hung rank (e.g. coordinator-port race) must not leak its peer blocked
-    at a distributed barrier holding the port past the test run.
-    """
-    outs = []
-    try:
-        for p in procs:
-            outs.append(p.communicate(timeout=timeout))
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-        for p in procs:
-            p.communicate()
-        raise
-    return outs
 
 
 def _run_cli(args: list[str], n_local_devices: int, timeout: int = 300):

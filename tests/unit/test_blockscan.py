@@ -1,0 +1,207 @@
+"""Exactness of the block-adjacency-pruned scans vs the full sweeps.
+
+The contract (ops/blockscan.py): candidate exclusion by f64 centroid/radius
+bounds must not change results — the pruned core scan matches
+``ops.tiled.knn_core_distances_rows`` and the pruned glue matches
+``ops.tiled.boruvka_glue_edges`` up to f32 scan jitter, on data partitioned
+the way the MR driver partitions it (spatially coherent blocks with seams).
+"""
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.ops import tiled
+from hdbscan_tpu.ops.blockscan import (
+    BlockGeometry,
+    boruvka_glue_edges_blockpruned,
+    knn_rows_blockpruned,
+)
+
+
+def _blocky_data(rng, n=3000, d=5, n_blocks=12):
+    """Spatially coherent blocks (sorted along a noisy projection) — the
+    shape the recursive partitioner produces: blocks own regions, seams
+    between neighbors."""
+    pts = np.concatenate(
+        [
+            rng.normal(c * 3.0, 1.0, size=(n // 6, d))
+            for c in rng.normal(size=(6, d))
+        ]
+    )[:n]
+    proj = pts @ rng.normal(size=d) + rng.normal(0, 0.1, len(pts))
+    order = np.argsort(proj)
+    block_of = np.empty(len(pts), np.int64)
+    for b, seg in enumerate(np.array_split(order, n_blocks)):
+        block_of[seg] = b
+    return pts, block_of
+
+
+def _per_block_cores(pts, block_of, min_pts, metric="euclidean"):
+    """Reference per-block core distances (the ub the driver feeds)."""
+    from hdbscan_tpu.core.distances import rowwise_distance_np
+
+    core = np.empty(len(pts))
+    for b in np.unique(block_of):
+        ids = np.nonzero(block_of == b)[0]
+        seg = pts[ids]
+        dm = np.sqrt(
+            np.maximum(
+                np.sum(seg**2, 1)[:, None]
+                + np.sum(seg**2, 1)[None, :]
+                - 2 * seg @ seg.T,
+                0,
+            )
+        )
+        if metric != "euclidean":
+            dm = np.stack(
+                [rowwise_distance_np(seg, np.broadcast_to(p, seg.shape), metric) for p in seg]
+            )
+        k = min(min_pts - 1, len(ids))
+        core[ids] = np.sort(dm, axis=1)[:, k - 1]
+    return core
+
+
+class TestPrunedCoreScan:
+    @pytest.mark.parametrize("metric", ["euclidean", "manhattan", "supremum"])
+    def test_matches_full_sweep(self, rng, metric):
+        pts, block_of = _blocky_data(rng)
+        min_pts = 8
+        ub = _per_block_cores(pts, block_of, min_pts, metric)
+        bset = np.sort(rng.choice(len(pts), 700, replace=False))
+        geom = BlockGeometry.build(pts, block_of, metric, col_tile=256)
+        got = knn_rows_blockpruned(geom, bset, ub[bset], min_pts, row_tile=64)
+        want = tiled.knn_core_distances_rows(
+            pts, bset, min_pts, metric, row_tile=64, col_tile=256
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_neighbor_ids_match_bruteforce(self, rng):
+        pts, block_of = _blocky_data(rng, n=1200, d=3)
+        min_pts = 6
+        ub = _per_block_cores(pts, block_of, min_pts)
+        bset = np.arange(0, len(pts), 3)
+        geom = BlockGeometry.build(pts, block_of, col_tile=256)
+        core, knn_d, knn_j = knn_rows_blockpruned(
+            geom, bset, ub[bset], min_pts, return_neighbors=True, row_tile=64
+        )
+        d2 = np.sum((pts[bset][:, None, :] - pts[None, :, :]) ** 2, axis=2)
+        want_d = np.sqrt(np.sort(d2, axis=1)[:, : min_pts - 1])
+        np.testing.assert_allclose(knn_d, want_d, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(core, want_d[:, -1], rtol=1e-5, atol=1e-6)
+        # ids point at actual columns achieving those distances
+        picked = np.take_along_axis(
+            np.sqrt(d2), np.argsort(knn_j, axis=1) * 0 + knn_j, axis=1
+        )
+        np.testing.assert_allclose(picked, knn_d, rtol=1e-5, atol=1e-6)
+
+    def test_empty_and_single_block(self, rng):
+        pts = rng.normal(size=(300, 4))
+        geom = BlockGeometry.build(pts, np.zeros(300, np.int64), col_tile=128)
+        core = knn_rows_blockpruned(
+            geom, np.zeros(0, np.int64), np.zeros(0), 5, row_tile=64
+        )
+        assert core.shape == (0,)
+        full, _ = tiled.knn_core_distances(pts, 5, row_tile=64, col_tile=128)
+        some = knn_rows_blockpruned(
+            geom, np.arange(50), np.full(50, np.inf), 5, row_tile=64
+        )
+        np.testing.assert_allclose(some, full[:50], rtol=1e-5, atol=1e-6)
+
+    def test_rejects_non_triangle_metric(self, rng):
+        pts = rng.normal(size=(100, 4))
+        with pytest.raises(ValueError, match="triangle"):
+            BlockGeometry.build(pts, np.zeros(100, np.int64), metric="cosine")
+
+
+class TestPrunedGlue:
+    def _knn_graph(self, pts, block_of, core, min_pts):
+        geom = BlockGeometry.build(pts, block_of, col_tile=256)
+        _, knn_d, knn_j = knn_rows_blockpruned(
+            geom,
+            np.arange(len(pts)),
+            np.full(len(pts), np.inf),
+            min_pts,
+            return_neighbors=True,
+            row_tile=64,
+        )
+        return knn_d, knn_j
+
+    @pytest.mark.parametrize("with_knn", [True, False])
+    def test_matches_dense_glue(self, rng, with_knn):
+        pts, block_of = _blocky_data(rng, n=1500, d=4)
+        min_pts = 6
+        core, _ = tiled.knn_core_distances(pts, min_pts, row_tile=64, col_tile=256)
+        knn_d = knn_j = None
+        if with_knn:
+            knn_d, knn_j = self._knn_graph(pts, block_of, core, min_pts)
+        gu, gv, gw = boruvka_glue_edges_blockpruned(
+            pts, block_of, core, knn_d=knn_d, knn_j=knn_j, col_tile=256,
+            row_tile=64,
+        )
+        wu, wv, ww = tiled.boruvka_glue_edges(
+            pts, block_of, core=core, row_tile=64, col_tile=256
+        )
+        # Same spanning structure: identical edge count and total weight
+        # (continuous data -> no ties -> the MST is unique).
+        assert len(gu) == len(wu)
+        np.testing.assert_allclose(np.sort(gw), np.sort(ww), rtol=1e-5, atol=1e-6)
+        got = {(min(a, b), max(a, b)) for a, b in zip(gu, gv)}
+        want = {(min(a, b), max(a, b)) for a, b in zip(wu, wv)}
+        assert got == want
+
+    def test_decoupled_init_comp_matches_dense(self, rng):
+        """Refinement shape: components = coarse labels cutting ACROSS the
+        geometry blocks (mixed blocks), still exact vs the dense glue."""
+        pts, block_of = _blocky_data(rng, n=1200, d=4)
+        min_pts = 6
+        core, _ = tiled.knn_core_distances(pts, min_pts, row_tile=64, col_tile=256)
+        # Coarse labels from a different projection: blocks get mixed.
+        labels = (pts @ rng.normal(size=4) > 0).astype(np.int64) + 2 * (
+            pts[:, 0] > np.median(pts[:, 0])
+        ).astype(np.int64)
+        knn_d, knn_j = self._knn_graph(pts, block_of, core, min_pts)
+        gu, gv, gw = boruvka_glue_edges_blockpruned(
+            pts, block_of, core, knn_d=knn_d, knn_j=knn_j, col_tile=256,
+            row_tile=64, init_comp=labels,
+        )
+        wu, wv, ww = tiled.boruvka_glue_edges(
+            pts, labels, core=core, row_tile=64, col_tile=256
+        )
+        assert len(gu) == len(wu)
+        np.testing.assert_allclose(np.sort(gw), np.sort(ww), rtol=1e-5, atol=1e-6)
+        got = {(min(a, b), max(a, b)) for a, b in zip(gu, gv)}
+        want = {(min(a, b), max(a, b)) for a, b in zip(wu, wv)}
+        assert got == want
+
+    def test_single_group_empty(self, rng):
+        pts = rng.normal(size=(200, 3))
+        u, v, w = boruvka_glue_edges_blockpruned(
+            pts, np.zeros(200, np.int64), np.zeros(200)
+        )
+        assert len(u) == len(v) == len(w) == 0
+
+    def test_spans_all_groups(self, rng):
+        pts, block_of = _blocky_data(rng, n=900, d=3, n_blocks=9)
+        core, _ = tiled.knn_core_distances(pts, 5, row_tile=64, col_tile=256)
+        u, v, w = boruvka_glue_edges_blockpruned(
+            pts, block_of, core, col_tile=128, row_tile=64
+        )
+        # glue edges + per-block connectivity span everything
+        parent = np.arange(len(pts))
+
+        def find(x):
+            while parent[x] != x:
+                parent[x] = parent[parent[x]]
+                x = parent[x]
+            return x
+
+        def union(a, b):
+            parent[find(a)] = find(b)
+
+        for b in np.unique(block_of):
+            ids = np.nonzero(block_of == b)[0]
+            for a in ids[1:]:
+                union(ids[0], a)
+        for a, b in zip(u, v):
+            union(int(a), int(b))
+        assert len({find(i) for i in range(len(pts))}) == 1

@@ -246,3 +246,30 @@ def test_final_block_ids_unique_across_levels(rng):
         f"glue saw {events['n_blocks']} blocks, recursion froze {total_blocks} "
         "(per-level subset-id collision?)"
     )
+
+
+def test_boundary_block_pruning_matches_full_sweep(rng):
+    """Pruned boundary phase (ops/blockscan.py) == full-sweep boundary phase.
+
+    The pruned scans are exact (test_blockscan.py pins the op level); this
+    pins the integration: same boundary set, same hybrid cores, same final
+    labels from mr_hdbscan.fit with and without boundary_block_pruning.
+    """
+    from tests.conftest import make_blobs
+
+    data, _ = make_blobs(rng, n=6000, d=4, centers=6, spread=0.35)
+    params = HDBSCANParams(
+        min_points=6, min_cluster_size=120, processing_units=1024,
+        boundary_quality=0.1, seed=2,
+    )
+    r_pruned = mr_hdbscan.fit(data, params, max_levels=16)
+    r_full = mr_hdbscan.fit(
+        data, params.replace(boundary_block_pruning=False), max_levels=16
+    )
+    np.testing.assert_allclose(
+        r_pruned.core_distances, r_full.core_distances, rtol=1e-5, atol=1e-6
+    )
+    from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+
+    ari = adjusted_rand_index(r_pruned.labels, r_full.labels)
+    assert ari > 0.999, f"pruned-vs-full boundary ARI {ari}"
