@@ -1,0 +1,117 @@
+"""High-dimensional regime evaluation — the d=28-90 coverage gap (VERDICT r2).
+
+The paper's real datasets reach d=28 (HEPMASS/HIGGS) and d=90
+(YearPrediction) — BASELINE.md Table 1 — while every round-1/2 measurement ran
+d <= 10. Two risks scale with d: the MXU dot-form distance expansion loses
+relative precision (the round-2 bf16 bug was caught at d >= 5 and fixed with
+``Precision.HIGHEST``; this harness cross-checks the fix holds at d=90), and
+``top_k`` working sets grow.
+
+Per (n, d) leg:
+  1. f64 ORACLE CROSS-CHECK: exact core distances from the tiled f32 device
+     scan vs a float64 numpy oracle on a row sample — max abs/rel error.
+  2. exact tiled-Borůvka fit (wall + ARI vs truth).
+  3. boundary-hybrid fit (wall + ARI vs truth + vs exact).
+
+Emits one JSON line per leg. Usage:
+  python benchmarks/highdim_eval.py [n] [dims_csv] [modes_csv]
+Defaults: n=500_000, dims=28,90, modes=oracle,exact,bound05.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hdbscan_tpu import HDBSCANParams
+from hdbscan_tpu.models import exact, mr_hdbscan
+from hdbscan_tpu.utils.datasets import make_gauss
+from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+
+
+def oracle_core_check(data, min_pts, sample=512, seed=0):
+    """Max abs/rel error of the device core distances vs a float64 oracle."""
+    from hdbscan_tpu.ops.tiled import knn_core_distances
+
+    core, _ = knn_core_distances(data, min_pts)
+    rng = np.random.default_rng(seed)
+    rows = rng.choice(len(data), min(sample, len(data)), replace=False)
+    d2 = (
+        np.sum(data[rows] ** 2, axis=1)[:, None]
+        + np.sum(data**2, axis=1)[None, :]
+        - 2.0 * data[rows] @ data.T
+    )
+    want = np.sqrt(np.maximum(np.sort(d2, axis=1)[:, min_pts - 2], 0.0))
+    got = core[rows]
+    abs_err = np.abs(got - want)
+    rel_err = abs_err / np.maximum(want, 1e-30)
+    return float(abs_err.max()), float(rel_err.max())
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 500_000
+    dims_list = [int(x) for x in (sys.argv[2] if len(sys.argv) > 2 else "28,90").split(",")]
+    modes = (sys.argv[3] if len(sys.argv) > 3 else "oracle,exact,bound05").split(",")
+    min_pts = 8
+    cap = 16384
+    for dims in dims_list:
+        # HEPMASS-class difficulty: few clusters. Separation scales with
+        # sqrt(d): within-cluster nearest-neighbor distances concentrate at
+        # ~sigma*sqrt(2d), so a FIXED center separation that is decisive at
+        # d=10 blends clusters at d=90 — 3*sqrt(d) keeps the difficulty in
+        # the same class as the sep-9 rows at d=10.
+        n_cl = 8
+        mcs = max(64, n // 200)
+        sep = 3.0 * float(np.sqrt(dims))
+        data, y = make_gauss(n, dims=dims, n_clusters=n_cl, separation=sep, seed=4)
+        base = dict(
+            min_points=min_pts, min_cluster_size=mcs, processing_units=cap,
+            seed=0, k=0.01,
+        )
+        exact_labels = None
+        for mode in modes:
+            t0 = time.time()
+            if mode == "oracle":
+                abs_e, rel_e = oracle_core_check(data, min_pts)
+                rec = {
+                    "config": "oracle_core_check",
+                    "n": n,
+                    "dims": dims,
+                    "core_abs_err_max": round(abs_e, 8),
+                    "core_rel_err_max": round(rel_e, 8),
+                    "wall_s": round(time.time() - t0, 2),
+                }
+                print(json.dumps(rec), flush=True)
+                continue
+            if mode == "exact":
+                r = exact.fit(data, HDBSCANParams(**base))
+                exact_labels = r.labels
+            elif mode == "bound05":
+                r = mr_hdbscan.fit(
+                    data, HDBSCANParams(**base, boundary_quality=0.05)
+                )
+            else:
+                raise ValueError(mode)
+            rec = {
+                "config": mode,
+                "n": n,
+                "dims": dims,
+                "min_cluster_size": mcs,
+                "wall_s": round(time.time() - t0, 2),
+                "ari_truth": round(float(adjusted_rand_index(r.labels, y)), 4),
+            }
+            if exact_labels is not None and mode != "exact":
+                rec["ari_exact"] = round(
+                    float(adjusted_rand_index(r.labels, exact_labels)), 4
+                )
+            print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -80,6 +80,7 @@ def main(argv: list[str] | None = None) -> int:
                 file=sys.stderr,
             )
 
+    fit_done = False
     try:
         data = load_points(params.input_file)
         if data.ndim == 1:
@@ -94,6 +95,7 @@ def main(argv: list[str] | None = None) -> int:
             result = mr_hdbscan.fit(data, params, mesh=mesh)
             mode = f"mr ({result.n_levels} levels)"
         wall = time.monotonic() - t0
+        fit_done = True
 
         if is_main:
             paths = hdbscan.write_outputs(result, params)
@@ -114,11 +116,14 @@ def main(argv: list[str] | None = None) -> int:
             for kind, path in paths.items():
                 print(f"  {kind}: {path}")
     finally:
-        if n_proc > 1:
-            # Barrier before exit — in a finally so a rank that fails (e.g.
-            # unwritable out_dir on process 0) still joins before teardown;
-            # peers stuck at the barrier would otherwise die on opaque
-            # coordinator RPC errors that mask the real cause.
+        if n_proc > 1 and fit_done:
+            # Barrier before exit — in a finally so a rank that fails AFTER
+            # the pipeline (e.g. unwritable out_dir on process 0) still
+            # joins before teardown. Gated on fit completion: a rank that
+            # failed BEFORE/INSIDE fit must NOT issue the barrier while
+            # healthy peers are still inside fit's collectives (mismatched
+            # collective order deadlocks both) — it exits loudly instead and
+            # peers surface the loss via the coordinator's liveness error.
             from jax.experimental import multihost_utils
 
             multihost_utils.sync_global_devices("hdbscan_tpu_cli_done")

@@ -121,7 +121,8 @@ def _select_boundary(
     core: np.ndarray | None = None,
     min_per_block: int = 32,
     max_frac: float = _BOUNDARY_MAX_FRAC,
-) -> np.ndarray:
+    return_floor: bool = False,
+):
     """Boundary-point ids: the adaptive at-risk set plus a per-block floor.
 
     Selected = { margin <= ALPHA * per-block core } ∪ { per final block, the
@@ -131,6 +132,9 @@ def _select_boundary(
     representatives — keeping the inter-block harvest connected — and is
     density-adaptive where a global margin threshold would mix distance
     scales across blocks.
+
+    ``return_floor``: also return the floor-only ids (the glue/refine row
+    set — always a subset of the union, one selection pass for both).
     """
     n = len(margin)
     _, inv = np.unique(subset, return_inverse=True)
@@ -143,6 +147,7 @@ def _select_boundary(
     rank = np.empty(n, np.int64)
     rank[order] = np.arange(n) - np.repeat(starts, counts)
     sel = rank < take[inv]
+    floor_ids = np.nonzero(sel)[0] if return_floor else None
     if core is not None:
         adaptive = margin <= _BOUNDARY_ALPHA * core
         max_n = int(np.ceil(max_frac * n))
@@ -166,7 +171,10 @@ def _select_boundary(
             )
         else:
             sel = sel | adaptive
-    return np.nonzero(sel)[0]
+    ids = np.nonzero(sel)[0]
+    if return_floor:
+        return ids, floor_ids
+    return ids
 
 
 def _reweight_pool(
@@ -553,7 +561,19 @@ def _fit_rows(
             # device — the configured footprint must be the compiled one.
             cap_s = 1 << (params.max_samples.bit_length() - 1)
             s_count = min(size, max(2, math.ceil(params.k * size)), cap_s)
-            samp_local = rng.choice(size, s_count, replace=False)
+            if weights is not None and s_count < size:
+                # Weighted draw ∝ multiplicity (Gumbel top-k = sampling
+                # without replacement with p ∝ w): the reference samples in
+                # ROW space (sampleByKeyExact over rows, main/Main.java:141),
+                # so under dedup a unique point standing for 1000 duplicate
+                # rows must be 1000x likelier to be drawn than a singleton —
+                # uniform unique-space draws skew samples toward sparse
+                # regions and were a measured seed-variance source on
+                # lattice data (VERDICT r2 item 7).
+                keys = np.log(weights[ids]) + rng.gumbel(size=size)
+                samp_local = np.argpartition(-keys, s_count - 1)[:s_count]
+            else:
+                samp_local = rng.choice(size, s_count, replace=False)
             samples_global = ids[samp_local]
             assign = nearest_sample_assign(data[ids], data[samples_global], metric)
 
@@ -775,17 +795,29 @@ def _fit_rows(
         # heavy that k-NN balls rival block radii) degrades toward the
         # full-sweep cost AND quality — i.e. toward fullq, which is the
         # right behavior at that difficulty; the cap warning still fires.
-        bset = _select_boundary(
+        # Two roles, two sets (round-3 measurement: conflating them cost 3x
+        # at 1M): the CORE RESCAN must cover the whole at-risk population —
+        # any point whose k-NN ball crosses a seam carries an inflated
+        # per-block core, and interior weights built from those poison the
+        # intra/inter contrast (round-2 diagnosis) — while the GLUE/REFINE
+        # rounds only need rows that can HOST inter-block MST edges, i.e.
+        # the closest-approach points of adjacent blocks: the lowest-margin
+        # fraction per block (the selection's floor term). With forced
+        # splits cutting through dense interiors the at-risk set reaches
+        # ~90% of n, but the edge-hosting set stays at the configured q.
+        bset, bset_glue_sel = _select_boundary(
             bmargin,
             final_block,
             boundary_q,
             core=core,
             max_frac=0.9 if pruned else _BOUNDARY_MAX_FRAC,
+            return_floor=True,
         )
         if trace is not None:
             trace(
                 "boundary_select",
                 m=len(bset),
+                m_glue=len(bset_glue_sel),
                 frac=round(len(bset) / n, 4),
                 pruned=pruned,
                 wall_s=round(time.monotonic() - t0, 3),
@@ -815,12 +847,23 @@ def _fit_rows(
             # The full-dataset device copy is only needed for this rescan —
             # release it before the glue/tree stages pin more HBM.
             del geom_blocks
-            # Map neighbor ids into boundary-local space for the glue (a
-            # neighbor outside the boundary set is not a glue vertex).
+            # The glue's k-NN seed edges, restricted to the glue set: rows
+            # are the glue rows (a subset of bset — the quantile floor is
+            # the adaptive selection's first term), neighbor ids re-mapped
+            # to glue-local space (a neighbor outside the glue set is not a
+            # glue vertex).
             bset_pos = np.full(n, -1, np.int64)
             bset_pos[bset] = np.arange(len(bset))
-            knn_j_local = np.where(knn_j_b >= 0, bset_pos[np.maximum(knn_j_b, 0)], -1)
-            bset_knn = (knn_d_b, knn_j_local)
+            glue_pos = np.full(n, -1, np.int64)
+            glue_pos[bset_glue_sel] = np.arange(len(bset_glue_sel))
+            sel_pos = bset_pos[bset_glue_sel]
+            knn_d_g = knn_d_b[sel_pos]
+            knn_j_g = np.where(
+                knn_j_b[sel_pos] >= 0,
+                glue_pos[np.maximum(knn_j_b[sel_pos], 0)],
+                -1,
+            )
+            bset_knn = (knn_d_g, knn_j_g)
         else:
             core_b = knn_core_distances_rows(data, bset, params.min_points, metric)
         core[bset] = np.minimum(core[bset], core_b)
@@ -833,12 +876,15 @@ def _fit_rows(
         w = _reweight_pool(u, v, w, data, core, metric)
         if trace is not None:
             trace("boundary_reweight", edges=len(w), wall_s=round(time.monotonic() - t0, 3))
-        # 4) Inter-block Borůvka glue restricted to the boundary set — the
-        #    true min MRD edges between blocks have seam endpoints, so the
-        #    harvest over B finds them; block pruning restricts each round's
-        #    columns to the blocks the per-component edge bounds can reach.
+        # 4) Inter-block Borůvka glue restricted to the GLUE set (the
+        #    lowest-margin fraction per block) — the true min MRD edges
+        #    between blocks connect the blocks' closest-approach points, so
+        #    the harvest over the seam-hosting rows finds them; block
+        #    pruning restricts each round's columns to the blocks the
+        #    per-component edge bounds can reach.
         t0 = time.monotonic()
-        if len(np.unique(final_block[bset])) >= 2:
+        bset_g = bset_glue_sel
+        if len(np.unique(final_block[bset_g])) >= 2:
             if pruned:
                 from hdbscan_tpu.ops.blockscan import (
                     BlockGeometry,
@@ -847,12 +893,12 @@ def _fit_rows(
 
                 # One geometry serves the glue AND every refinement round.
                 geom_bset = BlockGeometry.build(
-                    data[bset], final_block[bset], metric
+                    data[bset_g], final_block[bset_g], metric
                 )
                 gu, gv, gw = boruvka_glue_edges_blockpruned(
-                    data[bset],
-                    final_block[bset],
-                    core[bset],
+                    data[bset_g],
+                    final_block[bset_g],
+                    core[bset_g],
                     metric,
                     knn_d=bset_knn[0],
                     knn_j=bset_knn[1],
@@ -862,18 +908,19 @@ def _fit_rows(
                 )
             else:
                 gu, gv, gw = boruvka_glue_edges(
-                    data[bset], final_block[bset], metric, core=core[bset],
+                    data[bset_g], final_block[bset_g], metric, core=core[bset_g],
                     mesh=mesh,
                 )
-            u = np.concatenate([u, bset[gu]])
-            v = np.concatenate([v, bset[gv]])
+            u = np.concatenate([u, bset_g[gu]])
+            v = np.concatenate([v, bset_g[gv]])
             w = np.concatenate([w, gw])
         if trace is not None:
             trace(
                 "boundary_phase",
                 m=len(bset),
+                m_glue=len(bset_g),
                 frac=round(len(bset) / n, 4),
-                n_blocks=int(len(np.unique(final_block[bset]))),
+                n_blocks=int(len(np.unique(final_block[bset_g]))),
                 wall_s=round(time.monotonic() - t0, 3),
             )
 
@@ -926,9 +973,11 @@ def _fit_rows(
             t0 = time.monotonic()
             groups_r = tree.point_last_cluster[:n]
             if bset is not None:
-                # Boundary mode: refine over the seam set only — leaf-cluster
-                # boundaries are partition seams, so the repair edges live in B.
-                if len(np.unique(groups_r[bset])) < 2:
+                # Boundary mode: refine over the glue (seam-hosting) set only
+                # — leaf-cluster boundaries are partition seams, so the
+                # repair edges live among the lowest-margin rows.
+                bset_g = bset_glue_sel
+                if len(np.unique(groups_r[bset_g])) < 2:
                     break
                 if bset_knn is not None:
                     # Pruned refinement: components = leaf clusters, geometry
@@ -940,23 +989,23 @@ def _fit_rows(
                     )
 
                     ru, rv, rw = boruvka_glue_edges_blockpruned(
-                        data[bset],
-                        final_block[bset],
-                        core[bset],
+                        data[bset_g],
+                        final_block[bset_g],
+                        core[bset_g],
                         metric,
                         knn_d=bset_knn[0],
                         knn_j=bset_knn[1],
-                        init_comp=groups_r[bset],
+                        init_comp=groups_r[bset_g],
                         geom=geom_bset,
                         mesh=mesh,
                         trace=trace,
                     )
                 else:
                     ru, rv, rw = boruvka_glue_edges(
-                        data[bset], groups_r[bset], metric, core=core[bset],
+                        data[bset_g], groups_r[bset_g], metric, core=core[bset_g],
                         mesh=mesh,
                     )
-                ru, rv = bset[ru], bset[rv]
+                ru, rv = bset_g[ru], bset_g[rv]
             else:
                 if len(np.unique(groups_r)) < 2:
                     break

@@ -536,6 +536,23 @@ def boruvka_glue_edges_blockpruned(
     rows_f = rows_all.astype(np.float32)
     _dense_scanner = [None]
     n_comp = len(np.unique(comp))
+    # Centroid distances are ROUND-INVARIANT (rows and centroids never
+    # change): cache the (m, G) matrix once instead of recomputing it in
+    # both sweeps of every round (2R full O(m·G·d) host passes). f32 halves
+    # the footprint; above a 1 GB budget fall back to per-chunk recompute.
+    chunk = max(1, (256 << 20) // (8 * g))
+    dc_cache = None
+    if m * g * 4 <= (1 << 30):
+        dc_cache = np.empty((m, g), np.float32)
+        for lo in range(0, m, chunk):
+            dc_cache[lo : lo + chunk] = _chunked_centroid_distances(
+                rows_all[lo : lo + chunk], geom.centroid, metric
+            )
+
+    def _dc(sl: slice) -> np.ndarray:
+        if dc_cache is not None:
+            return dc_cache[sl]
+        return _chunked_centroid_distances(rows_all[sl], geom.centroid, metric)
     for rnd in range(max_rounds):
         if n_comp <= 1:
             break
@@ -571,10 +588,9 @@ def boruvka_glue_edges_blockpruned(
         # (``max(d(i,c_B)+r_B, core_i, maxcore_B)`` upper-bounds a REAL edge
         # into B, so thresholds are always attainable), then keep the (i, B)
         # pairs whose lower bound could beat the threshold.
-        chunk = max(1, (256 << 20) // (8 * g))
         for lo in range(0, m, chunk):
             r = slice(lo, lo + chunk)
-            dcc = _chunked_centroid_distances(rows_all[r], geom.centroid, metric)
+            dcc = _dc(r)
             foreign_c = block_comp[None, :] != cidx[r, None]
             ub2 = np.maximum(
                 dcc + geom.radius[None, :],
@@ -585,7 +601,7 @@ def boruvka_glue_edges_blockpruned(
         pair_rows_l, pair_blocks_l = [], []
         for lo in range(0, m, chunk):
             r = slice(lo, lo + chunk)
-            dcc = _chunked_centroid_distances(rows_all[r], geom.centroid, metric)
+            dcc = _dc(r)
             foreign_c = block_comp[None, :] != cidx[r, None]
             lb = np.maximum(
                 dcc - geom.radius[None, :],
