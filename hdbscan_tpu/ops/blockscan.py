@@ -235,19 +235,24 @@ def _window_jobs(
     static_argnames=("k", "metric", "row_tile", "col_tile", "n_win_tiles"),
 )
 def _knn_window_scan(
-    rows, data, valid, col_start, k: int, metric: str, row_tile: int,
+    row_ids, data, valid, col_start, k: int, metric: str, row_tile: int,
     col_tile: int, n_win_tiles: int,
 ):
-    """k smallest distances (+ sorted-space ids) of ``rows`` against the
-    window ``[col_start, col_start + n_win_tiles * col_tile)`` of ``data``.
+    """k smallest distances (+ sorted-space ids) of the rows ``row_ids`` of
+    ``data`` against the window ``[col_start, col_start + n_win_tiles *
+    col_tile)`` of the same array.
 
     Same tile discipline as ``ops.tiled._knn_core_scan`` — fori over column
     tiles, top_k merge — but over a fixed-width window at a dynamic origin:
     the static shape axis is (row_tile, col_tile, n_win_tiles), so every job
     of one row-count class shares a compile regardless of which blocks it
-    scans. Pad rows produce garbage; callers slice.
+    scans. Rows arrive as (R,) int32 SORTED-SPACE indices and gather on
+    device — uploading coordinates per job cost 10x the bytes (measured
+    dominating the 4M boundary rescan). Pad rows produce garbage; callers
+    slice.
     """
-    n_rows = rows.shape[0]
+    n_rows = row_ids.shape[0]
+    rows = jnp.take(data, row_ids, axis=0)
     inf = jnp.array(jnp.inf, data.dtype)
 
     def row_step(r):
@@ -310,7 +315,6 @@ def knn_rows_blockpruned(
     min_pts: int,
     return_neighbors: bool = False,
     row_tile: int = 256,
-    dtype=np.float32,
 ):
     """Exact core distances of selected rows via block-candidate windows.
 
@@ -337,17 +341,18 @@ def knn_rows_blockpruned(
 
     best_d = np.full((m, k), np.inf, np.float64)
     best_i = np.full((m, k), -1, np.int64)
-    rows_f = rows.astype(dtype)
+    # Jobs address rows by sorted-space index (device-side gather).
+    rows_sorted_pos = np.asarray(geom.inv_perm[row_ids], np.int32)
 
     from hdbscan_tpu.ops.tiled import _drain_window
 
     def dispatches():
         for col_start, ridx in jobs:
             r_pad = max(row_tile, 1 << int(len(ridx) - 1).bit_length())
-            xr = np.zeros((r_pad, rows_f.shape[1]), dtype)
-            xr[: len(ridx)] = rows_f[ridx]
+            ids = np.zeros(r_pad, np.int32)
+            ids[: len(ridx)] = rows_sorted_pos[ridx]
             out = _knn_window_scan(
-                jnp.asarray(xr),
+                jnp.asarray(ids),
                 geom.data_sorted,
                 geom.valid_sorted,
                 jnp.int32(col_start),
@@ -383,7 +388,7 @@ def knn_rows_blockpruned(
     jax.jit, static_argnames=("metric", "row_tile", "col_tile", "n_win_tiles")
 )
 def _min_out_window_scan(
-    xr, cr, kr, data, core, comp, valid, col_start, metric: str, row_tile: int,
+    row_ids, data, core, comp, valid, col_start, metric: str, row_tile: int,
     col_tile: int, n_win_tiles: int,
 ):
     """Min outgoing mutual-reachability edge per row against one window.
@@ -391,15 +396,21 @@ def _min_out_window_scan(
     Windowed twin of ``ops.tiled._min_out_row_block``: MRD weights, the
     other-component mask, smallest-column tie-break — columns restricted to
     ``[col_start, col_start + n_win_tiles * col_tile)`` of the block-sorted
-    arrays. Returns ((R,) best_w, (R,) best_j sorted-space, -1/inf if none).
+    arrays. Rows arrive as (R,) int32 sorted-space indices; coordinates,
+    cores, and component labels all gather on device from the resident
+    sorted arrays (per-job uploads shrink to 4 bytes/row). Returns
+    ((R,) best_w, (R,) best_j sorted-space, -1/inf if none).
     """
-    n_rows = xr.shape[0]
+    n_rows = row_ids.shape[0]
+    xr_all = jnp.take(data, row_ids, axis=0)
+    cr_all = jnp.take(core, row_ids)
+    kr_all = jnp.take(comp, row_ids)
     inf = jnp.array(jnp.inf, data.dtype)
 
     def row_step(r):
-        x = jax.lax.dynamic_slice_in_dim(xr, r * row_tile, row_tile)
-        c = jax.lax.dynamic_slice_in_dim(cr, r * row_tile, row_tile)
-        kk = jax.lax.dynamic_slice_in_dim(kr, r * row_tile, row_tile)
+        x = jax.lax.dynamic_slice_in_dim(xr_all, r * row_tile, row_tile)
+        c = jax.lax.dynamic_slice_in_dim(cr_all, r * row_tile, row_tile)
+        kk = jax.lax.dynamic_slice_in_dim(kr_all, r * row_tile, row_tile)
 
         def col_step(t, carry):
             bw, bj = carry
@@ -533,7 +544,6 @@ def boruvka_glue_edges_blockpruned(
 
     eu, ev, ew = [], [], []
     slack = lambda x: x * (1 + _BOUND_RTOL) + _BOUND_ATOL  # noqa: E731
-    rows_f = rows_all.astype(np.float32)
     _dense_scanner = [None]
     n_comp = len(np.unique(comp))
     # Centroid distances are ROUND-INVARIANT (rows and centroids never
@@ -639,16 +649,10 @@ def boruvka_glue_edges_blockpruned(
                         r_pad = max(
                             row_tile, 1 << int(len(ridx) - 1).bit_length()
                         )
-                        xr = np.zeros((r_pad, rows_f.shape[1]), np.float32)
-                        xr[: len(ridx)] = rows_f[ridx]
-                        cr = np.zeros(r_pad, np.float32)
-                        cr[: len(ridx)] = core[ridx]
-                        kr = np.full(r_pad, -1, np.int32)
-                        kr[: len(ridx)] = cidx[ridx]
+                        ids = np.zeros(r_pad, np.int32)
+                        ids[: len(ridx)] = geom.inv_perm[ridx]
                         out = _min_out_window_scan(
-                            jnp.asarray(xr),
-                            jnp.asarray(cr),
-                            jnp.asarray(kr),
+                            jnp.asarray(ids),
                             geom.data_sorted,
                             core_sorted,
                             comp_sorted,
