@@ -230,6 +230,48 @@ def _window_jobs(
     return jobs
 
 
+#: Per-dispatch element budget for batched window scans: bounds one
+#: program's padded row-slot count (J * r_pad) so device runtime and output
+#: transfer stay tunnel-friendly while dispatch count stays ~#shape-classes.
+_BATCH_SLOT_BUDGET = 1 << 21
+
+
+def _batched_window_jobs(
+    geom: BlockGeometry,
+    jobs: list[tuple[int, np.ndarray]],
+    to_sorted_pos,
+    min_rows: int,
+):
+    """Pack window jobs into per-shape-class batches for single dispatches.
+
+    Per-window dispatches pay one tunnel round trip EACH (~1-3 s at large
+    row counts) — measured dominating the 8M boundary rescan (516 windows,
+    2167 s). Jobs whose padded row count shares a pow2 class stack into a
+    (J, r_pad) id matrix + (J,) col_starts and run as ONE ``lax.map``
+    program; J splits so J * r_pad stays under ``_BATCH_SLOT_BUDGET``.
+
+    ``to_sorted_pos``: maps a job's row-idx array to sorted-space device
+    indices. Yields (ridx_list, ids (J, r_pad) int32, col_starts (J,)).
+    """
+    by_class: dict[int, list[tuple[int, np.ndarray]]] = {}
+    for col_start, ridx in jobs:
+        r_pad = max(min_rows, 1 << int(len(ridx) - 1).bit_length())
+        by_class.setdefault(r_pad, []).append((col_start, ridx))
+    for r_pad, group in sorted(by_class.items()):
+        j_cap = max(1, _BATCH_SLOT_BUDGET // r_pad)
+        for lo in range(0, len(group), j_cap):
+            part = group[lo : lo + j_cap]
+            j_pad = 1 << max(0, (len(part) - 1).bit_length())
+            ids = np.zeros((j_pad, r_pad), np.int32)
+            starts = np.zeros(j_pad, np.int32)
+            ridx_list = []
+            for i, (col_start, ridx) in enumerate(part):
+                ids[i, : len(ridx)] = to_sorted_pos(ridx)
+                starts[i] = col_start
+                ridx_list.append(ridx)
+            yield ridx_list, ids, starts
+
+
 @partial(
     jax.jit,
     static_argnames=("k", "metric", "row_tile", "col_tile", "n_win_tiles"),
@@ -282,6 +324,45 @@ def _knn_window_scan(
 
     out, out_i = jax.lax.map(row_step, jnp.arange(n_rows // row_tile))
     return out.reshape(n_rows, k), out_i.reshape(n_rows, k)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("k", "metric", "row_tile", "col_tile", "n_win_tiles"),
+)
+def _knn_window_scan_batched(
+    row_ids_b, data, valid, col_starts, k: int, metric: str, row_tile: int,
+    col_tile: int, n_win_tiles: int,
+):
+    """(J, R) ids + (J,) window origins -> (J, R, k) dists + ids: every job
+    of one shape class in ONE device program (one tunnel round trip)."""
+
+    def one(args):
+        ids, cs = args
+        return _knn_window_scan(
+            ids, data, valid, cs, k, metric, row_tile, col_tile, n_win_tiles
+        )
+
+    return jax.lax.map(one, (row_ids_b, col_starts))
+
+
+@partial(
+    jax.jit, static_argnames=("metric", "row_tile", "col_tile", "n_win_tiles")
+)
+def _min_out_window_scan_batched(
+    row_ids_b, data, core, comp, valid, col_starts, metric: str, row_tile: int,
+    col_tile: int, n_win_tiles: int,
+):
+    """Batched :func:`_min_out_window_scan` — one program per shape class."""
+
+    def one(args):
+        ids, cs = args
+        return _min_out_window_scan(
+            ids, data, core, comp, valid, cs, metric, row_tile, col_tile,
+            n_win_tiles,
+        )
+
+    return jax.lax.map(one, (row_ids_b, col_starts))
 
 
 def _merge_knn(
@@ -341,36 +422,37 @@ def knn_rows_blockpruned(
 
     best_d = np.full((m, k), np.inf, np.float64)
     best_i = np.full((m, k), -1, np.int64)
-    # Jobs address rows by sorted-space index (device-side gather).
+    # Jobs address rows by sorted-space index (device-side gather), batched
+    # per shape class so the dispatch count is ~#classes, not #windows.
     rows_sorted_pos = np.asarray(geom.inv_perm[row_ids], np.int32)
 
     from hdbscan_tpu.ops.tiled import _drain_window
 
     def dispatches():
-        for col_start, ridx in jobs:
-            r_pad = max(row_tile, 1 << int(len(ridx) - 1).bit_length())
-            ids = np.zeros(r_pad, np.int32)
-            ids[: len(ridx)] = rows_sorted_pos[ridx]
-            out = _knn_window_scan(
+        for ridx_list, ids, starts in _batched_window_jobs(
+            geom, jobs, lambda r: rows_sorted_pos[r], row_tile
+        ):
+            out = _knn_window_scan_batched(
                 jnp.asarray(ids),
                 geom.data_sorted,
                 geom.valid_sorted,
-                jnp.int32(col_start),
+                jnp.asarray(starts),
                 k,
                 geom.metric,
                 row_tile,
                 geom.col_tile,
                 geom.win_tiles,
             )
-            yield ridx, out
+            yield ridx_list, out
 
     fetched = _drain_window((d for d in dispatches()))
-    for ridx, (jd, ji) in fetched:
-        jd = np.asarray(jd, np.float64)[: len(ridx)]
-        ji = np.asarray(ji, np.int64)[: len(ridx)]
-        best_d[ridx], best_i[ridx] = _merge_knn(
-            best_d[ridx], best_i[ridx], jd, ji
-        )
+    for ridx_list, (jd_b, ji_b) in fetched:
+        jd_b = np.asarray(jd_b, np.float64)
+        ji_b = np.asarray(ji_b, np.int64)
+        for i, ridx in enumerate(ridx_list):
+            best_d[ridx], best_i[ridx] = _merge_knn(
+                best_d[ridx], best_i[ridx], jd_b[i, : len(ridx)], ji_b[i, : len(ridx)]
+            )
 
     core = best_d[:, min(k, geom.n) - 1].copy() if min_pts > 1 else np.zeros(m)
     if return_neighbors:
@@ -454,7 +536,7 @@ def boruvka_glue_edges_blockpruned(
     col_tile: int = 8192,
     row_tile: int = 256,
     max_rounds: int = 64,
-    dense_pair_frac: float = 0.35,
+    dense_work_ratio: float = 0.7,
     init_comp: np.ndarray | None = None,
     geom: BlockGeometry | None = None,
     mesh=None,
@@ -480,8 +562,9 @@ def boruvka_glue_edges_blockpruned(
        minimum of (k-NN candidate, window results) feeds the shared
        vectorized contraction (``utils.unionfind.contract_min_edges``).
 
-    If the surviving pair count exceeds ``dense_pair_frac`` of m·G, the round
-    falls back to the dense scan (same result, better schedule).
+    A round whose windowed work (pairs x window columns) would exceed
+    ``dense_work_ratio`` of the dense scan's (m x n_pad columns) falls back
+    to the dense scan — same result, better schedule at that density.
 
     ``init_comp`` decouples the INITIAL components from the geometry blocks
     (the refinement pass starts from leaf clusters, whose spreads are useless
@@ -627,7 +710,13 @@ def boruvka_glue_edges_blockpruned(
         bestB_w = np.full(m, np.inf, np.float64)
         bestB_j = np.full(m, -1, np.int64)
         if n_pairs:
-            if n_pairs > dense_pair_frac * m * g:
+            # Work-based fallback: the windowed path costs ~pairs * window
+            # columns, the dense scan ~m * n_pad columns. Compare WORK, not
+            # pair fraction — at 8M a 0.19 pair fraction made the windowed
+            # path 1.3x the dense cost (measured: a 236M-pair round).
+            win_work = n_pairs * geom.win_tiles * geom.col_tile
+            dense_work = m * geom.n_pad
+            if win_work > dense_work_ratio * dense_work:
                 # Dense round: same result, better schedule at this density.
                 if _dense_scanner[0] is None:
                     from hdbscan_tpu.ops.tiled import BoruvkaScanner
@@ -645,34 +734,38 @@ def boruvka_glue_edges_blockpruned(
                 comp_sorted = jax.device_put(comp_pad)
 
                 def dispatches():
-                    for col_start, ridx in jobs:
-                        r_pad = max(
-                            row_tile, 1 << int(len(ridx) - 1).bit_length()
-                        )
-                        ids = np.zeros(r_pad, np.int32)
-                        ids[: len(ridx)] = geom.inv_perm[ridx]
-                        out = _min_out_window_scan(
+                    for ridx_list, ids, starts in _batched_window_jobs(
+                        geom, jobs, lambda r: geom.inv_perm[r], row_tile
+                    ):
+                        out = _min_out_window_scan_batched(
                             jnp.asarray(ids),
                             geom.data_sorted,
                             core_sorted,
                             comp_sorted,
                             geom.valid_sorted,
-                            jnp.int32(col_start),
+                            jnp.asarray(starts),
                             metric,
                             row_tile,
                             geom.col_tile,
                             geom.win_tiles,
                         )
-                        yield ridx, out
+                        yield ridx_list, out
 
-                for ridx, (jw, jj) in _drain_window((x for x in dispatches())):
-                    jw = np.asarray(jw, np.float64)[: len(ridx)]
-                    jj = np.asarray(jj, np.int64)[: len(ridx)]
-                    valid_j = jj >= 0
-                    jg = np.where(valid_j, geom.perm[np.maximum(jj, 0)], -1)
-                    upd = jw < bestB_w[ridx]
-                    bestB_w[ridx] = np.where(upd, jw, bestB_w[ridx])
-                    bestB_j[ridx] = np.where(upd & valid_j, jg, bestB_j[ridx])
+                for ridx_list, (jw_b, jj_b) in _drain_window(
+                    (x for x in dispatches())
+                ):
+                    jw_b = np.asarray(jw_b, np.float64)
+                    jj_b = np.asarray(jj_b, np.int64)
+                    for i, ridx in enumerate(ridx_list):
+                        jw = jw_b[i, : len(ridx)]
+                        jj = jj_b[i, : len(ridx)]
+                        valid_j = jj >= 0
+                        jg = np.where(valid_j, geom.perm[np.maximum(jj, 0)], -1)
+                        upd = jw < bestB_w[ridx]
+                        bestB_w[ridx] = np.where(upd, jw, bestB_w[ridx])
+                        bestB_j[ridx] = np.where(
+                            upd & valid_j, jg, bestB_j[ridx]
+                        )
 
         take_b = bestB_w < bestA_w
         best_w = np.where(take_b, bestB_w, bestA_w)
