@@ -237,7 +237,6 @@ _BATCH_SLOT_BUDGET = 1 << 21
 
 
 def _batched_window_jobs(
-    geom: BlockGeometry,
     jobs: list[tuple[int, np.ndarray]],
     to_sorted_pos,
     min_rows: int,
@@ -248,7 +247,9 @@ def _batched_window_jobs(
     row counts) — measured dominating the 8M boundary rescan (516 windows,
     2167 s). Jobs whose padded row count shares a pow2 class stack into a
     (J, r_pad) id matrix + (J,) col_starts and run as ONE ``lax.map``
-    program; J splits so J * r_pad stays under ``_BATCH_SLOT_BUDGET``.
+    program. J is kept under ``_BATCH_SLOT_BUDGET`` / r_pad and each group
+    emits in DESCENDING pow2 sub-batches (5 jobs -> 4 + 1) so compile
+    classes stay pow2 without pad slots executing wasted window scans.
 
     ``to_sorted_pos``: maps a job's row-idx array to sorted-space device
     indices. Yields (ridx_list, ids (J, r_pad) int32, col_starts (J,)).
@@ -259,11 +260,14 @@ def _batched_window_jobs(
         by_class.setdefault(r_pad, []).append((col_start, ridx))
     for r_pad, group in sorted(by_class.items()):
         j_cap = max(1, _BATCH_SLOT_BUDGET // r_pad)
-        for lo in range(0, len(group), j_cap):
-            part = group[lo : lo + j_cap]
-            j_pad = 1 << max(0, (len(part) - 1).bit_length())
-            ids = np.zeros((j_pad, r_pad), np.int32)
-            starts = np.zeros(j_pad, np.int32)
+        lo = 0
+        while lo < len(group):
+            take = min(j_cap, len(group) - lo)
+            take = 1 << (take.bit_length() - 1)  # pow2 floor, no pad slots
+            part = group[lo : lo + take]
+            lo += take
+            ids = np.zeros((take, r_pad), np.int32)
+            starts = np.zeros(take, np.int32)
             ridx_list = []
             for i, (col_start, ridx) in enumerate(part):
                 ids[i, : len(ridx)] = to_sorted_pos(ridx)
@@ -430,7 +434,7 @@ def knn_rows_blockpruned(
 
     def dispatches():
         for ridx_list, ids, starts in _batched_window_jobs(
-            geom, jobs, lambda r: rows_sorted_pos[r], row_tile
+            jobs, lambda r: rows_sorted_pos[r], row_tile
         ):
             out = _knn_window_scan_batched(
                 jnp.asarray(ids),
@@ -735,7 +739,7 @@ def boruvka_glue_edges_blockpruned(
 
                 def dispatches():
                     for ridx_list, ids, starts in _batched_window_jobs(
-                        geom, jobs, lambda r: geom.inv_perm[r], row_tile
+                        jobs, lambda r: geom.inv_perm[r], row_tile
                     ):
                         out = _min_out_window_scan_batched(
                             jnp.asarray(ids),
