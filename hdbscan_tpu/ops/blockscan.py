@@ -230,83 +230,101 @@ def _window_jobs(
     return jobs
 
 
-#: Per-dispatch element budget for batched window scans: bounds one
-#: program's padded row-slot count (J * r_pad) so device runtime and output
-#: transfer stay tunnel-friendly while dispatch count stays ~#shape-classes.
+#: Per-dispatch row-slot budget for tiled window scans: bounds one program's
+#: (tiles * row_tile) so device runtime and output transfer stay
+#: tunnel-friendly. Compiled-shape count is ~log2 of the pow2 chunk
+#: lengths (<= ~13 per dataset); DISPATCH count is ~ceil(total_tiles /
+#: max_chunk) plus a log2 tail — budget tuning trades round trips against
+#: per-program size.
 _BATCH_SLOT_BUDGET = 1 << 21
 
 
-def _batched_window_jobs(
+def _tiled_window_jobs(
     jobs: list[tuple[int, np.ndarray]],
     to_sorted_pos,
-    min_rows: int,
+    row_tile: int,
 ):
-    """Pack window jobs into per-shape-class batches for single dispatches.
+    """Flatten window jobs to ROW-TILE granularity for batched dispatch.
 
-    Per-window dispatches pay one tunnel round trip EACH (~1-3 s at large
-    row counts) — measured dominating the 8M boundary rescan (516 windows,
-    2167 s). Jobs whose padded row count shares a pow2 class stack into a
-    (J, r_pad) id matrix + (J,) col_starts and run as ONE ``lax.map``
-    program. J is kept under ``_BATCH_SLOT_BUDGET`` / r_pad and each group
-    emits in DESCENDING pow2 sub-batches (5 jobs -> 4 + 1) so compile
-    classes stay pow2 without pad slots executing wasted window scans.
+    Two earlier schedules both lost: per-window dispatches pay one tunnel
+    round trip each (516 windows cost 2167 s at 8M), and per-(J, r_pad)
+    batches pay one XLA compile per shape combination (~20-40 s x dozens of
+    combos — measured 648 s at 4M). Flattening removes both axes: every job
+    becomes ceil(rows / row_tile) tiles with a per-TILE window origin, and
+    dispatches are descending-pow2 chunks of the global tile list — the
+    pow2 chunk length is the ONLY compiled axis, with no wasted pad scans
+    beyond the final partial tile of each job. Chunk arrays are assembled
+    LAZILY (one chunk in flight at a time), so host memory stays at the
+    per-chunk budget regardless of the round's total tile count.
 
-    ``to_sorted_pos``: maps a job's row-idx array to sorted-space device
-    indices. Yields (ridx_list, ids (J, r_pad) int32, col_starts (J,)).
+    Yields (metas, ids (T, row_tile) int32, col_starts (T,)) where metas is
+    [(ridx_slice, tile_lo, n_tiles), ...] mapping each job's rows back to
+    its contiguous tile span within this chunk. A job whose tile span
+    crosses a chunk boundary is split across yields — its per-chunk row
+    slices are disjoint, so callers' per-row merges stay correct.
     """
-    by_class: dict[int, list[tuple[int, np.ndarray]]] = {}
+    metas = []  # (ridx, global tile offset, n_tiles)
+    t_total = 0
     for col_start, ridx in jobs:
-        r_pad = max(min_rows, 1 << int(len(ridx) - 1).bit_length())
-        by_class.setdefault(r_pad, []).append((col_start, ridx))
-    for r_pad, group in sorted(by_class.items()):
-        j_cap = max(1, _BATCH_SLOT_BUDGET // r_pad)
-        lo = 0
-        while lo < len(group):
-            take = min(j_cap, len(group) - lo)
-            take = 1 << (take.bit_length() - 1)  # pow2 floor, no pad slots
-            part = group[lo : lo + take]
-            lo += take
-            ids = np.zeros((take, r_pad), np.int32)
-            starts = np.zeros(take, np.int32)
-            ridx_list = []
-            for i, (col_start, ridx) in enumerate(part):
-                ids[i, : len(ridx)] = to_sorted_pos(ridx)
-                starts[i] = col_start
-                ridx_list.append(ridx)
-            yield ridx_list, ids, starts
+        t = -(-len(ridx) // row_tile)
+        metas.append((col_start, ridx, t_total, t))
+        t_total += t
+    max_chunk = max(1, _BATCH_SLOT_BUDGET // row_tile)
+    lo = 0
+    mi = 0  # metas index; consumed in order (jobs laid out consecutively)
+    while lo < t_total:
+        take = min(max_chunk, t_total - lo)
+        take = 1 << (take.bit_length() - 1)  # pow2 floor: no pad tiles
+        ids = np.zeros((take, row_tile), np.int32)
+        starts = np.zeros(take, np.int32)
+        chunk_metas = []
+        while mi < len(metas):
+            col_start, ridx, t_lo, t_n = metas[mi]
+            if t_lo >= lo + take:
+                break
+            # Portion of this job's tile span inside [lo, lo + take).
+            a = max(t_lo, lo)
+            b = min(t_lo + t_n, lo + take)
+            row_a = (a - t_lo) * row_tile
+            row_b = min((b - t_lo) * row_tile, len(ridx))
+            if row_b > row_a:
+                seg = to_sorted_pos(ridx[row_a:row_b])
+                flat = ids[a - lo : b - lo].reshape(-1)
+                flat[: len(seg)] = seg
+                starts[a - lo : b - lo] = col_start
+                chunk_metas.append((ridx[row_a:row_b], a - lo, b - a))
+            if t_lo + t_n <= lo + take:
+                mi += 1
+            else:
+                break
+        yield chunk_metas, ids, starts
+        lo += take
 
 
 @partial(
     jax.jit,
-    static_argnames=("k", "metric", "row_tile", "col_tile", "n_win_tiles"),
+    static_argnames=("k", "metric", "col_tile", "n_win_tiles"),
 )
-def _knn_window_scan(
-    row_ids, data, valid, col_start, k: int, metric: str, row_tile: int,
-    col_tile: int, n_win_tiles: int,
+def _knn_window_scan_tiled(
+    ids, data, valid, col_starts, k: int, metric: str, col_tile: int,
+    n_win_tiles: int,
 ):
-    """k smallest distances (+ sorted-space ids) of the rows ``row_ids`` of
-    ``data`` against the window ``[col_start, col_start + n_win_tiles *
-    col_tile)`` of the same array.
+    """(T, row_tile) ids + (T,) per-tile window origins -> (T, row_tile, k).
 
-    Same tile discipline as ``ops.tiled._knn_core_scan`` — fori over column
-    tiles, top_k merge — but over a fixed-width window at a dynamic origin:
-    the static shape axis is (row_tile, col_tile, n_win_tiles), so every job
-    of one row-count class shares a compile regardless of which blocks it
-    scans. Rows arrive as (R,) int32 SORTED-SPACE indices and gather on
-    device — uploading coordinates per job cost 10x the bytes (measured
-    dominating the 4M boundary rescan). Pad rows produce garbage; callers
-    slice.
+    One ``lax.map`` over row tiles, each gathering its rows on device and
+    scanning ITS OWN fixed-width window: the pow2 tile count T is the only
+    compiled axis, so a whole rescan compiles ~log2(T) programs total.
     """
-    n_rows = row_ids.shape[0]
-    rows = jnp.take(data, row_ids, axis=0)
     inf = jnp.array(jnp.inf, data.dtype)
+    row_tile = ids.shape[1]
 
-    def row_step(r):
-        xr = jax.lax.dynamic_slice_in_dim(rows, r * row_tile, row_tile)
+    def one(args):
+        tids, cs = args
+        xr = jnp.take(data, tids, axis=0)
 
         def col_step(c, carry):
             best, bidx = carry
-            base = col_start + c * col_tile
+            base = cs + c * col_tile
             xc = jax.lax.dynamic_slice_in_dim(data, base, col_tile)
             vc = jax.lax.dynamic_slice_in_dim(valid, base, col_tile)
             dmat = pairwise_distance(xr, xc, metric)
@@ -326,47 +344,47 @@ def _knn_window_scan(
         best, bidx = jax.lax.fori_loop(0, n_win_tiles, col_step, init)
         return -best, bidx
 
-    out, out_i = jax.lax.map(row_step, jnp.arange(n_rows // row_tile))
-    return out.reshape(n_rows, k), out_i.reshape(n_rows, k)
+    return jax.lax.map(one, (ids, col_starts))
 
 
-@partial(
-    jax.jit,
-    static_argnames=("k", "metric", "row_tile", "col_tile", "n_win_tiles"),
-)
-def _knn_window_scan_batched(
-    row_ids_b, data, valid, col_starts, k: int, metric: str, row_tile: int,
-    col_tile: int, n_win_tiles: int,
+@partial(jax.jit, static_argnames=("metric", "col_tile", "n_win_tiles"))
+def _min_out_window_scan_tiled(
+    ids, data, core, comp, valid, col_starts, metric: str, col_tile: int,
+    n_win_tiles: int,
 ):
-    """(J, R) ids + (J,) window origins -> (J, R, k) dists + ids: every job
-    of one shape class in ONE device program (one tunnel round trip)."""
+    """Tile-granular :func:`_min_out_window_scan`: (T, row_tile) ids +
+    (T,) origins -> ((T, row_tile) best_w, (T, row_tile) best_j)."""
+    inf = jnp.array(jnp.inf, data.dtype)
 
     def one(args):
-        ids, cs = args
-        return _knn_window_scan(
-            ids, data, valid, cs, k, metric, row_tile, col_tile, n_win_tiles
+        tids, cs = args
+        x = jnp.take(data, tids, axis=0)
+        c = jnp.take(core, tids)
+        kk = jnp.take(comp, tids)
+
+        def col_step(t, carry):
+            bw, bj = carry
+            base = cs + t * col_tile
+            xc = jax.lax.dynamic_slice_in_dim(data, base, col_tile)
+            cc = jax.lax.dynamic_slice_in_dim(core, base, col_tile)
+            kc = jax.lax.dynamic_slice_in_dim(comp, base, col_tile)
+            vc = jax.lax.dynamic_slice_in_dim(valid, base, col_tile)
+            dmat = pairwise_distance(x, xc, metric)
+            w = jnp.maximum(dmat, jnp.maximum(c[:, None], cc[None, :]))
+            out = (kk[:, None] != kc[None, :]) & vc[None, :]
+            w = jnp.where(out, w, inf)
+            tw = jnp.min(w, axis=1)
+            tj = jnp.argmin(w, axis=1).astype(jnp.int32) + base
+            upd = tw < bw
+            return jnp.where(upd, tw, bw), jnp.where(upd, tj, bj)
+
+        init = (
+            jnp.full((x.shape[0],), jnp.inf, data.dtype),
+            jnp.full((x.shape[0],), -1, jnp.int32),
         )
+        return jax.lax.fori_loop(0, n_win_tiles, col_step, init)
 
-    return jax.lax.map(one, (row_ids_b, col_starts))
-
-
-@partial(
-    jax.jit, static_argnames=("metric", "row_tile", "col_tile", "n_win_tiles")
-)
-def _min_out_window_scan_batched(
-    row_ids_b, data, core, comp, valid, col_starts, metric: str, row_tile: int,
-    col_tile: int, n_win_tiles: int,
-):
-    """Batched :func:`_min_out_window_scan` — one program per shape class."""
-
-    def one(args):
-        ids, cs = args
-        return _min_out_window_scan(
-            ids, data, core, comp, valid, cs, metric, row_tile, col_tile,
-            n_win_tiles,
-        )
-
-    return jax.lax.map(one, (row_ids_b, col_starts))
+    return jax.lax.map(one, (ids, col_starts))
 
 
 def _merge_knn(
@@ -426,36 +444,38 @@ def knn_rows_blockpruned(
 
     best_d = np.full((m, k), np.inf, np.float64)
     best_i = np.full((m, k), -1, np.int64)
-    # Jobs address rows by sorted-space index (device-side gather), batched
-    # per shape class so the dispatch count is ~#classes, not #windows.
+    # Jobs address rows by sorted-space index (device-side gather),
+    # flattened to row tiles and dispatched in descending-pow2 tile chunks
+    # (_tiled_window_jobs — one compiled shape per chunk length).
     rows_sorted_pos = np.asarray(geom.inv_perm[row_ids], np.int32)
 
     from hdbscan_tpu.ops.tiled import _drain_window
 
     def dispatches():
-        for ridx_list, ids, starts in _batched_window_jobs(
+        for metas, ids, starts in _tiled_window_jobs(
             jobs, lambda r: rows_sorted_pos[r], row_tile
         ):
-            out = _knn_window_scan_batched(
+            out = _knn_window_scan_tiled(
                 jnp.asarray(ids),
                 geom.data_sorted,
                 geom.valid_sorted,
                 jnp.asarray(starts),
                 k,
                 geom.metric,
-                row_tile,
                 geom.col_tile,
                 geom.win_tiles,
             )
-            yield ridx_list, out
+            yield metas, out
 
     fetched = _drain_window((d for d in dispatches()))
-    for ridx_list, (jd_b, ji_b) in fetched:
+    for metas, (jd_b, ji_b) in fetched:
         jd_b = np.asarray(jd_b, np.float64)
         ji_b = np.asarray(ji_b, np.int64)
-        for i, ridx in enumerate(ridx_list):
+        for ridx, t_lo, t_n in metas:
+            jd = jd_b[t_lo : t_lo + t_n].reshape(-1, k)[: len(ridx)]
+            ji = ji_b[t_lo : t_lo + t_n].reshape(-1, k)[: len(ridx)]
             best_d[ridx], best_i[ridx] = _merge_knn(
-                best_d[ridx], best_i[ridx], jd_b[i, : len(ridx)], ji_b[i, : len(ridx)]
+                best_d[ridx], best_i[ridx], jd, ji
             )
 
     core = best_d[:, min(k, geom.n) - 1].copy() if min_pts > 1 else np.zeros(m)
@@ -468,60 +488,6 @@ def knn_rows_blockpruned(
 # --------------------------------------------------------------------------
 # Windowed exact Borůvka glue
 # --------------------------------------------------------------------------
-
-
-@partial(
-    jax.jit, static_argnames=("metric", "row_tile", "col_tile", "n_win_tiles")
-)
-def _min_out_window_scan(
-    row_ids, data, core, comp, valid, col_start, metric: str, row_tile: int,
-    col_tile: int, n_win_tiles: int,
-):
-    """Min outgoing mutual-reachability edge per row against one window.
-
-    Windowed twin of ``ops.tiled._min_out_row_block``: MRD weights, the
-    other-component mask, smallest-column tie-break — columns restricted to
-    ``[col_start, col_start + n_win_tiles * col_tile)`` of the block-sorted
-    arrays. Rows arrive as (R,) int32 sorted-space indices; coordinates,
-    cores, and component labels all gather on device from the resident
-    sorted arrays (per-job uploads shrink to 4 bytes/row). Returns
-    ((R,) best_w, (R,) best_j sorted-space, -1/inf if none).
-    """
-    n_rows = row_ids.shape[0]
-    xr_all = jnp.take(data, row_ids, axis=0)
-    cr_all = jnp.take(core, row_ids)
-    kr_all = jnp.take(comp, row_ids)
-    inf = jnp.array(jnp.inf, data.dtype)
-
-    def row_step(r):
-        x = jax.lax.dynamic_slice_in_dim(xr_all, r * row_tile, row_tile)
-        c = jax.lax.dynamic_slice_in_dim(cr_all, r * row_tile, row_tile)
-        kk = jax.lax.dynamic_slice_in_dim(kr_all, r * row_tile, row_tile)
-
-        def col_step(t, carry):
-            bw, bj = carry
-            base = col_start + t * col_tile
-            xc = jax.lax.dynamic_slice_in_dim(data, base, col_tile)
-            cc = jax.lax.dynamic_slice_in_dim(core, base, col_tile)
-            kc = jax.lax.dynamic_slice_in_dim(comp, base, col_tile)
-            vc = jax.lax.dynamic_slice_in_dim(valid, base, col_tile)
-            dmat = pairwise_distance(x, xc, metric)
-            w = jnp.maximum(dmat, jnp.maximum(c[:, None], cc[None, :]))
-            out = (kk[:, None] != kc[None, :]) & vc[None, :]
-            w = jnp.where(out, w, inf)
-            tw = jnp.min(w, axis=1)
-            tj = jnp.argmin(w, axis=1).astype(jnp.int32) + base
-            upd = tw < bw
-            return jnp.where(upd, tw, bw), jnp.where(upd, tj, bj)
-
-        init = (
-            jnp.full((row_tile,), jnp.inf, data.dtype),
-            jnp.full((row_tile,), -1, jnp.int32),
-        )
-        return jax.lax.fori_loop(0, n_win_tiles, col_step, init)
-
-    bw, bj = jax.lax.map(row_step, jnp.arange(n_rows // row_tile))
-    return bw.reshape(n_rows), bj.reshape(n_rows)
 
 
 def _segment_min(values: np.ndarray, segments: np.ndarray, n_seg: int) -> np.ndarray:
@@ -738,10 +704,10 @@ def boruvka_glue_edges_blockpruned(
                 comp_sorted = jax.device_put(comp_pad)
 
                 def dispatches():
-                    for ridx_list, ids, starts in _batched_window_jobs(
+                    for metas, ids, starts in _tiled_window_jobs(
                         jobs, lambda r: geom.inv_perm[r], row_tile
                     ):
-                        out = _min_out_window_scan_batched(
+                        out = _min_out_window_scan_tiled(
                             jnp.asarray(ids),
                             geom.data_sorted,
                             core_sorted,
@@ -749,20 +715,19 @@ def boruvka_glue_edges_blockpruned(
                             geom.valid_sorted,
                             jnp.asarray(starts),
                             metric,
-                            row_tile,
                             geom.col_tile,
                             geom.win_tiles,
                         )
-                        yield ridx_list, out
+                        yield metas, out
 
-                for ridx_list, (jw_b, jj_b) in _drain_window(
+                for metas, (jw_b, jj_b) in _drain_window(
                     (x for x in dispatches())
                 ):
                     jw_b = np.asarray(jw_b, np.float64)
                     jj_b = np.asarray(jj_b, np.int64)
-                    for i, ridx in enumerate(ridx_list):
-                        jw = jw_b[i, : len(ridx)]
-                        jj = jj_b[i, : len(ridx)]
+                    for ridx, t_lo, t_n in metas:
+                        jw = jw_b[t_lo : t_lo + t_n].reshape(-1)[: len(ridx)]
+                        jj = jj_b[t_lo : t_lo + t_n].reshape(-1)[: len(ridx)]
                         valid_j = jj >= 0
                         jg = np.where(valid_j, geom.perm[np.maximum(jj, 0)], -1)
                         upd = jw < bestB_w[ridx]
