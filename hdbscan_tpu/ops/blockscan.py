@@ -19,8 +19,9 @@ intersects — its own and the seam neighbors — instead of the whole dataset.
 TPU shape discipline (the round-1 tile-pruning lessons, ROADMAP "Remaining
 options" #2): no per-row control flow on device. The host computes candidate
 (row, block) pairs from f64 bounds, coalesces them into fixed-width column
-WINDOWS on a block-sorted device copy (every job = pow2 rows x W·col_tile
-columns — a handful of compiled shapes), and merges per-row results. Columns
+WINDOWS on a block-sorted device copy, flattens the work to row-tile
+granularity (each tile carries its own window origin; descending-pow2 tile
+chunks are the only compiled axis), and merges per-row results. Columns
 inside a window that belong to other blocks are scanned anyway: scanning a
 SUPERSET of the candidate set is free correctness (extra true distances can
 never displace the k nearest), and it is what keeps the schedule static.
