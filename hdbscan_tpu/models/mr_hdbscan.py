@@ -843,14 +843,23 @@ def _fit_rows(
         # fraction per block (the selection's floor term). With forced
         # splits cutting through dense interiors the at-risk set reaches
         # ~90% of n, but the edge-hosting set stays at the configured q.
-        bset, bset_glue_sel = _select_boundary(
+        # Without block pruning (cosine/pearson, or block_pruning=false) the
+        # glue/refine rounds keep the FULL boundary set, as before round 3:
+        # the reduced glue subset's alpha/factor trade-off was measured only
+        # on euclidean synthetics, and the full-sweep scans those metrics
+        # take don't benefit from a smaller row set the way the windowed path
+        # does (ADVICE r3). return_floor=pruned keeps the glue-floor
+        # computation — and its force-union of deep-crossing extras into the
+        # selection — off that path entirely.
+        sel = _select_boundary(
             bmargin,
             final_block,
             boundary_q,
             core=core,
             max_frac=0.9 if pruned else _BOUNDARY_MAX_FRAC,
-            return_floor=True,
+            return_floor=pruned,
         )
+        bset, bset_glue_sel = sel if pruned else (sel, sel)
         if trace is not None:
             trace(
                 "boundary_select",

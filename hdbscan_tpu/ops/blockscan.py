@@ -219,6 +219,10 @@ def _window_jobs(
     deduplicated per window. Returns [(col_start, row_idx_array), ...] sorted
     by window for deterministic dispatch order.
     """
+    if len(pair_rows) == 0:
+        # np.split of an empty array yields one empty segment whose seg_w[0]
+        # would IndexError (ADVICE r3) — no pairs means no jobs.
+        return []
     ws = geom.win_start[pair_blocks]
     order = np.lexsort((pair_rows, ws))
     ws, rs = ws[order], pair_rows[order]
@@ -612,6 +616,15 @@ def boruvka_glue_edges_blockpruned(
             dc_cache[lo : lo + chunk] = _chunked_centroid_distances(
                 rows_all[lo : lo + chunk], geom.centroid, metric
             )
+    # f32 rounding of the cached centroid distances is ABSOLUTE error
+    # ~6e-8·dc — when block geometry is orders of magnitude larger than the
+    # seam edge weight (upper ≲ 1e-6·dc, plausible at multi-M rows with
+    # tight seams) it exceeds the relative slack on ``upper`` and could
+    # wrongly prune the pair holding a component's true minimum edge (and
+    # deflate the ub2 tightening in the unsafe direction). Compensate with a
+    # distance-proportional slack wherever a cached dc enters a bound
+    # (ADVICE r3): widen lb downward, ub2 upward, by dc·1e-6 (>> f32 eps/2).
+    _dc_rtol = 1e-6 if dc_cache is not None else 0.0
 
     def _dc(sl: slice) -> np.ndarray:
         if dc_cache is not None:
@@ -657,7 +670,7 @@ def boruvka_glue_edges_blockpruned(
             dcc = _dc(r)
             foreign_c = block_comp[None, :] != cidx[r, None]
             ub2 = np.maximum(
-                dcc + geom.radius[None, :],
+                dcc * (1 + _dc_rtol) + geom.radius[None, :],
                 np.maximum(core[r, None], maxcore_b[None, :]),
             )
             ub2 = np.where(foreign_c, ub2, np.inf)
@@ -668,7 +681,7 @@ def boruvka_glue_edges_blockpruned(
             dcc = _dc(r)
             foreign_c = block_comp[None, :] != cidx[r, None]
             lb = np.maximum(
-                dcc - geom.radius[None, :],
+                dcc * (1 - _dc_rtol) - geom.radius[None, :],
                 np.maximum(core[r, None], mincore_b[None, :]),
             )
             keep = foreign_c & (lb <= slack(upper[cidx[r]])[:, None])

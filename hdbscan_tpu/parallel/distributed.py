@@ -138,6 +138,22 @@ def initialize_from_cluster_name(cluster_name: str) -> bool:
         )
     if cluster_name == "auto":
         jax.distributed.initialize()
+        if not already_initialized():
+            # The auto path has no requested process count to post-check
+            # against, so the only backstop is the client probe itself:
+            # immediately after a successful initialize it MUST see the
+            # client. If it doesn't, either initialize silently no-opped
+            # (backend touched first) or the private-API probe drifted on a
+            # JAX upgrade — both deserve a loud stop, not a single-process
+            # run racing its peers (ADVICE r3; the probe symbols are pinned
+            # by tests/unit/test_distributed.py against the vendored JAX).
+            raise RuntimeError(
+                "jax.distributed.initialize() returned but the distributed "
+                "client is not observable: either a JAX backend initialized "
+                "before distributed wiring (silent no-op) or the "
+                "already_initialized() probe no longer matches this JAX "
+                "version. Refusing to continue as an unwired process."
+            )
         return True
     # Init's own errors (bad ranks, unreachable coordinator) surface as
     # themselves, not as a format complaint.

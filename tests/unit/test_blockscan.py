@@ -110,6 +110,18 @@ class TestPrunedCoreScan:
         with pytest.raises(ValueError, match="triangle"):
             BlockGeometry.build(pts, np.zeros(100, np.int64), metric="cosine")
 
+    def test_window_jobs_empty_pairs(self, rng):
+        """No candidate pairs -> no jobs (ADVICE r3: the empty np.split
+        segment used to IndexError)."""
+        from hdbscan_tpu.ops.blockscan import _window_jobs
+
+        pts = rng.normal(size=(100, 3))
+        geom = BlockGeometry.build(pts, np.arange(100) // 50, col_tile=128)
+        assert (
+            _window_jobs(geom, np.zeros(0, np.int64), np.zeros(0, np.int64))
+            == []
+        )
+
 
 class TestPrunedGlue:
     def _knn_graph(self, pts, block_of, core, min_pts):

@@ -44,6 +44,20 @@ class TestClusterNameWiring:
         with pytest.raises(ValueError, match="clusterName"):
             initialize_from_cluster_name("not-a-spec-without-commas,x")
 
+    def test_private_probe_symbols_exist(self):
+        """Pin the jax._src internals the idempotence/silent-no-op probes
+        read (ADVICE r3): if a JAX upgrade moves them, this fails LOUDLY in
+        CI instead of the probes silently reverting to their fail-safe
+        defaults (double-init errors reappear; no-op detection vanishes)."""
+        from jax._src import distributed as _dist
+        from jax._src import xla_bridge
+
+        # already_initialized() reads distributed.global_state.client.
+        assert hasattr(_dist, "global_state")
+        assert hasattr(_dist.global_state, "client")
+        # _backend_already_touched() reads xla_bridge._backends (a dict).
+        assert isinstance(xla_bridge._backends, dict)
+
 
 class TestGlobalAssembly:
     def test_row_sharded_assembly_on_mesh(self):
