@@ -7,7 +7,12 @@ Compares, on a Gauss-family synthetic (the paper's evaluation shape):
   fullq      — global cores + full glue + refine (round-1 default, O(n²) heavy)
 
 Emits one JSON line per run: {config, n, dims, sep, wall_s, ari_truth, ari_exact}.
-Usage: python benchmarks/boundary_eval.py [n] [separation] [modes_csv]
+Usage: python benchmarks/boundary_eval.py [n] [separation] [modes_csv] [key=value ...]
+
+Trailing key=value pairs are HDBSCANParams overrides applied to every
+non-exact mode (e.g. ``glue_factor=6 boundary_alpha=1.0``), parsed by the
+CLI flag vocabulary (config.HDBSCANParams.from_args) and echoed in the JSON
+record's ``overrides`` field.
 """
 
 from __future__ import annotations
@@ -37,6 +42,20 @@ def main() -> None:
     # quality differences are real tree differences.
     sep = float(sys.argv[2]) if len(sys.argv) > 2 else 7.0
     modes = (sys.argv[3] if len(sys.argv) > 3 else "exact,compat,bound05,fullq").split(",")
+    overrides = {}
+    if len(sys.argv) > 4:
+        # Keys come from argv, not a value-vs-default diff: an explicit
+        # override that happens to EQUAL a dataclass default must still
+        # override the script's base/config values.
+        from hdbscan_tpu.config import FLAG_FIELDS
+
+        parsed = HDBSCANParams.from_args(sys.argv[4:])
+        overrides = {
+            FLAG_FIELDS[a.partition("=")[0]][0]: getattr(
+                parsed, FLAG_FIELDS[a.partition("=")[0]][0]
+            )
+            for a in sys.argv[4:]
+        }
     dims, n_clusters = 10, 30
     # Dense per-block MST needs cap^2 x ~8 f32 temps in HBM: 16384 (~8.6 GB)
     # is the single-chip ceiling; 32768+ OOMs a v5e (15.75 GB).
@@ -65,20 +84,29 @@ def main() -> None:
     exact_labels = np.load(cache) if os.path.exists(cache) else None
     from hdbscan_tpu.utils.tracing import Tracer
 
+    from hdbscan_tpu.utils.flops import counter as flops_counter
+    from hdbscan_tpu.utils.flops import phase_stats
+
     for mode in modes:
         tracer = Tracer(stream=sys.stderr)  # per-stage walls for the record
+        fsnap = flops_counter.snapshot()
         t0 = time.time()
         if mode == "exact":
             r = exact.fit(data, HDBSCANParams(**base), trace=tracer)
             exact_labels = r.labels
             np.save(cache, exact_labels)
         else:
-            r = mr_hdbscan.fit(
-                data, HDBSCANParams(**base, **configs[mode]), trace=tracer
-            )
+            p = HDBSCANParams(**{**base, **configs[mode], **overrides})
+            if p.consensus_draws > 1:
+                from hdbscan_tpu.models import consensus
+
+                r = consensus.fit(data, p, trace=tracer)
+            else:
+                r = mr_hdbscan.fit(data, p, trace=tracer)
         wall = time.time() - t0
         rec = {
             "config": mode,
+            **({"overrides": overrides} if overrides else {}),
             "n": n,
             "dims": dims,
             "sep": sep,
@@ -86,6 +114,7 @@ def main() -> None:
             "processing_units": cap,
             "wall_s": round(wall, 2),
             "ari_truth": round(float(adjusted_rand_index(r.labels, y)), 4),
+            **phase_stats(fsnap, wall),
         }
         if exact_labels is not None and mode != "exact":
             rec["ari_exact"] = round(

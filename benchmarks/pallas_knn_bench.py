@@ -55,8 +55,16 @@ def main() -> None:
         if name == "skin":
             sets[name] = np.loadtxt(SKIN)[:, :3]
         elif name.startswith("gauss"):
-            n = int(name[5:].replace("k", "000").replace("m", "000000"))
-            sets[name], _ = make_gauss(n, dims=10, n_clusters=30, seed=0)
+            # gauss<N>[_d<D>]: e.g. gauss200k (d=10), gauss200k_d28,
+            # gauss500k_d90 — the d=28-90 legs cover the paper's
+            # HEPMASS/HIGGS/YearPrediction dimensionality class, where the
+            # round-2 lane-padding verdict against the MXU dot form inverts
+            # (K pads to 128 lanes: ~42x waste at d=3, ~1.4x at d=90 —
+            # VERDICT r3 item 7).
+            base, _, dpart = name.partition("_d")
+            n = int(base[5:].replace("k", "000").replace("m", "000000"))
+            dims = int(dpart) if dpart else 10
+            sets[name], _ = make_gauss(n, dims=dims, n_clusters=30, seed=0)
         else:
             raise SystemExit(f"unknown dataset {name}")
 
@@ -69,6 +77,11 @@ def main() -> None:
             )[0],
             "pallas_diag": lambda d=data: knn_core_distances_pallas(
                 d, mp, order="diag"
+            )[0],
+            # The MXU dot form: lane padding wastes ~42x at d=3 but only
+            # ~1.4x at d=90 — the high-d legs are where it could win.
+            "pallas_dot": lambda d=data: knn_core_distances_pallas(
+                d, mp, order="diag", form="dot"
             )[0],
         }
         cores = {}
@@ -90,6 +103,15 @@ def main() -> None:
         for leg in ("pallas_scan", "pallas_diag"):
             err = float(np.abs(cores[leg] - cores["xla_scan"]).max())
             assert err < 1e-4, f"{name} {leg} diverges from XLA by {err}"
+        # The dot form is approximate near duplicates (~eps·|x|² absolute,
+        # documented) — report its deviation instead of asserting.
+        err = float(np.abs(cores["pallas_dot"] - cores["xla_scan"]).max())
+        print(
+            json.dumps(
+                {"metric": f"knn_{name}_pallas_dot_max_err", "value": err}
+            ),
+            flush=True,
+        )
 
 
 if __name__ == "__main__":

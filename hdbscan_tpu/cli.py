@@ -7,7 +7,9 @@ Usage mirrors the reference's documented contract (``main/Main.java:534-614``)::
         [dist_function={euclidean,cosine,pearson,manhattan,supremum}] \
         [out_dir=DIR] [seed=N] [variant={db,rs}] [dedup={true,false}] \
         [exact_inter_edges={true,false}] [global_cores={true,false}] [refine=N] \
-        [boundary=F] [block_pruning={true,false}] [compat_cf={true,false}] \
+        [boundary=F] [boundary_alpha=F] [glue_alpha=F] [glue_factor=N] \
+        [glue_rows=N] [block_pruning={true,false}] [consensus=N] \
+        [compat_cf={true,false}] \
         [clusterName={local,auto,<host:port>,<pid>,<np>}]
 
 Unlike the reference, argv is actually honored (the reference shadows it with
@@ -80,6 +82,18 @@ def main(argv: list[str] | None = None) -> int:
                 file=sys.stderr,
             )
 
+    # Per-stage tracing: always collected so the end-of-run summary can show
+    # phase walls, selected fractions, and FLOP rates (the reference's only
+    # progress output is println of filenames — SURVEY.md §5.1). Set
+    # HDBSCAN_TPU_TRACE=1 to also live-stream logfmt lines to stderr.
+    import os
+
+    from hdbscan_tpu.utils.tracing import Tracer
+
+    tracer = Tracer(
+        stream=sys.stderr if os.environ.get("HDBSCAN_TPU_TRACE") else None
+    )
+
     fit_done = False
     try:
         data = load_points(params.input_file)
@@ -91,8 +105,13 @@ def main(argv: list[str] | None = None) -> int:
             # Single-block exact path: dense local compute (no mesh to shard).
             result = hdbscan.fit(data, params)
             mode = "exact"
+        elif params.consensus_draws > 1:
+            from hdbscan_tpu.models import consensus
+
+            result = consensus.fit(data, params, mesh=mesh, trace=tracer)
+            mode = f"mr-consensus ({params.consensus_draws} draws)"
         else:
-            result = mr_hdbscan.fit(data, params, mesh=mesh)
+            result = mr_hdbscan.fit(data, params, mesh=mesh, trace=tracer)
             mode = f"mr ({result.n_levels} levels)"
         wall = time.monotonic() - t0
         fit_done = True
@@ -115,6 +134,22 @@ def main(argv: list[str] | None = None) -> int:
                 )
             for kind, path in paths.items():
                 print(f"  {kind}: {path}")
+            # Boundary/refine phase summary (VERDICT r3 item 9): walls,
+            # selected fractions, and achieved FLOP rates without Python.
+            phase_names = (
+                "dedup",
+                "boundary_select",
+                "boundary_cores",
+                "boundary_reweight",
+                "boundary_phase",
+                "refine",
+                "consensus",
+            )
+            summary = [e for e in tracer.events if e.name in phase_names]
+            if summary:
+                print("phases:", file=sys.stderr)
+                for ev in summary:
+                    print(f"  {ev.format()}", file=sys.stderr)
     finally:
         if n_proc > 1 and fit_done:
             # Barrier before exit — in a finally so a rank that fails AFTER

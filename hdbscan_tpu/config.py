@@ -87,6 +87,45 @@ class HDBSCANParams:
     #: non-triangle-inequality metrics (cosine/pearson). Set False to force
     #: the full sweeps everywhere.
     boundary_block_pruning: bool = True
+    #: Boundary-mode at-risk criterion multiplier: a point joins the exact
+    #: core rescan when its seam margin <= boundary_alpha * per-block core
+    #: (margin upper-bounds the seam distance, the per-block core
+    #: upper-bounds the k-NN ball radius, so 1.0 captures every point whose
+    #: ball can cross a seam — the measured-correct default; see
+    #: models/mr_hdbscan._BOUNDARY_ALPHA provenance).
+    boundary_alpha: float = 1.0
+    #: Glue-set deep-crossing criterion: rows with margin <=
+    #: glue_alpha * core join the per-block lowest-margin floor as
+    #: candidate hosts of inter-block MST edges (the min-MRD pair is not
+    #: necessarily the geometrically closest pair). Measured at 1M sep-7:
+    #: floor alone drops vs-exact fidelity 0.95 -> 0.90.
+    glue_alpha: float = 0.5
+    #: Cap on the glue set as a multiple of the per-block floor set
+    #: (smallest margins kept first). Glue/refine round cost scales with
+    #: the SQUARE of this factor when rounds go dense; measured at 4M
+    #: sep-7: factor 3 scores ARI-vs-truth 0.9558, factor 6 scores 0.9754
+    #: at ~2x the glue/refine wall (r3).
+    glue_max_factor: int = 3
+    #: Optional row-count TARGET for the glue/refine subset — the exact-tree
+    #: FIDELITY knob. When > 0 and the factor-capped set is below it, the
+    #: glue set grows with further at-risk rows (deep-crossing first, then
+    #: ascending seam margin) until the budget or the at-risk pool runs out.
+    #: Measured at 1M sep-7 (boundary_eval_r4.jsonl): glue_rows=1048576
+    #: lifts ARI-vs-exact 0.9058 -> 0.9507 (the r2 fidelity level) at 2x the
+    #: boundary wall and slightly LOWER ARI-vs-truth (0.9459 -> 0.9266 —
+    #: the floor-glue tree's deviations from exact act as regularization at
+    #: overlapping-cluster difficulty). Default 0 = factor-capped only:
+    #: better truth, better wall; set a budget when the contract is
+    #: "approximate the exact tree", not "maximize ground-truth ARI".
+    glue_row_budget: int = 0
+    #: Consensus across sample draws (``models/consensus.py``): > 1 runs the
+    #: distributed pipeline that many times with distinct seeds and returns
+    #: the evidence-accumulation consensus of the flat cuts — the stabilizer
+    #: for lattice-valued data whose flat cut is bimodal across draws (Skin:
+    #: per-draw ARI std 0.034 vs the paper's 0.002; the spread is structural,
+    #: not fixable by refinement — ROADMAP r3). 1 = single draw (reference
+    #: behavior).
+    consensus_draws: int = 1
     #: Collapse duplicate rows into weighted unique points before the exact
     #: pipeline (``core/dedup.py``). Semantics-preserving (a duplicate group
     #: is a zero-extent bubble; the member-weighted tree equals the full-row
@@ -135,6 +174,14 @@ class HDBSCANParams:
             raise ValueError(f"variant must be 'db' or 'rs', got {self.variant!r}")
         if not (0.0 <= self.boundary_quality < 1.0):
             raise ValueError("boundary_quality must be in [0, 1)")
+        if self.boundary_alpha <= 0 or self.glue_alpha < 0:
+            raise ValueError("boundary_alpha must be > 0, glue_alpha >= 0")
+        if self.glue_max_factor < 1:
+            raise ValueError("glue_max_factor must be >= 1")
+        if self.glue_row_budget < 0:
+            raise ValueError("glue_row_budget must be >= 0")
+        if self.consensus_draws < 1:
+            raise ValueError("consensus_draws must be >= 1")
         if self.boundary_quality > 0 and self.dedup_points:
             raise ValueError(
                 "boundary_quality and dedup_points are mutually exclusive "
@@ -163,38 +210,52 @@ class HDBSCANParams:
     @classmethod
     def from_args(cls, argv: list[str]) -> "HDBSCANParams":
         """Parse the reference's ``key=value`` flag strings."""
-        mapping = {
-            "file": ("input_file", str),
-            "minPts": ("min_points", int),
-            "minClSize": ("min_cluster_size", int),
-            "processing_units": ("processing_units", int),
-            "k": ("k", float),
-            "dist_function": ("dist_function", str),
-            "compact": ("compact_hierarchy", lambda s: s.lower() == "true"),
-            "constraints": ("constraints_file", str),
-            "clusterName": ("cluster_name", str),
-            "out_dir": ("out_dir", str),
-            "seed": ("seed", int),
-            "variant": ("variant", str),
-            "dedup": ("dedup_points", lambda s: s.lower() == "true"),
-            "exact_inter_edges": ("exact_inter_edges", lambda s: s.lower() == "true"),
-            "global_cores": ("global_core_distances", lambda s: s.lower() == "true"),
-            "refine": ("refine_iterations", int),
-            "boundary": ("boundary_quality", float),
-            "block_pruning": ("boundary_block_pruning", lambda s: s.lower() == "true"),
-            "max_samples": ("max_samples", int),
-            "compat_cf": ("compat_cf_int_math", lambda s: s.lower() == "true"),
-        }
         kwargs = {}
         for arg in argv:
             if "=" not in arg:
                 raise ValueError(f"malformed flag {arg!r}; expected key=value")
             key, _, value = arg.partition("=")
-            if key not in mapping:
+            if key not in FLAG_FIELDS:
                 raise ValueError(f"unknown flag {key!r}")
-            field, conv = mapping[key]
+            field, conv = FLAG_FIELDS[key]
             kwargs[field] = conv(value)
         return cls(**kwargs)
 
     def replace(self, **kw) -> "HDBSCANParams":
         return dataclasses.replace(self, **kw)
+
+
+def _bool(s: str) -> bool:
+    return s.lower() == "true"
+
+
+#: CLI flag -> (dataclass field, converter). Module-level so harnesses that
+#: need the flag->field correspondence (e.g. benchmarks/boundary_eval.py
+#: override echoing) share one copy instead of re-declaring it.
+FLAG_FIELDS = {
+    "file": ("input_file", str),
+    "minPts": ("min_points", int),
+    "minClSize": ("min_cluster_size", int),
+    "processing_units": ("processing_units", int),
+    "k": ("k", float),
+    "dist_function": ("dist_function", str),
+    "compact": ("compact_hierarchy", _bool),
+    "constraints": ("constraints_file", str),
+    "clusterName": ("cluster_name", str),
+    "out_dir": ("out_dir", str),
+    "seed": ("seed", int),
+    "variant": ("variant", str),
+    "dedup": ("dedup_points", _bool),
+    "exact_inter_edges": ("exact_inter_edges", _bool),
+    "global_cores": ("global_core_distances", _bool),
+    "refine": ("refine_iterations", int),
+    "boundary": ("boundary_quality", float),
+    "boundary_alpha": ("boundary_alpha", float),
+    "glue_alpha": ("glue_alpha", float),
+    "glue_factor": ("glue_max_factor", int),
+    "glue_rows": ("glue_row_budget", int),
+    "consensus": ("consensus_draws", int),
+    "block_pruning": ("boundary_block_pruning", _bool),
+    "max_samples": ("max_samples", int),
+    "compat_cf": ("compat_cf_int_math", _bool),
+}

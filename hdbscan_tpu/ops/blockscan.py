@@ -248,6 +248,8 @@ def _tiled_window_jobs(
     jobs: list[tuple[int, np.ndarray]],
     to_sorted_pos,
     row_tile: int,
+    *,
+    dummy: int,
 ):
     """Flatten window jobs to ROW-TILE granularity for batched dispatch.
 
@@ -262,11 +264,14 @@ def _tiled_window_jobs(
     LAZILY (one chunk in flight at a time), so host memory stays at the
     per-chunk budget regardless of the round's total tile count.
 
-    Yields (metas, ids (T, row_tile) int32, col_starts (T,)) where metas is
-    [(ridx_slice, tile_lo, n_tiles), ...] mapping each job's rows back to
-    its contiguous tile span within this chunk. A job whose tile span
-    crosses a chunk boundary is split across yields — its per-chunk row
-    slices are disjoint, so callers' per-row merges stay correct.
+    Yields (metas, ids (T, row_tile) int32, col_starts (T,), locs
+    (T, row_tile) int32) where metas is [(ridx_slice, tile_lo, n_tiles),
+    ...] mapping each job's rows back to its contiguous tile span within
+    this chunk, and ``locs`` carries each tile slot's LOCAL row index (the
+    job-space id, for device-side merges keyed by row) with pad slots set
+    to ``dummy``. A job whose tile span crosses a chunk boundary is split
+    across yields — its per-chunk row slices are disjoint, so callers'
+    per-row merges stay correct.
     """
     metas = []  # (ridx, global tile offset, n_tiles)
     t_total = 0
@@ -281,6 +286,7 @@ def _tiled_window_jobs(
         take = min(max_chunk, t_total - lo)
         take = 1 << (take.bit_length() - 1)  # pow2 floor: no pad tiles
         ids = np.zeros((take, row_tile), np.int32)
+        locs = np.full((take, row_tile), dummy, np.int32)
         starts = np.zeros(take, np.int32)
         chunk_metas = []
         while mi < len(metas):
@@ -296,35 +302,77 @@ def _tiled_window_jobs(
                 seg = to_sorted_pos(ridx[row_a:row_b])
                 flat = ids[a - lo : b - lo].reshape(-1)
                 flat[: len(seg)] = seg
+                lflat = locs[a - lo : b - lo].reshape(-1)
+                lflat[: len(seg)] = ridx[row_a:row_b]
                 starts[a - lo : b - lo] = col_start
                 chunk_metas.append((ridx[row_a:row_b], a - lo, b - a))
             if t_lo + t_n <= lo + take:
                 mi += 1
             else:
                 break
-        yield chunk_metas, ids, starts
+        yield chunk_metas, ids, starts, locs
         lo += take
+
+
+def _merge_knn_device(cur_d, cur_i, new_d, new_i, k: int):
+    """Rowwise dedup-merge of two (r, k) ascending neighbor lists on device.
+
+    Deduplicates by column id first: two jobs whose fixed-width windows
+    overlap legitimately scan the overlap columns twice, and a duplicated
+    neighbor would displace a real one from the k-list (measured on the old
+    host merge: it drove core distances BELOW the full-sweep truth).
+    Invalid slots carry id -1 / distance +inf; -1 duplicates are exempt
+    from the dedup mask (they are all inf anyway).
+    """
+    cat_d = jnp.concatenate([cur_d, new_d], axis=1)
+    cat_i = jnp.concatenate([cur_i, new_i], axis=1)
+    order = jnp.argsort(cat_i, axis=1, stable=True)
+    ci = jnp.take_along_axis(cat_i, order, axis=1)
+    cd = jnp.take_along_axis(cat_d, order, axis=1)
+    dup = (ci[:, 1:] == ci[:, :-1]) & (ci[:, 1:] >= 0)
+    cd = cd.at[:, 1:].set(jnp.where(dup, jnp.inf, cd[:, 1:]))
+    nb, sel = jax.lax.top_k(-cd, k)
+    return -nb, jnp.take_along_axis(ci, sel, axis=1)
+
+
+#: Block the dispatch queue on the merge buffer every N chunks: without
+#: per-chunk output fetches (the device-side merge removed them) nothing
+#: bounds the number of enqueued programs, and an unbounded async queue is
+#: the round-2 tunnel-drop failure mode (ops/tiled._drain_window).
+_MERGE_SYNC_EVERY = 8
 
 
 @partial(
     jax.jit,
     static_argnames=("k", "metric", "col_tile", "n_win_tiles"),
+    donate_argnums=(0, 1),
 )
-def _knn_window_scan_tiled(
-    ids, data, valid, col_starts, k: int, metric: str, col_tile: int,
-    n_win_tiles: int,
+def _knn_window_merge_chunk(
+    best_d, best_i, ids, locs, data, valid, col_starts, k: int, metric: str,
+    col_tile: int, n_win_tiles: int,
 ):
-    """(T, row_tile) ids + (T,) per-tile window origins -> (T, row_tile, k).
+    """Scan one chunk of row tiles and merge results into the device-resident
+    per-row best-k buffers, keyed by local row id.
 
-    One ``lax.map`` over row tiles, each gathering its rows on device and
-    scanning ITS OWN fixed-width window: the pow2 tile count T is the only
-    compiled axis, so a whole rescan compiles ~log2(T) programs total.
+    ``best_d``/``best_i`` are (m+1, k) with row m a write-off dummy slot for
+    pad tile positions (``locs`` points them there). A ``lax.fori_loop`` over
+    the chunk's tiles runs each tile's fixed-width window scan, gathers the
+    row's current best list, dedup-merges, and scatters back — sequential
+    over tiles, so a row appearing in several jobs (its ball intersects
+    several blocks) merges correctly without any host round trip. Only the
+    pow2 tile count T is a compiled axis (~log2(T) programs per rescan);
+    the buffers are donated so chained chunk calls update in place.
+
+    This replaces the round-3 host-side merge, whose per-chunk (dists, ids)
+    fetch moved ~row-duplication x m x k x 8 bytes over the ~10-25 MB/s
+    tunnel and made the rescan scale ~n^1.9 (VERDICT r3 item 1): the merged
+    result now leaves the device once, as (m,) cores plus the glue subset's
+    neighbor lists.
     """
     inf = jnp.array(jnp.inf, data.dtype)
     row_tile = ids.shape[1]
 
-    def one(args):
-        tids, cs = args
+    def scan_tile(tids, cs):
         xr = jnp.take(data, tids, axis=0)
 
         def col_step(c, carry):
@@ -349,71 +397,125 @@ def _knn_window_scan_tiled(
         best, bidx = jax.lax.fori_loop(0, n_win_tiles, col_step, init)
         return -best, bidx
 
-    return jax.lax.map(one, (ids, col_starts))
+    def body(t, carry):
+        bd, bi = carry
+        loc = locs[t]
+        nd, ni = scan_tile(ids[t], col_starts[t])
+        md, mi = _merge_knn_device(
+            jnp.take(bd, loc, axis=0), jnp.take(bi, loc, axis=0), nd, ni, k
+        )
+        return bd.at[loc].set(md), bi.at[loc].set(mi)
+
+    return jax.lax.fori_loop(0, ids.shape[0], body, (best_d, best_i))
 
 
-@partial(jax.jit, static_argnames=("metric", "col_tile", "n_win_tiles"))
-def _min_out_window_scan_tiled(
-    ids, data, core, comp, valid, col_starts, metric: str, col_tile: int,
-    n_win_tiles: int,
+#: Foreign candidate edges retained PER ROW across glue rounds. Mid-Borůvka
+#: rounds used to re-derive upper bounds from the (fixed) k-NN graph alone;
+#: once components span cluster gaps every k-NN edge is intra-component and
+#: the bounds collapse to the loose geometric backstop — pair fractions hit
+#: 0.2-0.5 and rounds fell back dense (ROADMAP r3 lever 2). Keeping each
+#: scanned row's best F still-foreign window results carries tight REAL
+#: upper bounds into later rounds: when a row's best target merges into its
+#: component, the next-best retained candidate (next seam over) takes over.
+_CAND_F = 8
+
+
+@partial(
+    jax.jit,
+    static_argnames=("f", "metric", "col_tile", "n_win_tiles"),
+    donate_argnums=(0, 1),
+)
+def _min_out_window_merge_chunk(
+    cand_w, cand_i, ids, locs, data, core, comp_sorted, comp_local, valid,
+    col_starts, f: int, metric: str, col_tile: int, n_win_tiles: int,
 ):
-    """Tile-granular :func:`_min_out_window_scan`: (T, row_tile) ids +
-    (T,) origins -> ((T, row_tile) best_w, (T, row_tile) best_j)."""
-    inf = jnp.array(jnp.inf, data.dtype)
+    """Scan one chunk of row tiles for their top-``f`` smallest FOREIGN MRD
+    edges and merge into the device-resident per-row candidate buffers.
 
-    def one(args):
-        tids, cs = args
+    ``cand_w``/``cand_i`` are (m+1, f) keyed by local row id (row m = pad
+    dummy), ids in SORTED column space. Before each merge the row's stored
+    candidates are re-validated against the current components (a target
+    that merged into the row's component is stale FOREVER — components only
+    merge — so its weight is inf-ed ahead of the dedup merge). Sequential
+    ``lax.fori_loop`` over tiles keeps multi-job rows correct on device.
+    """
+    inf = jnp.array(jnp.inf, data.dtype)
+    row_tile = ids.shape[1]
+
+    def scan_tile(tids, cs):
         x = jnp.take(data, tids, axis=0)
         c = jnp.take(core, tids)
-        kk = jnp.take(comp, tids)
+        kk = jnp.take(comp_sorted, tids)
 
         def col_step(t, carry):
-            bw, bj = carry
+            bw, bi = carry
             base = cs + t * col_tile
             xc = jax.lax.dynamic_slice_in_dim(data, base, col_tile)
             cc = jax.lax.dynamic_slice_in_dim(core, base, col_tile)
-            kc = jax.lax.dynamic_slice_in_dim(comp, base, col_tile)
+            kc = jax.lax.dynamic_slice_in_dim(comp_sorted, base, col_tile)
             vc = jax.lax.dynamic_slice_in_dim(valid, base, col_tile)
             dmat = pairwise_distance(x, xc, metric)
             w = jnp.maximum(dmat, jnp.maximum(c[:, None], cc[None, :]))
             out = (kk[:, None] != kc[None, :]) & vc[None, :]
             w = jnp.where(out, w, inf)
-            tw = jnp.min(w, axis=1)
-            tj = jnp.argmin(w, axis=1).astype(jnp.int32) + base
-            upd = tw < bw
-            return jnp.where(upd, tw, bw), jnp.where(upd, tj, bj)
+            cols = base + jax.lax.broadcasted_iota(
+                jnp.int32, (row_tile, col_tile), 1
+            )
+            merged = jnp.concatenate([bw, -w], axis=1)
+            merged_i = jnp.concatenate([bi, cols], axis=1)
+            nb, sel = jax.lax.top_k(merged, f)
+            return nb, jnp.take_along_axis(merged_i, sel, axis=1)
 
         init = (
-            jnp.full((x.shape[0],), jnp.inf, data.dtype),
-            jnp.full((x.shape[0],), -1, jnp.int32),
+            jnp.full((row_tile, f), -jnp.inf, data.dtype),
+            jnp.full((row_tile, f), -1, jnp.int32),
         )
-        return jax.lax.fori_loop(0, n_win_tiles, col_step, init)
+        bw, bi = jax.lax.fori_loop(0, n_win_tiles, col_step, init)
+        return -bw, bi
 
-    return jax.lax.map(one, (ids, col_starts))
+    def body(t, carry):
+        cw, ci = carry
+        loc = locs[t]
+        nw, ni = scan_tile(ids[t], col_starts[t])
+        cur_w = jnp.take(cw, loc, axis=0)
+        cur_i = jnp.take(ci, loc, axis=0)
+        row_comp = jnp.take(comp_local, loc)
+        tgt_comp = jnp.take(comp_sorted, jnp.maximum(cur_i, 0))
+        stale = (cur_i >= 0) & (tgt_comp == row_comp[:, None])
+        cur_w = jnp.where(stale, inf, cur_w)
+        mw, mi = _merge_knn_device(cur_w, cur_i, nw, ni, f)
+        return cw.at[loc].set(mw), ci.at[loc].set(mi)
+
+    return jax.lax.fori_loop(0, ids.shape[0], body, (cand_w, cand_i))
 
 
-def _merge_knn(
-    best_d: np.ndarray, best_i: np.ndarray, new_d: np.ndarray, new_i: np.ndarray
-) -> tuple[np.ndarray, np.ndarray]:
-    """Rowwise k-way merge of two (r, k) ascending neighbor lists.
+@jax.jit
+def _cand_best(cand_w, cand_i, comp_local, comp_sorted):
+    """Per-row best still-foreign candidate: ((m+1,) w, (m+1,) sorted id).
 
-    Deduplicates by column id first: two jobs whose fixed-width windows
-    overlap legitimately scan the overlap columns twice, and a duplicated
-    neighbor would displace a real one from the k-list (measured: it drove
-    core distances BELOW the full-sweep truth).
-    """
-    cat_d = np.concatenate([best_d, new_d], axis=1)
-    cat_i = np.concatenate([best_i, new_i], axis=1)
-    k = best_d.shape[1]
-    order = np.argsort(cat_i, axis=1, kind="stable")
-    ci = np.take_along_axis(cat_i, order, axis=1)
-    cd = np.take_along_axis(cat_d, order, axis=1)
-    dup = (ci[:, 1:] == ci[:, :-1]) & (ci[:, 1:] >= 0)
-    cd[:, 1:][dup] = np.inf
-    sel = np.argsort(cd, axis=1, kind="stable")[:, :k]
-    return np.take_along_axis(cd, sel, axis=1), np.take_along_axis(
-        ci, sel, axis=1
-    )
+    Rows whose candidates all went stale (or were never scanned) return
+    (inf, -1). Offering a stale row's surviving candidates is SAFE for the
+    Borůvka contraction — every candidate is a real foreign edge, so it can
+    never undercut the component's true minimum (which the row hosting it
+    offers exactly, its pair having survived the bound test)."""
+    tgt = jnp.take(comp_sorted, jnp.maximum(cand_i, 0))
+    ok = (cand_i >= 0) & (tgt != comp_local[:, None])
+    w = jnp.where(ok, cand_w, jnp.inf)
+    a = jnp.argmin(w, axis=1)
+    bw = jnp.take_along_axis(w, a[:, None], axis=1)[:, 0]
+    bi = jnp.take_along_axis(cand_i, a[:, None], axis=1)[:, 0]
+    return bw, jnp.where(jnp.isfinite(bw), bi, -1)
+
+
+@partial(jax.jit, static_argnames=("n_seg",))
+def _cand_comp_min(cand_w, cand_i, comp_local, comp_sorted, n_seg: int):
+    """Per-component min of still-foreign candidate weights: (n_seg + 1,)
+    (slot n_seg collects the pad dummy; callers slice [:ncomp]). ``n_seg``
+    is pow2-padded by the caller so recompiles stay logarithmic as
+    components shrink across rounds."""
+    bw, _ = _cand_best(cand_w, cand_i, comp_local, comp_sorted)
+    seg = jnp.where(comp_local >= 0, comp_local, n_seg).astype(jnp.int32)
+    return jax.ops.segment_min(bw, seg, num_segments=n_seg + 1)
 
 
 def knn_rows_blockpruned(
@@ -423,6 +525,7 @@ def knn_rows_blockpruned(
     min_pts: int,
     return_neighbors: bool = False,
     row_tile: int = 256,
+    neighbor_rows: np.ndarray | None = None,
 ):
     """Exact core distances of selected rows via block-candidate windows.
 
@@ -430,63 +533,79 @@ def knn_rows_blockpruned(
     metrics: ``ub`` (each row's per-block core distance) bounds its k-NN ball
     radius, blocks outside the ball are excluded by f64 geometry, and the
     surviving windows are scanned exactly. Work is O(sum of candidate-window
-    sizes) ≈ O(m · seam-degree · cap) instead of O(m · n).
+    sizes) ≈ O(m · seam-degree · cap) instead of O(m · n); per-row results
+    merge ON DEVICE (``_knn_window_merge_chunk``), so host transfer is one
+    (m,) core fetch plus the requested neighbor lists — not the per-chunk
+    (dists, ids) streams that made the round-3 rescan scale ~n^1.9.
 
-    Returns ``core`` (m,) — and with ``return_neighbors`` the (m, k) global
-    neighbor ids + distances backing it (the boundary k-NN graph the pruned
-    glue seeds its upper bounds with).
+    Returns ``core`` (m,). ``neighbor_rows`` (local indices into
+    ``row_ids``) additionally returns those rows' (r, k) ascending neighbor
+    distances + GLOBAL ids (the k-NN graph the pruned glue seeds its upper
+    bounds with — typically the small glue subset, so the fetch stays tiny).
+    ``return_neighbors`` is the all-rows convenience form
+    (``neighbor_rows=arange(m)``).
     """
     m = len(row_ids)
     k = max(min_pts - 1, 1)
+    if return_neighbors and neighbor_rows is None:
+        neighbor_rows = np.arange(m)
     if m == 0:
         empty = np.zeros(0, np.float64)
-        if return_neighbors:
+        if neighbor_rows is not None:
             return empty, np.zeros((0, k)), np.zeros((0, k), np.int64)
         return empty
     rows = geom.data_host[row_ids]
     pair_rows, pair_blocks = geom.candidate_pairs(rows, np.asarray(ub, np.float64))
     jobs = _window_jobs(geom, pair_rows, pair_blocks)
 
-    best_d = np.full((m, k), np.inf, np.float64)
-    best_i = np.full((m, k), -1, np.int64)
     # Jobs address rows by sorted-space index (device-side gather),
     # flattened to row tiles and dispatched in descending-pow2 tile chunks
-    # (_tiled_window_jobs — one compiled shape per chunk length).
+    # (_tiled_window_jobs — one compiled shape per chunk length). Row m of
+    # the merge buffers is the pad-slot dummy.
     rows_sorted_pos = np.asarray(geom.inv_perm[row_ids], np.int32)
+    best_d = jnp.full((m + 1, k), jnp.inf, geom.data_sorted.dtype)
+    best_i = jnp.full((m + 1, k), -1, jnp.int32)
+    from hdbscan_tpu.utils.flops import counter as _flops
 
-    from hdbscan_tpu.ops.tiled import _drain_window
+    d = geom.data_host.shape[1]
+    win_cols = geom.win_tiles * geom.col_tile
+    n_chunks = 0
+    for _metas, ids, starts, locs in _tiled_window_jobs(
+        jobs, lambda r: rows_sorted_pos[r], row_tile, dummy=m
+    ):
+        _flops.add_scan(
+            ids.shape[0] * row_tile, win_cols, d, row_tile=row_tile
+        )
+        best_d, best_i = _knn_window_merge_chunk(
+            best_d,
+            best_i,
+            jnp.asarray(ids),
+            jnp.asarray(locs),
+            geom.data_sorted,
+            geom.valid_sorted,
+            jnp.asarray(starts),
+            k,
+            geom.metric,
+            geom.col_tile,
+            geom.win_tiles,
+        )
+        n_chunks += 1
+        if n_chunks % _MERGE_SYNC_EVERY == 0:
+            jax.block_until_ready(best_d)
 
-    def dispatches():
-        for metas, ids, starts in _tiled_window_jobs(
-            jobs, lambda r: rows_sorted_pos[r], row_tile
-        ):
-            out = _knn_window_scan_tiled(
-                jnp.asarray(ids),
-                geom.data_sorted,
-                geom.valid_sorted,
-                jnp.asarray(starts),
-                k,
-                geom.metric,
-                geom.col_tile,
-                geom.win_tiles,
-            )
-            yield metas, out
-
-    fetched = _drain_window((d for d in dispatches()))
-    for metas, (jd_b, ji_b) in fetched:
-        jd_b = np.asarray(jd_b, np.float64)
-        ji_b = np.asarray(ji_b, np.int64)
-        for ridx, t_lo, t_n in metas:
-            jd = jd_b[t_lo : t_lo + t_n].reshape(-1, k)[: len(ridx)]
-            ji = ji_b[t_lo : t_lo + t_n].reshape(-1, k)[: len(ridx)]
-            best_d[ridx], best_i[ridx] = _merge_knn(
-                best_d[ridx], best_i[ridx], jd, ji
-            )
-
-    core = best_d[:, min(k, geom.n) - 1].copy() if min_pts > 1 else np.zeros(m)
-    if return_neighbors:
-        ids = np.where(best_i >= 0, geom.perm[np.maximum(best_i, 0)], -1)
-        return core, best_d, ids
+    if min_pts > 1:
+        kth = min(k, geom.n) - 1
+        core = np.asarray(jax.device_get(best_d[:m, kth]), np.float64)
+    else:
+        core = np.zeros(m)
+    if neighbor_rows is not None:
+        nbr = jnp.asarray(np.asarray(neighbor_rows, np.int32))
+        gd, gi = jax.device_get(
+            (jnp.take(best_d, nbr, axis=0), jnp.take(best_i, nbr, axis=0))
+        )
+        gi = np.asarray(gi, np.int64)
+        ids_g = np.where(gi >= 0, geom.perm[np.maximum(gi, 0)], -1)
+        return core, np.asarray(gd, np.float64), ids_g
     return core
 
 
@@ -555,7 +674,6 @@ def boruvka_glue_edges_blockpruned(
     single-device by design (each is a small pow2-rows x fixed-window
     program — sharding them would cost more in dispatch than it saves).
     """
-    from hdbscan_tpu.ops.tiled import _drain_window
     from hdbscan_tpu.utils.unionfind import contract_min_edges
 
     m = len(data)
@@ -630,6 +748,10 @@ def boruvka_glue_edges_blockpruned(
         if dc_cache is not None:
             return dc_cache[sl]
         return _chunked_centroid_distances(rows_all[sl], geom.centroid, metric)
+    # Cross-round candidate buffers (device-resident, lazily allocated on
+    # the first windowed round): each row's best _CAND_F still-foreign
+    # window results, re-validated per round. See _CAND_F.
+    cand_w = cand_i = None
     for rnd in range(max_rounds):
         if n_comp <= 1:
             break
@@ -643,6 +765,14 @@ def boruvka_glue_edges_blockpruned(
         bmin = np.minimum.reduceat(cs, geom.starts)
         bmax = np.maximum.reduceat(cs, geom.starts)
         block_comp = np.where(bmin == bmax, bmin, -2)
+        # Component labels on device, in both index spaces the kernels use:
+        # sorted column space (masking) and local row space (re-validation).
+        comp_pad = np.full(geom.n_pad, -3, np.int32)
+        comp_pad[:m] = cs
+        comp_sorted = jax.device_put(comp_pad)
+        comp_local_np = np.full(m + 1, -9, np.int32)
+        comp_local_np[:m] = cidx
+        comp_local = jax.device_put(comp_local_np)
 
         # --- pass A: k-NN-graph candidates + per-component upper bounds ----
         bestA_w = np.full(m, np.inf)
@@ -658,6 +788,21 @@ def boruvka_glue_edges_blockpruned(
                 -1,
             )
         upper = _segment_min(bestA_w, cidx, ncomp_dense)
+        if cand_w is not None:
+            # Tighten per-component bounds with the retained still-foreign
+            # candidates (real edges from earlier rounds' window scans) —
+            # the cross-round maintenance that keeps mid-round pair
+            # fractions from collapsing to the geometric backstop.
+            n_seg_pad = 1 << max(0, (int(ncomp_dense) - 1).bit_length())
+            cu = np.asarray(
+                jax.device_get(
+                    _cand_comp_min(
+                        cand_w, cand_i, comp_local, comp_sorted, n_seg_pad
+                    )
+                ),
+                np.float64,
+            )[:ncomp_dense]
+            upper = np.minimum(upper, cu)
 
         # --- geometric backstop + pass-B pair extraction, chunked over rows
         # so only a (chunk, G) bound matrix ever materializes. Two sweeps:
@@ -693,6 +838,7 @@ def boruvka_glue_edges_blockpruned(
         n_pairs = len(pair_rows)
         bestB_w = np.full(m, np.inf, np.float64)
         bestB_j = np.full(m, -1, np.int64)
+        dense_round = False
         if n_pairs:
             # Work-based fallback: the windowed path costs ~pairs * window
             # columns, the dense scan ~m * n_pad columns. Compare WORK, not
@@ -701,6 +847,7 @@ def boruvka_glue_edges_blockpruned(
             win_work = n_pairs * geom.win_tiles * geom.col_tile
             dense_work = m * geom.n_pad
             if win_work > dense_work_ratio * dense_work:
+                dense_round = True
                 # Dense round: same result, better schedule at this density.
                 if _dense_scanner[0] is None:
                     from hdbscan_tpu.ops.tiled import BoruvkaScanner
@@ -713,42 +860,54 @@ def boruvka_glue_edges_blockpruned(
                 bestB_j = bj
             else:
                 jobs = _window_jobs(geom, pair_rows, pair_blocks)
-                comp_pad = np.full(geom.n_pad, -3, np.int32)
-                comp_pad[:m] = cs
-                comp_sorted = jax.device_put(comp_pad)
+                if cand_w is None:
+                    cand_w = jnp.full(
+                        (m + 1, _CAND_F), jnp.inf, geom.data_sorted.dtype
+                    )
+                    cand_i = jnp.full((m + 1, _CAND_F), -1, jnp.int32)
+                from hdbscan_tpu.utils.flops import counter as _flops
 
-                def dispatches():
-                    for metas, ids, starts in _tiled_window_jobs(
-                        jobs, lambda r: geom.inv_perm[r], row_tile
-                    ):
-                        out = _min_out_window_scan_tiled(
-                            jnp.asarray(ids),
-                            geom.data_sorted,
-                            core_sorted,
-                            comp_sorted,
-                            geom.valid_sorted,
-                            jnp.asarray(starts),
-                            metric,
-                            geom.col_tile,
-                            geom.win_tiles,
-                        )
-                        yield metas, out
-
-                for metas, (jw_b, jj_b) in _drain_window(
-                    (x for x in dispatches())
+                win_cols = geom.win_tiles * geom.col_tile
+                n_chunks = 0
+                for _metas, idsc, starts, locs in _tiled_window_jobs(
+                    jobs, lambda r: geom.inv_perm[r], row_tile, dummy=m
                 ):
-                    jw_b = np.asarray(jw_b, np.float64)
-                    jj_b = np.asarray(jj_b, np.int64)
-                    for ridx, t_lo, t_n in metas:
-                        jw = jw_b[t_lo : t_lo + t_n].reshape(-1)[: len(ridx)]
-                        jj = jj_b[t_lo : t_lo + t_n].reshape(-1)[: len(ridx)]
-                        valid_j = jj >= 0
-                        jg = np.where(valid_j, geom.perm[np.maximum(jj, 0)], -1)
-                        upd = jw < bestB_w[ridx]
-                        bestB_w[ridx] = np.where(upd, jw, bestB_w[ridx])
-                        bestB_j[ridx] = np.where(
-                            upd & valid_j, jg, bestB_j[ridx]
-                        )
+                    _flops.add_scan(
+                        idsc.shape[0] * row_tile,
+                        win_cols,
+                        data.shape[1],
+                        row_tile=row_tile,
+                    )
+                    cand_w, cand_i = _min_out_window_merge_chunk(
+                        cand_w,
+                        cand_i,
+                        jnp.asarray(idsc),
+                        jnp.asarray(locs),
+                        geom.data_sorted,
+                        core_sorted,
+                        comp_sorted,
+                        comp_local,
+                        geom.valid_sorted,
+                        jnp.asarray(starts),
+                        _CAND_F,
+                        metric,
+                        geom.col_tile,
+                        geom.win_tiles,
+                    )
+                    n_chunks += 1
+                    if n_chunks % _MERGE_SYNC_EVERY == 0:
+                        jax.block_until_ready(cand_w)
+                # One (m,) fetch: each row's best still-foreign candidate.
+                # Scanned rows offer this round's exact window minimum;
+                # other rows offer retained candidates — real foreign edges,
+                # so they can never undercut a component's true minimum
+                # (hosted by a row whose pair survived and was scanned).
+                bw_c, bi_c = jax.device_get(
+                    _cand_best(cand_w, cand_i, comp_local, comp_sorted)
+                )
+                bestB_w = np.asarray(bw_c, np.float64)[:m]
+                bi_c = np.asarray(bi_c, np.int64)[:m]
+                bestB_j = np.where(bi_c >= 0, geom.perm[np.maximum(bi_c, 0)], -1)
 
         take_b = bestB_w < bestA_w
         best_w = np.where(take_b, bestB_w, bestA_w)
@@ -760,6 +919,7 @@ def boruvka_glue_edges_blockpruned(
                 n_comp=int(n_comp),
                 pairs=int(n_pairs),
                 pair_frac=round(n_pairs / (m * g), 5),
+                dense=dense_round,
             )
         emit, comp, n_comp = contract_min_edges(comp, best_j, best_w)
         if len(emit) == 0:

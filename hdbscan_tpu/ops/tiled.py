@@ -196,6 +196,9 @@ def knn_core_distances(
         )
         for a in range(0, n_pad, chunk_rows)
     )
+    from hdbscan_tpu.utils.flops import counter as _flops
+
+    _flops.add_scan(n_pad, n_pad, data.shape[1], row_tile=row_tile)
     knn = np.concatenate([np.asarray(c[0], np.float64) for c in fetched])[:n]
     if return_indices:
         idx = np.concatenate([np.asarray(c[1]) for c in fetched])[:n]
@@ -254,6 +257,9 @@ def knn_core_distances_rows(
             for a in range(0, m_pad, chunk_rows)
         ),
     )
+    from hdbscan_tpu.utils.flops import counter as _flops
+
+    _flops.add_scan(m_pad, n_pad, data.shape[1], row_tile=row_tile)
     knn = np.concatenate([np.asarray(c[0], np.float64) for c in fetched])[:m]
     if min_pts <= 1:
         return np.zeros(m, np.float64)
@@ -528,6 +534,7 @@ class BoruvkaScanner:
     ):
         n = len(data)
         self.n = n
+        self.d = data.shape[1]
         self.metric = metric
         self.row_tile, self.col_tile, n_pad = _tile_sizes(
             n, row_tile, col_tile, pad_pow2=pad_pow2
@@ -560,6 +567,9 @@ class BoruvkaScanner:
 
     def min_outgoing(self, comp: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """(best_w, best_j) per point, edges leaving the point's component."""
+        from hdbscan_tpu.utils.flops import counter as _flops
+
+        _flops.add_scan(self.n_pad, self.n_pad, self.d, row_tile=self.row_tile)
         comp_p = _pad_rows(np.asarray(comp, np.int32), self.n_pad)
         if self.mesh is not None:
             from hdbscan_tpu.parallel.mesh import replicated

@@ -352,6 +352,16 @@ def run_packed_blocks(
     core = np.empty((b, cap), np.float64)
     gu, gv, gw = [], [], []
 
+    # Analytic accounting (utils/flops.py): the fused block program's
+    # dominant arithmetic is one (cap, cap, d) distance matrix per block
+    # (the in-matrix Borůvka rounds re-read, not recompute).
+    from hdbscan_tpu.utils.flops import counter as _flops
+
+    _flops.add(
+        2.0 * b * cap * cap * packed.x.shape[2],
+        float(b * cap * cap * itemsize),
+    )
+
     with_core = packed.core is not None
     if with_core:
         core[:] = packed.core

@@ -45,7 +45,11 @@ class Tracer:
         self._stream = stream
 
     def __call__(self, name: str, **fields) -> None:
-        self._emit(TraceEvent(name, 0.0, fields))
+        # An explicit wall_s field becomes the event's wall (several sites
+        # time their own block and emit an instant event with the result) —
+        # otherwise the logfmt line would carry two wall_s keys.
+        wall = fields.pop("wall_s", 0.0)
+        self._emit(TraceEvent(name, float(wall), fields))
 
     @contextmanager
     def stage(self, name: str, **fields):

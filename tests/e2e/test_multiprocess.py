@@ -112,6 +112,56 @@ class TestMultiProcess:
             b2 = (out2 / f).read_bytes()
             assert b1 == b2, f"{f} differs between single- and multi-process"
 
+    def test_four_process_cli_matches_single_process(self, tmp_path):
+        """4 OS processes x 2 devices == 1 process x 8 devices.
+
+        The >2-rank leg (VERDICT r3 item 8c): cross-process assembly,
+        allgather fetch, and the deterministic driver loop must hold beyond
+        the pairwise case — rank counts change slab boundaries, mesh shape,
+        and the collective participant set.
+        """
+        rng = np.random.default_rng(9)
+        pts = np.concatenate(
+            [rng.normal(c, 0.5, size=(300, 3)) for c in ((0, 0, 0), (9, 0, 0), (0, 9, 0), (9, 9, 9))]
+        )
+        dataset = str(tmp_path / "blobs4.txt")
+        np.savetxt(dataset, pts, fmt="%.6f")
+
+        out1 = tmp_path / "single"
+        r = _run_cli(_cli_args(dataset, out1, "local"), n_local_devices=8)
+        assert r.returncode == 0, r.stderr[-2000:]
+
+        port = _free_port()
+        out2 = tmp_path / "multi"
+        procs = [
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "hdbscan_tpu",
+                    *_cli_args(dataset, out2, f"127.0.0.1:{port},{pid},4"),
+                ],
+                env=_child_env(2),
+                cwd=REPO,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+            for pid in range(4)
+        ]
+        outs = _communicate_all(procs)
+        for p, (so, se) in zip(procs, outs):
+            assert p.returncode == 0, f"rank failed:\n{se[-2000:]}"
+        assert "4 processes" in outs[0][1] and "8 devices" in outs[0][1]
+
+        files1 = sorted(os.listdir(out1))
+        files2 = sorted(os.listdir(out2))
+        assert files1 == files2 and len(files1) == len(OUTPUT_KINDS)
+        for f in files1:
+            assert (out1 / f).read_bytes() == (out2 / f).read_bytes(), (
+                f"{f} differs between single- and 4-process runs"
+            )
+
     def test_library_slab_and_assembly_two_process(self, tmp_path):
         """host_row_slab + global_rows_from_local + sharded scan across 2 procs.
 

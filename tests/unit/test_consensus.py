@@ -1,0 +1,94 @@
+"""Consensus across sample draws (models/consensus.py): quotient-space
+evidence accumulation semantics and the end-to-end wrapper."""
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.config import HDBSCANParams
+from hdbscan_tpu.models import consensus
+from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+
+
+class TestConsensusLabels:
+    def test_unanimous_draws_pass_through(self):
+        lab = np.array([0, 1, 1, 2, 2, 0])
+        rows = np.stack([lab] * 5)
+        got = consensus.consensus_labels(rows)
+        assert adjusted_rand_index(got, lab, noise_as_singletons=True) == 1.0
+        assert (got[lab == 0] == 0).all()
+
+    def test_majority_split_wins(self):
+        # Region X = points 0-3, region Y = points 4-7. Three draws split
+        # X|Y, two merge them: majority says split.
+        split = np.array([1, 1, 1, 1, 2, 2, 2, 2])
+        merged = np.array([1, 1, 1, 1, 1, 1, 1, 1])
+        rows = np.stack([split, split, split, merged, merged])
+        got = consensus.consensus_labels(rows)
+        assert got[0] != got[4]
+        assert (got[:4] == got[0]).all() and (got[4:] == got[4]).all()
+
+    def test_majority_merge_wins(self):
+        split = np.array([1, 1, 1, 1, 2, 2, 2, 2])
+        merged = np.array([1, 1, 1, 1, 1, 1, 1, 1])
+        rows = np.stack([merged, merged, merged, split, split])
+        got = consensus.consensus_labels(rows)
+        assert (got == got[0]).all() and got[0] > 0
+
+    def test_even_tie_stays_split(self):
+        # 2-2 on an even draw count is NOT a majority: merging would let one
+        # draw's reading dominate. The cut at t < 0.5 keeps the split.
+        split = np.array([1, 1, 2, 2])
+        merged = np.array([1, 1, 1, 1])
+        rows = np.stack([split, merged, split, merged])
+        got = consensus.consensus_labels(rows)
+        assert got[0] != got[2]
+
+    def test_noise_majority_is_noise(self):
+        a = np.array([0, 0, 1, 1])
+        b = np.array([2, 0, 1, 1])
+        rows = np.stack([a, a, a, b, b])
+        got = consensus.consensus_labels(rows)
+        assert got[0] == 0  # 3/5 draws say noise
+        assert got[2] > 0
+
+    def test_relabeled_clusters_still_agree(self):
+        # Draws that agree on the partition but permute label ids must
+        # produce the same consensus (agreement is within-draw).
+        a = np.array([1, 1, 2, 2, 3, 3])
+        b = np.array([7, 7, 5, 5, 9, 9])
+        rows = np.stack([a, b, a, b, a])
+        got = consensus.consensus_labels(rows)
+        assert adjusted_rand_index(got, a, noise_as_singletons=True) == 1.0
+
+    def test_cell_explosion_guard(self):
+        rng = np.random.default_rng(0)
+        rows = rng.integers(1, 5000, size=(3, 20000))
+        with pytest.raises(ValueError, match="distinct label tuples"):
+            consensus.consensus_labels(rows)
+
+
+class TestConsensusFit:
+    def test_end_to_end_stabilizes(self, rng):
+        from hdbscan_tpu.utils.datasets import make_gauss
+
+        data, y = make_gauss(4000, dims=4, n_clusters=5, separation=9.0, seed=5)
+        params = HDBSCANParams(
+            min_points=4,
+            min_cluster_size=100,
+            processing_units=1024,
+            k=0.05,
+            seed=3,
+            consensus_draws=3,
+        )
+        r = consensus.fit(data, params)
+        assert len(r.labels) == len(data)
+        ari = adjusted_rand_index(r.labels, y, noise_as_singletons=True)
+        assert ari > 0.95
+        # tree/cores come from the representative draw and stay usable
+        assert r.core_distances.shape == (len(data),)
+
+    def test_rejects_single_draw(self):
+        with pytest.raises(ValueError, match="consensus_draws"):
+            consensus.fit(
+                np.zeros((10, 2)), HDBSCANParams(consensus_draws=1)
+            )

@@ -1,0 +1,51 @@
+"""Analytic FLOP/byte accounting (utils/flops.py): dispatch sites credit the
+module counter with the documented model, and phase_stats derives rates."""
+
+import numpy as np
+
+from hdbscan_tpu.utils import flops as flops_mod
+
+
+class TestScanCounter:
+    def test_add_scan_model(self):
+        c = flops_mod.ScanCounter()
+        c.add_scan(rows=256, cols=1024, d=8, itemsize=4, row_tile=64)
+        assert c.flops == 2.0 * 256 * 1024 * 8
+        # 4 row tiles re-read the 1024x8 column window + one row pass.
+        assert c.bytes == (4 * 1024 * 8 + 256 * 8) * 4
+
+    def test_phase_stats_rates(self):
+        snap = flops_mod.counter.snapshot()
+        flops_mod.counter.add(2e9, 1e9)
+        stats = flops_mod.phase_stats(snap, wall_s=2.0)
+        assert stats["gflops"] == 2.0
+        assert stats["gflops_s"] == 1.0
+        assert stats["gbytes_s"] == 0.5
+        assert 0 < stats["mfu"] < 1
+
+    def test_phase_stats_empty(self):
+        snap = flops_mod.counter.snapshot()
+        assert flops_mod.phase_stats(snap, 1.0) == {}
+
+
+class TestDispatchSitesCredit:
+    def test_tiled_knn_credits(self):
+        from hdbscan_tpu.ops import tiled
+
+        before = flops_mod.counter.flops
+        data = np.random.default_rng(0).normal(size=(300, 5))
+        tiled.knn_core_distances(data, 4, row_tile=64, col_tile=128)
+        # n_pad = 384 (round up to col_tile 128): 2 * 384^2 * 5 flops.
+        assert flops_mod.counter.flops - before == 2.0 * 384 * 384 * 5
+
+    def test_blockscan_credits(self):
+        from hdbscan_tpu.ops.blockscan import BlockGeometry, knn_rows_blockpruned
+
+        rng = np.random.default_rng(1)
+        pts = rng.normal(size=(400, 4))
+        geom = BlockGeometry.build(pts, np.arange(400) // 100, col_tile=128)
+        before = flops_mod.counter.flops
+        knn_rows_blockpruned(
+            geom, np.arange(50), np.full(50, np.inf), 5, row_tile=64
+        )
+        assert flops_mod.counter.flops > before
