@@ -97,16 +97,13 @@ def main() -> None:
             np.save(cache, exact_labels)
         else:
             p = HDBSCANParams(**{**base, **configs[mode], **overrides})
-            if p.consensus_draws > 1:
-                from hdbscan_tpu.models import consensus
-
-                r = consensus.fit(data, p, trace=tracer)
-            else:
-                r = mr_hdbscan.fit(data, p, trace=tracer)
+            r = mr_hdbscan.fit(data, p, trace=tracer)  # consensus inside
         wall = time.time() - t0
         rec = {
             "config": mode,
-            **({"overrides": overrides} if overrides else {}),
+            # Overrides only apply to non-exact modes; echoing them on the
+            # exact row would attribute the baseline to a config it never ran.
+            **({"overrides": overrides} if overrides and mode != "exact" else {}),
             "n": n,
             "dims": dims,
             "sep": sep,

@@ -1,0 +1,47 @@
+#!/bin/bash
+# Round-4 measurement campaign — strictly sequential: the tunneled single-chip
+# host inflates TPU walls 5-10x under concurrent load (ROADMAP bench caveat).
+# Each leg runs in its own process (fresh device state) and appends one JSON
+# row; per-leg stage traces land in logs_r4/.
+set -u
+cd /root/repo
+mkdir -p logs_r4
+B=benchmarks
+log() { echo "[campaign $(date +%H:%M:%S)] $*" >> logs_r4/campaign.log; }
+
+log "A: 4M sep7 bound05 default"
+python $B/boundary_eval.py 4000000 7.0 bound05 \
+  >> $B/boundary_eval_r4.jsonl 2> logs_r4/4M7_default.log
+log "A done rc=$?"
+
+log "B: 4M sep7 bound05 glue_factor=6"
+python $B/boundary_eval.py 4000000 7.0 bound05 glue_factor=6 \
+  >> $B/boundary_eval_r4.jsonl 2> logs_r4/4M7_f6.log
+log "B done rc=$?"
+
+log "C: skin 45-seed consensus sweep (cons5)"
+python $B/seed_sweep.py 45 skin cons5 \
+  >> $B/seed_sweep45_skin_r4.jsonl 2> logs_r4/sweep_cons5.log
+log "C done rc=$?"
+
+log "D: pallas high-d legs (d=28, d=90)"
+python $B/pallas_knn_bench.py --datasets gauss500k_d28,gauss500k_d90 \
+  >> $B/pallas_r4.jsonl 2> logs_r4/pallas_highd.log
+log "D done rc=$?"
+
+log "E: 4M sep9 bound05"
+python $B/boundary_eval.py 4000000 9.0 bound05 \
+  >> $B/boundary_eval_r4.jsonl 2> logs_r4/4M9.log
+log "E done rc=$?"
+
+log "F: 8M sep9 bound05"
+python $B/boundary_eval.py 8000000 9.0 bound05 \
+  >> $B/boundary_eval_r4.jsonl 2> logs_r4/8M9.log
+log "F done rc=$?"
+
+log "G: HEPMASS-class 10.5M x 28d bound05"
+python $B/highdim_eval.py 10500000 28 bound05 \
+  >> $B/highdim_r4.jsonl 2> logs_r4/hepmass_10M5.log
+log "G done rc=$?"
+
+log "campaign complete"

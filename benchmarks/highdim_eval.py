@@ -33,6 +33,9 @@ from hdbscan_tpu import HDBSCANParams
 from hdbscan_tpu.models import exact, mr_hdbscan
 from hdbscan_tpu.utils.datasets import make_gauss
 from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+from hdbscan_tpu.utils.flops import counter as flops_counter
+from hdbscan_tpu.utils.flops import phase_stats
+from hdbscan_tpu.utils.tracing import Tracer
 
 
 def oracle_core_check(data, min_pts, sample=512, seed=0):
@@ -76,6 +79,8 @@ def main() -> None:
         )
         exact_labels = None
         for mode in modes:
+            tracer = Tracer(stream=sys.stderr)
+            fsnap = flops_counter.snapshot()
             t0 = time.time()
             if mode == "oracle":
                 abs_e, rel_e = oracle_core_check(data, min_pts)
@@ -90,21 +95,24 @@ def main() -> None:
                 print(json.dumps(rec), flush=True)
                 continue
             if mode == "exact":
-                r = exact.fit(data, HDBSCANParams(**base))
+                r = exact.fit(data, HDBSCANParams(**base), trace=tracer)
                 exact_labels = r.labels
             elif mode == "bound05":
                 r = mr_hdbscan.fit(
-                    data, HDBSCANParams(**base, boundary_quality=0.05)
+                    data, HDBSCANParams(**base, boundary_quality=0.05),
+                    trace=tracer,
                 )
             else:
                 raise ValueError(mode)
+            wall = time.time() - t0
             rec = {
                 "config": mode,
                 "n": n,
                 "dims": dims,
                 "min_cluster_size": mcs,
-                "wall_s": round(time.time() - t0, 2),
+                "wall_s": round(wall, 2),
                 "ari_truth": round(float(adjusted_rand_index(r.labels, y)), 4),
+                **phase_stats(fsnap, wall),
             }
             if exact_labels is not None and mode != "exact":
                 rec["ari_exact"] = round(

@@ -7,9 +7,10 @@ protocol: the DB and RS variants, >= 10 seeds each, on the bundled Skin set
 and the Gauss synthetic family.
 
 Emits one JSON line per (dataset, variant) with mean/std ARI + wall stats.
-Usage: python benchmarks/seed_sweep.py [n_seeds] [dataset1,dataset2,...]
-Datasets: skin | gauss200k. Results land in benchmarks/seed_sweep_r2.jsonl
-via shell redirection.
+Usage: python benchmarks/seed_sweep.py [n_seeds] [dataset1,...] [variant1,...]
+Datasets: skin | gauss200k | gauss2_200k | gauss3_200k | gauss2_1m | gauss3_1m.
+Variants: db | rs | consN (N>=2: DB + consensus over N draws). Results land
+in benchmarks/seed_sweep_r*.jsonl via shell redirection.
 """
 
 from __future__ import annotations
@@ -74,6 +75,24 @@ def load_dataset(name: str):
 def main() -> None:
     n_seeds = int(sys.argv[1]) if len(sys.argv) > 1 else 10
     datasets = (sys.argv[2] if len(sys.argv) > 2 else "skin,gauss200k").split(",")
+    # Variants: db | rs | consN (DB + evidence-accumulation consensus over N
+    # draws, models/consensus.py — the round-4 lever against the Skin
+    # lattice-tie bimodality; each sweep seed uses a disjoint draw-seed block).
+    # Validated up front: a typo must die before the first leg runs, not
+    # hours into a sweep.
+    variants = []
+    for variant in (sys.argv[3] if len(sys.argv) > 3 else "db,rs").split(","):
+        if variant.startswith("cons"):
+            if not variant[4:].isdigit() or int(variant[4:]) < 2:
+                raise SystemExit(
+                    f"variant {variant!r}: consensus needs 'cons<N>' with "
+                    "N >= 2 (e.g. cons5)"
+                )
+            variants.append((variant, int(variant[4:])))
+        elif variant in ("db", "rs"):
+            variants.append((variant, 1))
+        else:
+            raise SystemExit(f"unknown variant {variant!r}")
 
     for ds in datasets:
         data, truth, base = load_dataset(ds)
@@ -115,12 +134,17 @@ def main() -> None:
                 ),
                 flush=True,
             )
-        for variant in ("db", "rs"):
+        for variant, draws in variants:
             aris, walls = [], []
             for seed in range(n_seeds):
-                p = HDBSCANParams(**base, variant=variant, seed=seed)
+                p = HDBSCANParams(
+                    **base,
+                    variant="db" if draws > 1 else variant,
+                    seed=seed,
+                    consensus_draws=draws,
+                )
                 t0 = time.time()
-                r = mr_hdbscan.fit(data, p)
+                r = mr_hdbscan.fit(data, p)  # dispatches consensus inside
                 walls.append(time.time() - t0)
                 aris.append(
                     float(

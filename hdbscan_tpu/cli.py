@@ -105,14 +105,14 @@ def main(argv: list[str] | None = None) -> int:
             # Single-block exact path: dense local compute (no mesh to shard).
             result = hdbscan.fit(data, params)
             mode = "exact"
-        elif params.consensus_draws > 1:
-            from hdbscan_tpu.models import consensus
-
-            result = consensus.fit(data, params, mesh=mesh, trace=tracer)
-            mode = f"mr-consensus ({params.consensus_draws} draws)"
         else:
+            # consensus_draws > 1 dispatches to consensus.fit inside.
             result = mr_hdbscan.fit(data, params, mesh=mesh, trace=tracer)
-            mode = f"mr ({result.n_levels} levels)"
+            mode = (
+                f"mr-consensus ({params.consensus_draws} draws)"
+                if params.consensus_draws > 1
+                else f"mr ({result.n_levels} levels)"
+            )
         wall = time.monotonic() - t0
         fit_done = True
 

@@ -103,13 +103,18 @@ class HDBSCANParams:
     #: Cap on the glue set as a multiple of the per-block floor set
     #: (smallest margins kept first). Glue/refine round cost scales with
     #: the SQUARE of this factor when rounds go dense; measured at 4M
-    #: sep-7: factor 3 scores ARI-vs-truth 0.9558, factor 6 scores 0.9754
-    #: at ~2x the glue/refine wall (r3).
+    #: sep-7: factor 3 scores ARI-vs-truth 0.9558, factor 6 scores 0.9535
+    #: at ~1.1x the wall (r4 — growing the deep tier PARTIALLY is not a
+    #: quality lever; the 0.9754 high-water mark needs the whole tier,
+    #: see glue_row_budget = -1 below).
     glue_max_factor: int = 3
     #: Optional row-count TARGET for the glue/refine subset — the exact-tree
     #: FIDELITY knob. When > 0 and the factor-capped set is below it, the
     #: glue set grows with further at-risk rows (deep-crossing first, then
     #: ascending seam margin) until the budget or the at-risk pool runs out.
+    #: -1 = the whole deep-crossing tier with no at-risk filler and no cap
+    #: (glue = floor ∪ {margin <= glue_alpha*core}) — the 4M sep-7 quality
+    #: high-water composition (see models/mr_hdbscan._select_boundary).
     #: Measured at 1M sep-7 (boundary_eval_r4.jsonl): glue_rows=1048576
     #: lifts ARI-vs-exact 0.9058 -> 0.9507 (the r2 fidelity level) at 2x the
     #: boundary wall and slightly LOWER ARI-vs-truth (0.9459 -> 0.9266 —
@@ -178,8 +183,9 @@ class HDBSCANParams:
             raise ValueError("boundary_alpha must be > 0, glue_alpha >= 0")
         if self.glue_max_factor < 1:
             raise ValueError("glue_max_factor must be >= 1")
-        if self.glue_row_budget < 0:
-            raise ValueError("glue_row_budget must be >= 0")
+        if self.glue_row_budget < -1:
+            raise ValueError("glue_row_budget must be >= 0, or -1 for the "
+                             "uncapped deep-crossing tier")
         if self.consensus_draws < 1:
             raise ValueError("consensus_draws must be >= 1")
         if self.boundary_quality > 0 and self.dedup_points:

@@ -158,8 +158,11 @@ def _select_boundary(
     ``HDBSCANParams`` (VERDICT r3: a user could not buy the factor-6 ARI
     back without editing source).
 
-    ``return_floor``: also return the floor-only ids (the glue/refine row
-    set — always a subset of the union, one selection pass for both).
+    ``return_floor``: also return the glue/refine row ids — the floor plus
+    glue growth up to max(``glue_max_factor`` x floor, ``glue_row_budget``)
+    rows, or floor ∪ the whole UNCAPPED deep-crossing tier when
+    ``glue_row_budget`` is -1. Always a subset of the returned selection
+    (one selection pass covers both).
     """
     n = len(margin)
     _, inv = np.unique(subset, return_inverse=True)
@@ -186,16 +189,25 @@ def _select_boundary(
             # proportional cap when the floor itself is huge.
             deep = margin <= glue_alpha * core
             at_risk = margin <= alpha * core
-            budget = max(
-                (glue_max_factor - 1) * int(floor.sum()),
-                glue_row_budget - int(floor.sum()),
-            )
-            extra = np.nonzero((deep | at_risk) & ~floor)[0]
-            if len(extra) > budget:
-                order = np.lexsort(
-                    (margin[extra], ~deep[extra])
-                )  # deep tier first, then margin
-                extra = extra[order[:budget]]
+            if glue_row_budget < 0:
+                # The whole deep-crossing tier, uncapped, with NO at-risk
+                # filler: glue = floor ∪ deep. This is the composition that
+                # scored the 4M sep-7 quality high-water mark (ARI-vs-truth
+                # 0.9754, r3 pre-cap state 054ef0f); the factor cap
+                # truncates the deep tier and the positive budget fill
+                # dilutes it with at-risk rows — both measured worse there.
+                extra = np.nonzero(deep & ~floor)[0]
+            else:
+                budget = max(
+                    (glue_max_factor - 1) * int(floor.sum()),
+                    glue_row_budget - int(floor.sum()),
+                )
+                extra = np.nonzero((deep | at_risk) & ~floor)[0]
+                if len(extra) > budget:
+                    order = np.lexsort(
+                        (margin[extra], ~deep[extra])
+                    )  # deep tier first, then margin
+                    extra = extra[order[:budget]]
             floor = floor.copy()
             floor[extra] = True
         floor_ids = np.nonzero(floor)[0]
@@ -226,7 +238,10 @@ def _select_boundary(
         # Enforce the documented invariant glue ⊆ selected even when the
         # max_frac cap truncated the adaptive union (the cap preserves the
         # quantile floor but not the deep-crossing extras; the overshoot is
-        # bounded by the _GLUE_MAX_FACTOR cap on the glue set itself).
+        # bounded by the glue set's own cap — NOTE that glue_row_budget=-1
+        # removes that bound: the uncapped deep tier can approach n on
+        # dense-seam data, and its O(rows²·d) glue rounds with it — the
+        # fidelity-over-wall tradeoff that mode exists to buy).
         sel = sel.copy()
         sel[floor_ids] = True
     ids = np.nonzero(sel)[0]
@@ -413,6 +428,17 @@ def fit(
     result is broadcast back to row space.
     """
     params = params or HDBSCANParams()
+    if params.consensus_draws > 1:
+        # Centralized dispatch: consensus_draws must work for every caller,
+        # not only call sites that hand-roll the branch. consensus.fit
+        # re-enters here with consensus_draws=1 per draw (no recursion).
+        # Checkpointing is per-draw-disabled there by design.
+        from hdbscan_tpu.models import consensus
+
+        return consensus.fit(
+            data, params, mesh=mesh, max_levels=max_levels, trace=trace,
+            keep_edge_pool=keep_edge_pool,
+        )
     if params.dedup_points:
         if not params.global_core_distances:
             raise ValueError("dedup_points requires global_core_distances")

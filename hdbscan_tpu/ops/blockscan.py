@@ -765,14 +765,22 @@ def boruvka_glue_edges_blockpruned(
         bmin = np.minimum.reduceat(cs, geom.starts)
         bmax = np.maximum.reduceat(cs, geom.starts)
         block_comp = np.where(bmin == bmax, bmin, -2)
-        # Component labels on device, in both index spaces the kernels use:
-        # sorted column space (masking) and local row space (re-validation).
+        # Component labels in both index spaces the kernels use: sorted
+        # column space (masking) and local row space (re-validation). Shipped
+        # to device LAZILY — a dense round with no candidate buffers yet
+        # (typical for the earliest, biggest rounds) reads neither, and the
+        # ~(n_pad + m) int32 upload is real wall on the ~10-25 MB/s tunnel.
         comp_pad = np.full(geom.n_pad, -3, np.int32)
         comp_pad[:m] = cs
-        comp_sorted = jax.device_put(comp_pad)
         comp_local_np = np.full(m + 1, -9, np.int32)
         comp_local_np[:m] = cidx
-        comp_local = jax.device_put(comp_local_np)
+        _comp_dev_cache = []
+
+        def _comp_dev():
+            if not _comp_dev_cache:
+                _comp_dev_cache.append(jax.device_put(comp_pad))
+                _comp_dev_cache.append(jax.device_put(comp_local_np))
+            return _comp_dev_cache[0], _comp_dev_cache[1]
 
         # --- pass A: k-NN-graph candidates + per-component upper bounds ----
         bestA_w = np.full(m, np.inf)
@@ -794,6 +802,7 @@ def boruvka_glue_edges_blockpruned(
             # the cross-round maintenance that keeps mid-round pair
             # fractions from collapsing to the geometric backstop.
             n_seg_pad = 1 << max(0, (int(ncomp_dense) - 1).bit_length())
+            comp_sorted, comp_local = _comp_dev()
             cu = np.asarray(
                 jax.device_get(
                     _cand_comp_min(
@@ -860,6 +869,7 @@ def boruvka_glue_edges_blockpruned(
                 bestB_j = bj
             else:
                 jobs = _window_jobs(geom, pair_rows, pair_blocks)
+                comp_sorted, comp_local = _comp_dev()
                 if cand_w is None:
                     cand_w = jnp.full(
                         (m + 1, _CAND_F), jnp.inf, geom.data_sorted.dtype

@@ -90,6 +90,34 @@ def test_select_boundary_adaptive_core_criterion():
     assert len(sel_fixed) == 10
 
 
+def test_select_boundary_glue_budget_tiers():
+    """Glue-set growth: the positive budget fills deep-crossing rows first,
+    then at-risk rows by margin; -1 takes the WHOLE deep tier and nothing
+    else beyond the floor (the r3-054ef0f quality-high-water composition)."""
+    n = 100
+    margin = np.linspace(0.01, 1.0, n)
+    subset = np.zeros(n, np.int64)
+    core = np.full(n, 0.8)  # deep tier: margin <= 0.4 -> rows 0..39
+    deep = np.nonzero(margin <= 0.5 * 0.8)[0]
+    kw = dict(q=0.01, core=core, min_per_block=2, return_floor=True)
+    # -1: floor ∪ deep exactly, regardless of factor.
+    _, glue_deep = _select_boundary(
+        margin, subset, glue_max_factor=1, glue_row_budget=-1, **kw
+    )
+    assert set(glue_deep) == set(deep) | {0, 1}
+    # Positive budget below the deep-tier size: strict subset of deep rows
+    # (plus floor), smallest margins first.
+    _, glue_b = _select_boundary(
+        margin, subset, glue_max_factor=1, glue_row_budget=10, **kw
+    )
+    assert len(glue_b) == 10 and set(glue_b) <= set(deep)
+    # Budget past the deep tier: at-risk filler rows join by margin.
+    _, glue_big = _select_boundary(
+        margin, subset, glue_max_factor=1, glue_row_budget=60, **kw
+    )
+    assert set(deep) <= set(glue_big) and len(glue_big) == 60
+
+
 def test_select_boundary_caps_runaway_adaptive_set():
     """When the adaptive criterion would select (almost) everything, the set
     truncates at the max fraction — most-at-risk first, floor kept — and
