@@ -432,7 +432,17 @@ def fit(
         # Centralized dispatch: consensus_draws must work for every caller,
         # not only call sites that hand-roll the branch. consensus.fit
         # re-enters here with consensus_draws=1 per draw (no recursion).
-        # Checkpointing is per-draw-disabled there by design.
+        if checkpoint_dir is not None:
+            # Per-draw checkpointing is disabled by design (a consensus run
+            # is cheap multiples of a cheap run) — but the caller asked for
+            # it, so say so instead of silently writing nothing.
+            import warnings
+
+            warnings.warn(
+                "checkpoint_dir is ignored under consensus_draws > 1: "
+                "consensus draws re-run from scratch on failure",
+                stacklevel=2,
+            )
         from hdbscan_tpu.models import consensus
 
         return consensus.fit(

@@ -186,7 +186,12 @@ class BlockGeometry:
         )
 
     def candidate_pairs(
-        self, rows: np.ndarray, ub: np.ndarray, chunk: int = 1 << 16
+        self,
+        rows: np.ndarray,
+        ub: np.ndarray,
+        chunk: int = 1 << 16,
+        exclude: np.ndarray | None = None,
+        dc_rows: np.ndarray | None = None,
     ) -> tuple[np.ndarray, np.ndarray]:
         """(row_idx, block_idx) pairs whose block can intersect the row's ball.
 
@@ -194,20 +199,79 @@ class BlockGeometry:
         bounds. Exclusion: ``d(row, c_B) - r_B > ub`` implies every member of
         B is outside the ball (triangle inequality), with f64 slack. Chunked
         over rows so the (chunk, G) bound matrix — never the full (m, G) —
-        is the only dense temporary.
+        is the only dense temporary. ``exclude``: optional (m, P) block
+        indices per row already scanned elsewhere (the probe phase) — those
+        pairs are dropped from the result. ``dc_rows``: optional cached
+        (m, G) centroid distances (possibly f32 — compensated with a
+        distance-proportional slack, same rule as the glue's dc_cache).
         """
+        dc_rtol = 1e-6 if dc_rows is not None and dc_rows.dtype != np.float64 else 0.0
         prs, pbs = [], []
         for lo in range(0, len(rows), chunk):
             r = rows[lo : lo + chunk]
-            dc = _chunked_centroid_distances(r, self.centroid, self.metric)
+            if dc_rows is not None:
+                dc = dc_rows[lo : lo + len(r)]
+            else:
+                dc = _chunked_centroid_distances(r, self.centroid, self.metric)
             keep = (
-                dc - self.radius[None, :]
+                dc * (1 - dc_rtol) - self.radius[None, :]
                 <= ub[lo : lo + chunk, None] * (1 + _BOUND_RTOL) + _BOUND_ATOL
             )
             pr, pb = np.nonzero(keep)
+            if exclude is not None:
+                probed = (exclude[lo + pr] == pb[:, None]).any(axis=1)
+                pr, pb = pr[~probed], pb[~probed]
             prs.append(pr + lo)
             pbs.append(pb)
         return np.concatenate(prs), np.concatenate(pbs)
+
+    def centroid_distance_cache(self, rows: np.ndarray) -> np.ndarray | None:
+        """(m, G) f32 centroid-distance cache, or None past the 1 GB budget.
+
+        One O(m·G·d) host pass shared by ``probe_pairs`` and the phase-2
+        ``candidate_pairs`` (otherwise each pays its own); consumers add the
+        f32 distance-proportional slack (see ``candidate_pairs``)."""
+        m, g = len(rows), len(self.block_ids)
+        if m * g * 4 > (1 << 30):
+            return None
+        out = np.empty((m, g), np.float32)
+        chunk = 1 << 16
+        for lo in range(0, m, chunk):
+            out[lo : lo + chunk] = _chunked_centroid_distances(
+                rows[lo : lo + chunk], self.centroid, self.metric
+            )
+        return out
+
+    def probe_pairs(
+        self,
+        rows: np.ndarray,
+        n_probe: int,
+        chunk: int = 1 << 16,
+        dc_rows: np.ndarray | None = None,
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Each row's ``n_probe`` nearest blocks by centroid lower bound.
+
+        Returns (pair_rows, pair_blocks, probe (m, n_probe) int64) — the
+        first-phase scan set of the two-phase rescan: scanning these blocks
+        first yields a k-th-distance upper bound far tighter than the
+        per-block core, which then shrinks the second-phase candidate
+        windows (the ~n² FLOP growth driver at 8M — block radii shrink only
+        ~7% per doubling in 10-d, so per-row windows nearly double with
+        block count unless the ball radius itself tightens).
+        """
+        p = min(n_probe, len(self.block_ids))
+        probes = np.empty((len(rows), p), np.int64)
+        for lo in range(0, len(rows), chunk):
+            r = rows[lo : lo + chunk]
+            if dc_rows is not None:
+                dc = dc_rows[lo : lo + len(r)]
+            else:
+                dc = _chunked_centroid_distances(r, self.centroid, self.metric)
+            # Probe choice needs no f32 slack: ANY probe set is valid (it
+            # only seeds the upper bound); exactness lives in phase 2.
+            lb = dc - self.radius[None, :]
+            probes[lo : lo + len(r)] = np.argpartition(lb, p - 1, axis=1)[:, :p]
+        return np.repeat(np.arange(len(rows)), p), probes.reshape(-1), probes
 
 
 def _window_jobs(
@@ -518,6 +582,16 @@ def _cand_comp_min(cand_w, cand_i, comp_local, comp_sorted, n_seg: int):
     return jax.ops.segment_min(bw, seg, num_segments=n_seg + 1)
 
 
+#: Blocks probed per row in the first phase of the two-phase rescan (0
+#: disables the probe). The probe scans each row's n nearest blocks, and the
+#: resulting k-th distance replaces the per-block core as the ball-radius
+#: upper bound for the main candidate-window selection — the per-block core
+#: is inflated exactly where the boundary set lives (forced splits cut
+#: through dense regions), so phase-2 windows shrink several-fold at multi-M
+#: rows for a probe cost of ~n_probe windows/row.
+_KNN_PROBE_BLOCKS = 2
+
+
 def knn_rows_blockpruned(
     geom: BlockGeometry,
     row_ids: np.ndarray,
@@ -526,6 +600,7 @@ def knn_rows_blockpruned(
     return_neighbors: bool = False,
     row_tile: int = 256,
     neighbor_rows: np.ndarray | None = None,
+    probe_blocks: int = _KNN_PROBE_BLOCKS,
 ):
     """Exact core distances of selected rows via block-candidate windows.
 
@@ -537,6 +612,14 @@ def knn_rows_blockpruned(
     merge ON DEVICE (``_knn_window_merge_chunk``), so host transfer is one
     (m,) core fetch plus the requested neighbor lists — not the per-chunk
     (dists, ids) streams that made the round-3 rescan scale ~n^1.9.
+
+    Two-phase selection (``probe_blocks`` > 0): phase 1 scans each row's
+    nearest blocks and fetches the provisional k-th distance — a VALID ball
+    bound (the k-th of a distance subset only over-estimates the true k-th)
+    that is far tighter than ``ub`` wherever the per-block core is inflated;
+    phase 2 selects candidate windows under ``min(ub, probe k-th)``,
+    skipping the probed pairs, and merges into the same buffers. Exactness
+    is unchanged — only the window population shrinks.
 
     Returns ``core`` (m,). ``neighbor_rows`` (local indices into
     ``row_ids``) additionally returns those rows' (r, k) ascending neighbor
@@ -555,8 +638,6 @@ def knn_rows_blockpruned(
             return empty, np.zeros((0, k)), np.zeros((0, k), np.int64)
         return empty
     rows = geom.data_host[row_ids]
-    pair_rows, pair_blocks = geom.candidate_pairs(rows, np.asarray(ub, np.float64))
-    jobs = _window_jobs(geom, pair_rows, pair_blocks)
 
     # Jobs address rows by sorted-space index (device-side gather),
     # flattened to row tiles and dispatched in descending-pow2 tile chunks
@@ -569,29 +650,51 @@ def knn_rows_blockpruned(
 
     d = geom.data_host.shape[1]
     win_cols = geom.win_tiles * geom.col_tile
-    n_chunks = 0
-    for _metas, ids, starts, locs in _tiled_window_jobs(
-        jobs, lambda r: rows_sorted_pos[r], row_tile, dummy=m
-    ):
-        _flops.add_scan(
-            ids.shape[0] * row_tile, win_cols, d, row_tile=row_tile
+
+    def scan_jobs(jobs, best_d, best_i):
+        n_chunks = 0
+        for _metas, ids, starts, locs in _tiled_window_jobs(
+            jobs, lambda r: rows_sorted_pos[r], row_tile, dummy=m
+        ):
+            _flops.add_scan(
+                ids.shape[0] * row_tile, win_cols, d, row_tile=row_tile
+            )
+            best_d, best_i = _knn_window_merge_chunk(
+                best_d,
+                best_i,
+                jnp.asarray(ids),
+                jnp.asarray(locs),
+                geom.data_sorted,
+                geom.valid_sorted,
+                jnp.asarray(starts),
+                k,
+                geom.metric,
+                geom.col_tile,
+                geom.win_tiles,
+            )
+            n_chunks += 1
+            if n_chunks % _MERGE_SYNC_EVERY == 0:
+                jax.block_until_ready(best_d)
+        return best_d, best_i
+
+    ub = np.asarray(ub, np.float64)
+    probe = dc_cache = None
+    if probe_blocks > 0 and len(geom.block_ids) > probe_blocks:
+        dc_cache = geom.centroid_distance_cache(rows)
+        ppr, ppb, probe = geom.probe_pairs(rows, probe_blocks, dc_rows=dc_cache)
+        best_d, best_i = scan_jobs(_window_jobs(geom, ppr, ppb), best_d, best_i)
+        kth_idx = min(k, geom.n) - 1
+        probe_kth = np.asarray(
+            jax.device_get(best_d[:m, kth_idx]), np.float64
         )
-        best_d, best_i = _knn_window_merge_chunk(
-            best_d,
-            best_i,
-            jnp.asarray(ids),
-            jnp.asarray(locs),
-            geom.data_sorted,
-            geom.valid_sorted,
-            jnp.asarray(starts),
-            k,
-            geom.metric,
-            geom.col_tile,
-            geom.win_tiles,
-        )
-        n_chunks += 1
-        if n_chunks % _MERGE_SYNC_EVERY == 0:
-            jax.block_until_ready(best_d)
+        # inf where the probe found < k valid points; keep the caller's ub.
+        ub = np.where(np.isfinite(probe_kth), np.minimum(ub, probe_kth), ub)
+    pair_rows, pair_blocks = geom.candidate_pairs(
+        rows, ub, exclude=probe, dc_rows=dc_cache
+    )
+    best_d, best_i = scan_jobs(
+        _window_jobs(geom, pair_rows, pair_blocks), best_d, best_i
+    )
 
     if min_pts > 1:
         kth = min(k, geom.n) - 1

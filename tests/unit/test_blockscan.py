@@ -92,6 +92,47 @@ class TestPrunedCoreScan:
         picked = np.take_along_axis(np.sqrt(d2), knn_j, axis=1)
         np.testing.assert_allclose(picked, knn_d, rtol=1e-5, atol=1e-6)
 
+    def test_probe_phase_is_exact_and_tightens_windows(self, rng):
+        """Two-phase selection: probe on/off produce identical cores (both
+        equal to the full sweep), and the probe's tightened ball bound
+        selects no more phase-2 pairs than the per-block-core bound."""
+        pts, block_of = _blocky_data(rng)
+        min_pts = 8
+        ub = _per_block_cores(pts, block_of, min_pts)
+        # Inflate the caller's ub: the probe must recover tight bounds even
+        # when the per-block core is badly pessimistic (forced-split case).
+        ub_bad = ub * 5.0
+        bset = np.sort(rng.choice(len(pts), 700, replace=False))
+        geom = BlockGeometry.build(pts, block_of, col_tile=256)
+        got_probe = knn_rows_blockpruned(
+            geom, bset, ub_bad[bset], min_pts, row_tile=64, probe_blocks=2
+        )
+        got_plain = knn_rows_blockpruned(
+            geom, bset, ub_bad[bset], min_pts, row_tile=64, probe_blocks=0
+        )
+        np.testing.assert_allclose(got_probe, got_plain, rtol=1e-6)
+        want = tiled.knn_core_distances_rows(
+            pts, bset, min_pts, row_tile=64, col_tile=256
+        )
+        np.testing.assert_allclose(got_probe, want, rtol=1e-5, atol=1e-6)
+        # The probe k-th bound must not grow the candidate set — measured
+        # with the bound phase 2 ACTUALLY uses: min(caller ub, probe k-th),
+        # the probe k-th computed brute-force over each row's probe blocks.
+        rows = geom.data_host[bset]
+        n_plain = len(geom.candidate_pairs(rows, ub_bad[bset])[0])
+        ppr, ppb, probe = geom.probe_pairs(rows, 2)
+        kth = np.empty(len(bset))
+        for i, r in enumerate(bset):
+            cols = np.nonzero(np.isin(block_of, geom.block_ids[probe[i]]))[0]
+            dists = np.sort(np.linalg.norm(pts[cols] - pts[r], axis=1))
+            kth[i] = dists[min_pts - 2] if len(dists) >= min_pts - 1 else np.inf
+        ub2 = np.where(np.isfinite(kth), np.minimum(ub_bad[bset], kth), ub_bad[bset])
+        n_phase2 = len(geom.candidate_pairs(rows, ub2, exclude=probe)[0])
+        assert len(ppr) + n_phase2 <= n_plain
+        # And the tightened bound must genuinely shrink phase 2 vs the
+        # inflated caller ub (the point of probing).
+        assert n_phase2 < n_plain - len(ppr)
+
     def test_empty_and_single_block(self, rng):
         pts = rng.normal(size=(300, 4))
         geom = BlockGeometry.build(pts, np.zeros(300, np.int64), col_tile=128)
