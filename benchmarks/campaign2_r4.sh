@@ -1,0 +1,51 @@
+#!/bin/bash
+# Round-4 follow-up campaign — sequential (one TPU job at a time).
+# H/I: the two-phase probe rescan A/B at 4M/8M (vs legs E/F which ran the
+#      pre-probe code) — the VERDICT item-1 scaling evidence.
+# J:   glue_rows=-1 quality probe at the 4M stress shape (the r3-054ef0f
+#      composition behind the 0.9754 high-water mark).
+# K:   45-seed Skin consensus at 9 draws (cons5 reached std 0.012; target
+#      <= 0.01).
+# L:   pallas high-d legs re-run under the scale-aware tolerance.
+# M:   bench.py (median-of-3 protocol smoke on the real chip).
+set -u
+cd /root/repo
+mkdir -p logs_r4
+B=benchmarks
+log() { echo "[campaign2 $(date +%H:%M:%S)] $*" >> logs_r4/campaign.log; }
+
+log "H: 4M sep9 bound05 (two-phase probe)"
+python $B/boundary_eval.py 4000000 9.0 bound05 \
+  >> $B/boundary_eval_r4.jsonl 2> logs_r4/4M9_probe.log
+log "H done rc=$?"
+
+log "I: 8M sep9 bound05 (two-phase probe)"
+python $B/boundary_eval.py 8000000 9.0 bound05 \
+  >> $B/boundary_eval_r4.jsonl 2> logs_r4/8M9_probe.log
+log "I done rc=$?"
+
+log "G2: HEPMASS-class 10.5M x 28d plain-DB pipeline"
+python $B/highdim_eval.py 10500000 28 db \
+  >> $B/highdim_r4.jsonl 2> logs_r4/hepmass_10M5_db.log
+log "G2 done rc=$?"
+
+log "J: 4M sep7 bound05 glue_rows=-1"
+python $B/boundary_eval.py 4000000 7.0 bound05 glue_rows=-1 \
+  >> $B/boundary_eval_r4.jsonl 2> logs_r4/4M7_deepglue.log
+log "J done rc=$?"
+
+log "K: skin 45-seed consensus sweep (cons9)"
+python $B/seed_sweep.py 45 skin cons9 \
+  >> $B/seed_sweep45_skin_r4.jsonl 2> logs_r4/sweep_cons9.log
+log "K done rc=$?"
+
+log "L: pallas high-d legs rerun"
+python $B/pallas_knn_bench.py --datasets gauss500k_d28,gauss500k_d90 \
+  >> $B/pallas_r4.jsonl 2> logs_r4/pallas_highd2.log
+log "L done rc=$?"
+
+log "M: bench.py median-of-3"
+python bench.py > logs_r4/bench_smoke.json 2> logs_r4/bench_smoke.log
+log "M done rc=$?"
+
+log "campaign2 complete"

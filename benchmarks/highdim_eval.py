@@ -102,6 +102,28 @@ def main() -> None:
                     data, HDBSCANParams(**base, boundary_quality=0.05),
                     trace=tracer,
                 )
+            elif mode == "db":
+                # The plain recursive-sampling + bubbles pipeline, no
+                # boundary phase (per-block cores, bubble-weight pooling,
+                # no refinement — the reference-faithful cost shape). At
+                # d >= 28 this is the RIGHT tool: within-cluster block
+                # radii (~sigma*sqrt(2d)) exceed k-NN cores, so the
+                # boundary rescan's block pruning cannot exclude any
+                # same-cluster window and its work degenerates toward
+                # O(m * n) (measured: the 10.5M x 28 bound05 rescan
+                # projected ~1e18 FLOPs); meanwhile seams at this
+                # separation class are empty, so per-block core inflation
+                # does not move the flat cut.
+                r = mr_hdbscan.fit(
+                    data,
+                    HDBSCANParams(
+                        **base,
+                        global_core_distances=False,
+                        exact_inter_edges=False,
+                        refine_iterations=0,
+                    ),
+                    trace=tracer,
+                )
             else:
                 raise ValueError(mode)
             wall = time.time() - t0

@@ -226,13 +226,27 @@ class BlockGeometry:
         return np.concatenate(prs), np.concatenate(pbs)
 
     def centroid_distance_cache(self, rows: np.ndarray) -> np.ndarray | None:
-        """(m, G) f32 centroid-distance cache, or None past the 1 GB budget.
+        """(m, G) f32 centroid-distance cache, or None past the RAM budget.
 
-        One O(m·G·d) host pass shared by ``probe_pairs`` and the phase-2
-        ``candidate_pairs`` (otherwise each pays its own); consumers add the
-        f32 distance-proportional slack (see ``candidate_pairs``)."""
+        One O(m·G·d) host pass shared by every consumer that sweeps the
+        row-by-block bound matrix more than once (``probe_pairs`` +
+        ``candidate_pairs`` in the two-phase rescan; both sweeps of every
+        glue round). Budget: a quarter of currently-available RAM (f32
+        halves the footprint; at multi-M boundary sets the matrix runs to
+        double-digit GB, which a 125 GB bench host affords but a fixed 1 GB
+        cap never did). Consumers must apply the f32
+        distance-proportional slack (see ``candidate_pairs``)."""
         m, g = len(rows), len(self.block_ids)
-        if m * g * 4 > (1 << 30):
+        budget = 1 << 30
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemAvailable:"):
+                        budget = max(budget, int(line.split()[1]) * 1024 // 4)
+                        break
+        except OSError:
+            pass
+        if m * g * 4 > budget:
             return None
         out = np.empty((m, g), np.float32)
         chunk = 1 << 16
@@ -827,16 +841,10 @@ def boruvka_glue_edges_blockpruned(
     n_comp = len(np.unique(comp))
     # Centroid distances are ROUND-INVARIANT (rows and centroids never
     # change): cache the (m, G) matrix once instead of recomputing it in
-    # both sweeps of every round (2R full O(m·G·d) host passes). f32 halves
-    # the footprint; above a 1 GB budget fall back to per-chunk recompute.
+    # both sweeps of every round (2R full O(m·G·d) host passes); one budget
+    # policy shared with the two-phase rescan (centroid_distance_cache).
     chunk = max(1, (256 << 20) // (8 * g))
-    dc_cache = None
-    if m * g * 4 <= (1 << 30):
-        dc_cache = np.empty((m, g), np.float32)
-        for lo in range(0, m, chunk):
-            dc_cache[lo : lo + chunk] = _chunked_centroid_distances(
-                rows_all[lo : lo + chunk], geom.centroid, metric
-            )
+    dc_cache = geom.centroid_distance_cache(rows_all)
     # f32 rounding of the cached centroid distances is ABSOLUTE error
     # ~6e-8·dc — when block geometry is orders of magnitude larger than the
     # seam edge weight (upper ≲ 1e-6·dc, plausible at multi-M rows with
