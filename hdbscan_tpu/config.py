@@ -131,6 +131,22 @@ class HDBSCANParams:
     #: not fixable by refinement — ROADMAP r3). 1 = single draw (reference
     #: behavior).
     consensus_draws: int = 1
+    #: Probe-tightened boundary selection (boundary_quality mode, pruned
+    #: path only): before the exact core rescan, scan each at-risk row's
+    #: own + nearest blocks and re-test the at-risk criterion against the
+    #: resulting k-th distance (<= the per-block core by construction).
+    #: Rows failing margin <= alpha * probe-k-th keep their per-block core
+    #: (undamaged by the same ball-vs-seam argument that justifies the
+    #: selection) and skip the full rescan. MEASURED (r4): a no-op at
+    #: d >= 8 — in high dimension most of a 16k-row forced-split cell's
+    #: volume lies near its boundary, so ~all rows of a split cluster
+    #: genuinely have k-NN across the cut (50k x 8-d sep-9.5: tightening
+    #: kept 30,286 of 30,293 rows while paying an extra probe pass). The
+    #: ~99% at-risk fractions at multi-M are REAL damage, not block-core
+    #: pessimism; the rescan's ~n²/n_clusters FLOP floor follows. Default
+    #: off; worth enabling only on low-d data (2-3d: thin cell boundaries)
+    #: with seam-light structure.
+    probe_tighten: bool = False
     #: Collapse duplicate rows into weighted unique points before the exact
     #: pipeline (``core/dedup.py``). Semantics-preserving (a duplicate group
     #: is a zero-extent bubble; the member-weighted tree equals the full-row
@@ -260,6 +276,7 @@ FLAG_FIELDS = {
     "glue_alpha": ("glue_alpha", float),
     "glue_factor": ("glue_max_factor", int),
     "glue_rows": ("glue_row_budget", int),
+    "probe_tighten": ("probe_tighten", _bool),
     "consensus": ("consensus_draws", int),
     "block_pruning": ("boundary_block_pruning", _bool),
     "max_samples": ("max_samples", int),

@@ -922,6 +922,31 @@ def _fit_rows(
             glue_row_budget=params.glue_row_budget,
         )
         bset, bset_glue_sel = sel if pruned else (sel, sel)
+        geom_blocks = None
+        if pruned and params.probe_tighten and len(bset):
+            # Probe-tightened selection (opt-in, see config.probe_tighten:
+            # measured a no-op at d >= 8, where ~all rows of a forced-split
+            # cluster genuinely have k-NN across the cut): re-test the
+            # at-risk criterion against the probe's own+nearest-block k-th
+            # (<= the per-block core by construction); rows that clear it
+            # keep a provably-undamaged per-block core and skip the full
+            # rescan. Glue rows always stay (their neighbor lists seed the
+            # glue bounds).
+            from hdbscan_tpu.ops.blockscan import (
+                BlockGeometry,
+                knn_rows_blockpruned,
+            )
+
+            geom_blocks = BlockGeometry.build(data, final_block, metric)
+            kth_p = knn_rows_blockpruned(
+                geom_blocks, bset, core[bset], params.min_points,
+                probe_only=True,
+            )
+            keep = bmargin[bset] <= params.boundary_alpha * kth_p
+            in_glue = np.zeros(n, bool)
+            in_glue[bset_glue_sel] = True
+            keep |= in_glue[bset]
+            bset = bset[keep]
         if trace is not None:
             trace(
                 "boundary_select",
@@ -929,6 +954,7 @@ def _fit_rows(
                 m_glue=len(bset_glue_sel),
                 frac=round(len(bset) / n, 4),
                 pruned=pruned,
+                tightened=bool(pruned and params.probe_tighten),
                 wall_s=round(time.monotonic() - t0, 3),
             )
         # 2) Exact global core distances for boundary points only (their
@@ -955,7 +981,8 @@ def _fit_rows(
             bset_pos = np.full(n, -1, np.int64)
             bset_pos[bset] = np.arange(len(bset))
             sel_pos = bset_pos[bset_glue_sel]
-            geom_blocks = BlockGeometry.build(data, final_block, metric)
+            if geom_blocks is None:
+                geom_blocks = BlockGeometry.build(data, final_block, metric)
             core_b, knn_d_g, knn_j_gl = knn_rows_blockpruned(
                 geom_blocks,
                 bset,

@@ -256,12 +256,18 @@ class BlockGeometry:
             )
         return out
 
+    def block_of_rows(self, row_ids: np.ndarray) -> np.ndarray:
+        """(m,) dense block index of each row (by sorted-space span)."""
+        pos = self.inv_perm[row_ids]
+        return np.searchsorted(self.starts, pos, side="right") - 1
+
     def probe_pairs(
         self,
         rows: np.ndarray,
         n_probe: int,
         chunk: int = 1 << 16,
         dc_rows: np.ndarray | None = None,
+        self_blocks: np.ndarray | None = None,
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Each row's ``n_probe`` nearest blocks by centroid lower bound.
 
@@ -272,6 +278,12 @@ class BlockGeometry:
         windows (the ~n² FLOP growth driver at 8M — block radii shrink only
         ~7% per doubling in 10-d, so per-row windows nearly double with
         block count unless the ball radius itself tightens).
+
+        ``self_blocks``: optional (m,) dense block index per row, forced
+        into its probe set (slot 0) — guarantees the probe k-th never
+        exceeds the row's own per-block core (the own block can otherwise
+        lose the argpartition to other overlapping blocks, since several
+        blocks can carry a negative lower bound).
         """
         p = min(n_probe, len(self.block_ids))
         probes = np.empty((len(rows), p), np.int64)
@@ -284,6 +296,12 @@ class BlockGeometry:
             # Probe choice needs no f32 slack: ANY probe set is valid (it
             # only seeds the upper bound); exactness lives in phase 2.
             lb = dc - self.radius[None, :]
+            if self_blocks is not None:
+                # Push the own block to the front by making it unbeatable,
+                # so argpartition always keeps it.
+                np.put_along_axis(
+                    lb, self_blocks[lo : lo + len(r), None], -np.inf, axis=1
+                )
             probes[lo : lo + len(r)] = np.argpartition(lb, p - 1, axis=1)[:, :p]
         return np.repeat(np.arange(len(rows)), p), probes.reshape(-1), probes
 
@@ -615,6 +633,7 @@ def knn_rows_blockpruned(
     row_tile: int = 256,
     neighbor_rows: np.ndarray | None = None,
     probe_blocks: int = _KNN_PROBE_BLOCKS,
+    probe_only: bool = False,
 ):
     """Exact core distances of selected rows via block-candidate windows.
 
@@ -694,15 +713,30 @@ def knn_rows_blockpruned(
     ub = np.asarray(ub, np.float64)
     probe = dc_cache = None
     if probe_blocks > 0 and len(geom.block_ids) > probe_blocks:
-        dc_cache = geom.centroid_distance_cache(rows)
-        ppr, ppb, probe = geom.probe_pairs(rows, probe_blocks, dc_rows=dc_cache)
+        dc_cache = None if probe_only else geom.centroid_distance_cache(rows)
+        ppr, ppb, probe = geom.probe_pairs(
+            rows,
+            probe_blocks,
+            dc_rows=dc_cache,
+            self_blocks=geom.block_of_rows(row_ids),
+        )
         best_d, best_i = scan_jobs(_window_jobs(geom, ppr, ppb), best_d, best_i)
         kth_idx = min(k, geom.n) - 1
         probe_kth = np.asarray(
             jax.device_get(best_d[:m, kth_idx]), np.float64
         )
+        if probe_only:
+            # Selection-tightening mode: the caller only wants the probe's
+            # k-th upper bound (own block forced in, so it never exceeds
+            # the per-block core). min against ub keeps the contract
+            # "never worse than what the caller already knew".
+            return np.where(
+                np.isfinite(probe_kth), np.minimum(ub, probe_kth), ub
+            )
         # inf where the probe found < k valid points; keep the caller's ub.
         ub = np.where(np.isfinite(probe_kth), np.minimum(ub, probe_kth), ub)
+    elif probe_only:
+        return ub
     pair_rows, pair_blocks = geom.candidate_pairs(
         rows, ub, exclude=probe, dc_rows=dc_cache
     )
