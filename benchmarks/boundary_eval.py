@@ -117,6 +117,13 @@ def main() -> None:
             rec["ari_exact"] = round(
                 float(adjusted_rand_index(r.labels, exact_labels)), 4
             )
+        # Persist labels so any run can be re-scored post-hoc (e.g. against
+        # an exact tree computed LATER — the r4 glue-dial question needed
+        # exactly this and leg J's labels were gone).
+        otag = "_".join(
+            f"{k}={v}" for k, v in sorted(overrides.items())
+        ) if mode != "exact" else ""
+        np.save(f"/tmp/beval_labels_{mode}_{otag}_{n}_{sep}_{mcs}.npy", r.labels)
         print(json.dumps(rec), flush=True)
 
 

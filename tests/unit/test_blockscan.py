@@ -224,6 +224,42 @@ class TestPrunedGlue:
         want = {(min(a, b), max(a, b)) for a, b in zip(wu, wv)}
         assert got == want
 
+    def test_matches_dense_glue_under_forced_chunk_splits(self, rng, monkeypatch):
+        """Exactness when every dispatch is squeezed into MANY tiny chunks:
+        jobs split across chunk boundaries, pad tiles at every pow2 tail —
+        the regime production hits at multi-M rows (thousands of tiles per
+        round) that the default-budget tests never enter. Guards the window
+        -dispatch plumbing (job flattening, locs/dummy slots, cross-chunk
+        merges) against exactly the class of bug that could silently lose a
+        seam edge at scale while all small-dispatch tests stay green."""
+        import hdbscan_tpu.ops.blockscan as bs
+
+        monkeypatch.setattr(bs, "_BATCH_SLOT_BUDGET", 256)  # 4 tiles/chunk
+        monkeypatch.setattr(bs, "_MERGE_SYNC_EVERY", 2)
+        pts, block_of = _blocky_data(rng, n=1500, d=4)
+        min_pts = 6
+        core, _ = tiled.knn_core_distances(pts, min_pts, row_tile=64, col_tile=256)
+        knn_d, knn_j = self._knn_graph(pts, block_of, core, min_pts)
+        gu, gv, gw = boruvka_glue_edges_blockpruned(
+            pts, block_of, core, knn_d=knn_d, knn_j=knn_j, col_tile=256,
+            row_tile=64,
+        )
+        wu, wv, ww = tiled.boruvka_glue_edges(
+            pts, block_of, core=core, row_tile=64, col_tile=256
+        )
+        assert len(gu) == len(wu)
+        np.testing.assert_allclose(np.sort(gw), np.sort(ww), rtol=1e-5, atol=1e-6)
+        got = {(min(a, b), max(a, b)) for a, b in zip(gu, gv)}
+        want = {(min(a, b), max(a, b)) for a, b in zip(wu, wv)}
+        assert got == want
+        # And the rescan path under the same squeeze.
+        geom = BlockGeometry.build(pts, block_of, col_tile=256)
+        got_c = knn_rows_blockpruned(
+            geom, np.arange(len(pts)), np.full(len(pts), np.inf), min_pts,
+            row_tile=64,
+        )
+        np.testing.assert_allclose(got_c, core, rtol=1e-5, atol=1e-6)
+
     def test_single_group_empty(self, rng):
         pts = rng.normal(size=(200, 3))
         u, v, w = boruvka_glue_edges_blockpruned(
