@@ -1,0 +1,27 @@
+#!/bin/bash
+# Round-4 final package — the glue-dial science at 2M sep-7 (stress):
+#   exact tree (percolation check: does exact-vs-truth fall with density?)
+#   + default bound05 (truth optimum) + glue_rows=-1 (exact-fidelity end),
+# all sharing one exact-label cache so ari_exact lands on every row.
+set -u
+cd /root/repo
+mkdir -p logs_r4
+B=benchmarks
+log() { echo "[campaign3 $(date +%H:%M:%S)] $*" >> logs_r4/campaign.log; }
+
+log "N1: 2M sep7 exact + bound05"
+python $B/boundary_eval.py 2000000 7.0 exact,bound05 \
+  >> $B/boundary_eval_r4.jsonl 2> logs_r4/2M7_exact.log
+log "N1 done rc=$?"
+
+log "N2: 2M sep7 bound05 glue_rows=-1"
+python $B/boundary_eval.py 2000000 7.0 bound05 glue_rows=-1 \
+  >> $B/boundary_eval_r4.jsonl 2> logs_r4/2M7_deepglue.log
+log "N2 done rc=$?"
+
+log "O: pallas d90 retry (VMEM-fixed col tile)"
+python $B/pallas_knn_bench.py --datasets gauss500k_d90 \
+  >> $B/pallas_r4.jsonl 2> logs_r4/pallas_d90_retry.log
+log "O done rc=$?"
+
+log "campaign3 complete"

@@ -280,6 +280,13 @@ def knn_core_distances_pallas(
     k = max(k or 0, max(min_pts - 1, 1))
     if k > LANES:
         raise ValueError(f"pallas knn kernel supports k <= {LANES}, got {k}")
+    if d >= 64 and col_tile > 1024:
+        # The diff-form column loop holds more live (row_tile, col_tile)
+        # temporaries as d grows; at d=90 the default 256x2048 tile
+        # overflows the 16 MB scoped VMEM by ~1 MB (measured: compile-time
+        # OOM). Halving the column tile keeps every shape under the limit
+        # at ~unchanged throughput (the grid doubles instead).
+        col_tile = 1024
     perm = None
     if order == "diag":
         perm = morton_order(data)
