@@ -71,7 +71,10 @@ def main() -> None:
     mp = args.min_pts
     for name, data in sets.items():
         legs = {
-            "xla_scan": lambda d=data: knn_core_distances(d, mp)[0],
+            # backend="xla" pins the baseline: at d >= 24 the default now
+            # auto-dispatches to the pallas kernel, which would make this
+            # leg compare the kernel against itself.
+            "xla_scan": lambda d=data: knn_core_distances(d, mp, backend="xla")[0],
             "pallas_scan": lambda d=data: knn_core_distances_pallas(
                 d, mp, order="scan"
             )[0],

@@ -298,6 +298,11 @@ def knn_core_distances_pallas(
     x[:n, :d] = data
     colmask = np.full((1, n_pad), np.inf, np.float32)
     colmask[0, :n] = 0.0
+    from hdbscan_tpu.utils.flops import counter as _flops
+
+    # Same convention as the XLA scan's accounting: logical (rows, cols, d)
+    # of the padded sweep, so MFU reports stay comparable across backends.
+    _flops.add_scan(n_pad, n_pad, d, row_tile=row_tile)
     xj, xtj, mj = jax.device_put((x, np.ascontiguousarray(x.T), colmask))
     d2 = knn_smallest_pallas(
         xj, xtj, mj, d, k,
