@@ -1,0 +1,274 @@
+"""Device-timed kernel microbench — on-chip rate vs dispatch overhead.
+
+VERDICT r4 "what's missing" #1: every phase MFU figure (0.03-0.45%) divides
+analytic FLOPs by WALL that includes host candidate building, chunked
+dispatch round trips, and tunnel fetches — so "the pipeline is
+dispatch/transfer-bound, kernels are not worth optimizing" (ROADMAP r4 item
+6) was asserted, never isolated. This bench isolates it:
+
+- ``dispatch_latency``: median round trip of a trivial program — the
+  per-dispatch floor the tunnel imposes.
+- ``matmul_floor``: the tiled euclidean distance expansion with a ONE-PASS
+  min reduction instead of top_k, one big program, block_until_ready-timed.
+  The arithmetic ceiling of any scan schedule on this chip.
+- ``scan_body``: the production ``_knn_core_scan`` body (distance + per-tile
+  ``lax.top_k`` merge) as ONE program on the same shape. matmul_floor vs
+  scan_body = the price of exact selection; scan_body vs scan_e2e = the
+  price of chunked dispatch + transfers.
+- ``scan_e2e``: the public ``knn_core_distances`` wall on the same data
+  (chunked dispatch, host round trips) — what the pipeline actually pays.
+- ``rescan_chunk_T{n}``: the boundary rescan's ``_knn_window_merge_chunk``
+  at production geometry (256-row tiles x 4-tile windows), chained
+  donated-buffer calls at two chunk sizes — the dispatch-amortization curve
+  of the phase that dominates multi-M walls.
+
+FLOP convention matches ``utils/flops`` (2*rows*cols*d logical; the
+f32-HIGHEST cross matmul runs ~6 bf16 passes, so a perfectly MXU-bound
+euclidean scan tops out near PEAK/6 — compare legs RELATIVE to that
+ceiling). Counterpart being replaced: the reference's runtime tables
+(ResearchReport.pdf §5.4) — here the table is per-kernel, on-device.
+
+Rows append to ``benchmarks/devicebench_r5.jsonl`` with full config echo.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hdbscan_tpu.core.distances import pairwise_distance
+from hdbscan_tpu.utils.flops import PEAK_FLOPS
+
+
+def _time_call(fn, iters: int, warmup: int = 1):
+    """Median wall of ``iters`` calls, after ``warmup``.
+
+    Each call's (small) result is fetched with ``jax.device_get``: on the
+    tunneled axon platform ``block_until_ready`` returns without waiting for
+    the remote device (measured: a 1.8 TFLOP program "completed" in 0.1 ms),
+    so a host fetch is the only reliable completion barrier. Timed programs
+    must return a REDUCED result (scalar/vector) so the fetch itself stays
+    off the critical path (~10-25 MB/s tunnel)."""
+    for _ in range(warmup):
+        jax.device_get(fn())
+    walls = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.device_get(fn())
+        walls.append(time.perf_counter() - t0)
+    return float(np.median(walls)), [round(min(walls), 4), round(max(walls), 4)]
+
+
+def _emit(out_path, row):
+    row = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"), **row}
+    print(json.dumps(row), flush=True)
+    with open(out_path, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+@partial(jax.jit, static_argnames=("metric", "row_tile", "col_tile"))
+def _dist_min_scan(rows, data, valid, metric: str, row_tile: int, col_tile: int):
+    """The scan loop structure of ``_knn_core_scan`` with the cheapest
+    possible reduction (rowwise running min) in place of top_k: the
+    arithmetic floor of the schedule."""
+    n_pad = data.shape[0]
+    n_col_tiles = n_pad // col_tile
+    inf = jnp.array(jnp.inf, data.dtype)
+
+    def row_step(r):
+        xr = jax.lax.dynamic_slice_in_dim(rows, r * row_tile, row_tile)
+
+        def col_step(c, best):
+            xc = jax.lax.dynamic_slice_in_dim(data, c * col_tile, col_tile)
+            vc = jax.lax.dynamic_slice_in_dim(valid, c * col_tile, col_tile)
+            d = pairwise_distance(xr, xc, metric)
+            d = jnp.where(vc[None, :], d, inf)
+            return jnp.minimum(best, jnp.min(d, axis=1))
+
+        return jax.lax.fori_loop(
+            0, n_col_tiles, col_step, jnp.full((row_tile,), jnp.inf, data.dtype)
+        )
+
+    n_row_tiles = rows.shape[0] // row_tile
+    return jax.lax.map(row_step, jnp.arange(n_row_tiles)).reshape(-1)
+
+
+def bench_exact_scan(out_path, n=500_000, d=28, k=15, iters=3, seed=0):
+    """matmul_floor / scan_body / scan_e2e triplet at the 500k x 28 shape
+    (the r4 pallas-campaign shape: XLA 41.9 s, pallas dot 30.3 s)."""
+    from hdbscan_tpu.ops.tiled import _knn_core_scan, _tile_sizes, _pad_rows
+
+    rng = np.random.default_rng(seed)
+    data = rng.normal(size=(n, d)).astype(np.float32)
+    row_tile, col_tile, n_pad = _tile_sizes(n, 1024, 8192)
+    data_p = jnp.asarray(_pad_rows(data, n_pad))
+    valid_p = jnp.asarray(np.arange(n_pad) < n)
+    chunk = 1 << 16  # one big program: ~1.8 TFLOP logical at d=28
+    rows = data_p[:chunk]
+    flops = 2.0 * chunk * n_pad * d
+
+    base = dict(
+        n=n, d=d, k=k, n_pad=n_pad, chunk_rows=chunk, row_tile=row_tile,
+        col_tile=col_tile, iters=iters, seed=seed, device=str(jax.devices()[0]),
+        peak_flops=PEAK_FLOPS,
+    )
+
+    wall, spread = _time_call(
+        lambda: jnp.sum(
+            _dist_min_scan(rows, data_p, valid_p, "euclidean", row_tile, col_tile)
+        ),
+        iters,
+    )
+    _emit(out_path, dict(
+        leg="matmul_floor", wall_s=round(wall, 4), spread_s=spread,
+        gflops=round(flops / 1e9, 1), gflops_s=round(flops / wall / 1e9, 1),
+        mfu=round(flops / wall / PEAK_FLOPS, 5), **base,
+    ))
+
+    for guarded in (False, True):
+        wall, spread = _time_call(
+            lambda: jnp.sum(
+                _knn_core_scan(
+                    rows, data_p, valid_p, k, "euclidean", row_tile, col_tile,
+                    guarded=guarded,
+                )[0]
+            ),
+            iters,
+        )
+        _emit(out_path, dict(
+            leg="scan_body" + ("_guarded" if guarded else ""),
+            wall_s=round(wall, 4), spread_s=spread,
+            gflops=round(flops / 1e9, 1), gflops_s=round(flops / wall / 1e9, 1),
+            mfu=round(flops / wall / PEAK_FLOPS, 5), **base,
+        ))
+
+    from hdbscan_tpu.ops.tiled import knn_core_distances
+
+    flops_full = 2.0 * n_pad * n_pad * d
+    for guarded in (False, True):
+        walls = []
+        for _ in range(max(1, iters - 1)):
+            t0 = time.perf_counter()
+            knn_core_distances(
+                data, k + 1, "euclidean", backend="xla",
+                fetch_knn=False, guarded=guarded,
+            )
+            walls.append(time.perf_counter() - t0)
+        wall = float(np.median(walls))
+        _emit(out_path, dict(
+            leg="scan_e2e" + ("_guarded" if guarded else ""),
+            wall_s=round(wall, 4),
+            spread_s=[round(min(walls), 4), round(max(walls), 4)],
+            gflops=round(flops_full / 1e9, 1),
+            gflops_s=round(flops_full / wall / 1e9, 1),
+            mfu=round(flops_full / wall / PEAK_FLOPS, 5), **base,
+        ))
+
+
+def bench_dispatch_latency(out_path, iters=50):
+    x = jnp.zeros(8, jnp.float32)
+    f = jax.jit(lambda a: a + 1.0)
+    wall, spread = _time_call(lambda: f(x), iters, warmup=3)
+    _emit(out_path, dict(
+        leg="dispatch_latency", wall_s=round(wall, 6), spread_s=spread,
+        iters=iters, device=str(jax.devices()[0]),
+    ))
+
+
+def bench_rescan_chunk(out_path, n=1_000_000, d=10, k=15, win_tiles=4,
+                       row_tile=256, col_tile=8192, chunk_tiles=(64, 1024),
+                       iters=3, seed=0):
+    """``_knn_window_merge_chunk`` at production rescan geometry, chained
+    donated-buffer calls: the on-chip rate of the phase that dominates
+    multi-M boundary walls (r4: 51.9-94.9 GFLOP/s incl. host time)."""
+    from hdbscan_tpu.ops.blockscan import _knn_window_merge_chunk
+
+    rng = np.random.default_rng(seed)
+    n_pad = -(-n // col_tile) * col_tile
+    data = rng.normal(size=(n_pad, d)).astype(np.float32)
+    data_dev = jax.device_put(data)
+    valid_dev = jax.device_put(np.arange(n_pad) < n)
+    n_tiles = n_pad // col_tile
+    base = dict(
+        n=n, d=d, k=k, win_tiles=win_tiles, row_tile=row_tile,
+        col_tile=col_tile, iters=iters, seed=seed,
+        device=str(jax.devices()[0]), peak_flops=PEAK_FLOPS,
+    )
+    for t_chunk in chunk_tiles:
+        m = t_chunk * row_tile
+        # Production jobs address CONTIGUOUS runs of the block-sorted copy
+        # (each job is one block's rows); random ids would benchmark HBM
+        # gather pathology the real path never pays. Each tile's rows sit
+        # inside its own window.
+        starts = (
+            rng.integers(0, max(1, n_tiles - win_tiles), size=t_chunk) * col_tile
+        ).astype(np.int32)
+        ids = (
+            starts[:, None] + np.arange(row_tile, dtype=np.int32)[None, :]
+        ).astype(np.int32)
+        locs = np.arange(m, dtype=np.int32).reshape(t_chunk, row_tile)
+        ids_d, locs_d, starts_d = jax.device_put((ids, locs, starts))
+        flops = 2.0 * m * win_tiles * col_tile * d
+
+        def run(prime: bool):
+            bd = jnp.full((m + 1, k), jnp.inf, jnp.float32)
+            bi = jnp.full((m + 1, k), -1, jnp.int32)
+            bd, bi = _knn_window_merge_chunk(
+                bd, bi, ids_d, locs_d, data_dev, valid_dev, starts_d,
+                k, "euclidean", col_tile, win_tiles,
+            )
+            if prime:
+                # Second pass over the SAME windows with primed buffers —
+                # the production main-phase condition (probe primed the
+                # bounds); measures the guard's skip rate, not just the
+                # fast-lowering effect.
+                bd, bi = _knn_window_merge_chunk(
+                    bd, bi, ids_d, locs_d, data_dev, valid_dev, starts_d,
+                    k, "euclidean", col_tile, win_tiles,
+                )
+            return jnp.sum(jnp.where(jnp.isfinite(bd), bd, 0.0))
+
+        wall_cold, spread = _time_call(lambda: run(False), iters)
+        wall_both, spread2 = _time_call(lambda: run(True), iters)
+        for leg, wall, spr in (
+            (f"rescan_chunk_T{t_chunk}", wall_cold, spread),
+            (f"rescan_chunk_T{t_chunk}_primed",
+             max(wall_both - wall_cold, 1e-9), spread2),
+        ):
+            _emit(out_path, dict(
+                leg=leg, wall_s=round(wall, 4),
+                spread_s=spr, tiles=t_chunk, rows=m,
+                gflops=round(flops / 1e9, 1),
+                gflops_s=round(flops / wall / 1e9, 1),
+                mfu=round(flops / wall / PEAK_FLOPS, 5), **base,
+            ))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "devicebench_r5.jsonl"))
+    ap.add_argument("--legs", default="dispatch,exact,rescan")
+    ap.add_argument("--iters", type=int, default=3)
+    args = ap.parse_args()
+    legs = args.legs.split(",")
+    if "dispatch" in legs:
+        bench_dispatch_latency(args.out)
+    if "exact" in legs:
+        bench_exact_scan(args.out, iters=args.iters)
+    if "rescan" in legs:
+        bench_rescan_chunk(args.out, iters=args.iters)
+
+
+if __name__ == "__main__":
+    main()

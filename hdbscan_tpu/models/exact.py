@@ -57,7 +57,8 @@ def mst_edges(
     """
     n = len(data)
     core, _ = knn_core_distances(
-        data, min_pts, metric, row_tile=row_tile, col_tile=col_tile, dtype=dtype
+        data, min_pts, metric, row_tile=row_tile, col_tile=col_tile, dtype=dtype,
+        fetch_knn=False,
     )
     if trace is not None:
         trace("core_distances", n=n)
@@ -212,7 +213,8 @@ def mst_edges_random_blocks(
 
     n = len(data)
     core, _ = knn_core_distances(
-        data, min_pts, metric, row_tile=row_tile, col_tile=col_tile, dtype=dtype
+        data, min_pts, metric, row_tile=row_tile, col_tile=col_tile, dtype=dtype,
+        fetch_knn=False,
     )
     if trace is not None:
         trace("core_distances", n=n)

@@ -105,12 +105,12 @@ class MRHDBSCANResult:
 #: exact 0.70; adaptive selection restores 0.99 — ROADMAP "Scaling").
 _BOUNDARY_ALPHA = 1.0
 
-#: Hard cap on the boundary-set fraction. The adaptive criterion is
-#: open-ended by design (it selects whatever the data's seam population
-#: demands), but past ~half the dataset the boundary phase's O(m·n·d) scan
-#: approaches the full exact scan the mode exists to avoid — at that point
-#: exact/fullq is the right tool, so the selection truncates (most-at-risk
-#: first, floor preserved) and warns instead of silently paying ~n².
+#: Default hard cap on the boundary-set fraction (config.boundary_max_frac
+#: since r5 — VERDICT r4 weak #6; see that field's docstring). The adaptive
+#: criterion is open-ended by design; past ~half the dataset the non-pruned
+#: O(m·n·d) scan approaches the full exact scan the mode exists to avoid,
+#: so the selection truncates (most-at-risk first, floor preserved) and
+#: warns instead of silently paying ~n².
 _BOUNDARY_MAX_FRAC = 0.5
 
 #: Glue-set criterion: rows whose seam margin is within this fraction of
@@ -573,7 +573,9 @@ def _fit_rows(
                 data, weights, params.min_points, metric
             )
         else:
-            core, _ = knn_core_distances(data, params.min_points, metric)
+            core, _ = knn_core_distances(
+                data, params.min_points, metric, fetch_knn=False
+            )
     n_dev = 1
     if mesh is not None:
         n_dev = math.prod(mesh.devices.shape)
@@ -914,7 +916,7 @@ def _fit_rows(
             final_block,
             boundary_q,
             core=core,
-            max_frac=1.0 if pruned else _BOUNDARY_MAX_FRAC,
+            max_frac=1.0 if pruned else params.boundary_max_frac,
             return_floor=pruned,
             alpha=params.boundary_alpha,
             glue_alpha=params.glue_alpha,

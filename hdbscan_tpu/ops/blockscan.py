@@ -55,6 +55,14 @@ PRUNABLE_METRICS = ("euclidean", "manhattan", "supremum")
 _BOUND_RTOL = 1e-4
 _BOUND_ATOL = 1e-9
 
+#: Fraction of MemAvailable the (m, G) centroid-distance cache may claim,
+#: and the per-row reserve subtracted first for the phase's later host
+#: temporaries (candidate-pair int arrays ~16 B/pair at several pairs/row,
+#: glue/neighbor buffers). Module constants so tests and tight hosts can
+#: lower them (ADVICE r4).
+_CACHE_RAM_FRACTION = 0.25
+_CACHE_ROW_RESERVE_BYTES = 64
+
 
 def _chunked_centroid_distances(
     rows: np.ndarray, centroids: np.ndarray, metric: str, chunk: int = 1 << 16
@@ -231,18 +239,28 @@ class BlockGeometry:
         One O(m·G·d) host pass shared by every consumer that sweeps the
         row-by-block bound matrix more than once (``probe_pairs`` +
         ``candidate_pairs`` in the two-phase rescan; both sweeps of every
-        glue round). Budget: a quarter of currently-available RAM (f32
-        halves the footprint; at multi-M boundary sets the matrix runs to
-        double-digit GB, which a 125 GB bench host affords but a fixed 1 GB
-        cap never did). Consumers must apply the f32
-        distance-proportional slack (see ``candidate_pairs``)."""
+        glue round). Budget: ``_CACHE_RAM_FRACTION`` of currently-available
+        RAM minus an m-proportional reserve for the phase's LATER host
+        temporaries — candidate-pair index arrays, glue buffers, neighbor
+        lists — which allocate after this snapshot and used to be able to
+        OOM a shared host the snapshot had seen as free (ADVICE r4).
+        Consumers must apply the f32 distance-proportional slack (see
+        ``candidate_pairs``)."""
         m, g = len(rows), len(self.block_ids)
         budget = 1 << 30
         try:
             with open("/proc/meminfo") as f:
                 for line in f:
                     if line.startswith("MemAvailable:"):
-                        budget = max(budget, int(line.split()[1]) * 1024 // 4)
+                        avail = int(line.split()[1]) * 1024
+                        free = max(avail - m * _CACHE_ROW_RESERVE_BYTES, 0)
+                        # The 1 GiB legacy floor must not override the
+                        # reserve math on tight hosts — cap it by what is
+                        # actually free after the reserve.
+                        budget = max(
+                            min(budget, free),
+                            int(free * _CACHE_RAM_FRACTION),
+                        )
                         break
         except OSError:
             pass
@@ -280,10 +298,11 @@ class BlockGeometry:
         block count unless the ball radius itself tightens).
 
         ``self_blocks``: optional (m,) dense block index per row, forced
-        into its probe set (slot 0) — guarantees the probe k-th never
-        exceeds the row's own per-block core (the own block can otherwise
-        lose the argpartition to other overlapping blocks, since several
-        blocks can carry a negative lower bound).
+        into the probe set membership via a -inf sentinel (argpartition
+        gives no positional guarantee, and none is needed) — guarantees the
+        probe k-th never exceeds the row's own per-block core (the own
+        block can otherwise lose the argpartition to other overlapping
+        blocks, since several blocks can carry a negative lower bound).
         """
         p = min(n_probe, len(self.block_ids))
         probes = np.empty((len(rows), p), np.int64)
@@ -464,11 +483,24 @@ def _knn_window_merge_chunk(
     tunnel and made the rescan scale ~n^1.9 (VERDICT r3 item 1): the merged
     result now leaves the device once, as (m,) cores plus the glue subset's
     neighbor lists.
+
+    Selection guard (r5): the per-column-tile exact ``top_k`` merge — ~90%
+    of the on-chip scan cost by the r5 microbench — is wrapped in
+    ``lax.cond`` on ``any(d < bound)`` (strict — see the inline comment),
+    where ``bound`` is the row's CURRENT buffer k-th (gathered once per
+    tile job) tightening to the tile-local k-th as the window progresses. An element above the bound can
+    never enter the final dedup-merged list (dedup only ever removes a
+    duplicate between the two merged lists, so the buffer k-th is a
+    monotone upper bound), so skipped tiles cost distance + one compare and
+    the result is exact. The probe phase primes the buffers, which is what
+    makes the bound tight from the first main-phase tile.
     """
     inf = jnp.array(jnp.inf, data.dtype)
     row_tile = ids.shape[1]
 
-    def scan_tile(tids, cs):
+    from hdbscan_tpu.ops.tiled import _merge_sorted_k
+
+    def scan_tile(tids, cs, bnd):
         xr = jnp.take(data, tids, axis=0)
 
         def col_step(c, carry):
@@ -478,25 +510,33 @@ def _knn_window_merge_chunk(
             vc = jax.lax.dynamic_slice_in_dim(valid, base, col_tile)
             dmat = pairwise_distance(xr, xc, metric)
             dmat = jnp.where(vc[None, :], dmat, inf)
-            cols = base + jax.lax.broadcasted_iota(
-                jnp.int32, (row_tile, col_tile), 1
+
+            def merge(carry):
+                best, bidx = carry
+                nv, ni = jax.lax.top_k(-dmat, k)  # k smallest, ascending
+                return _merge_sorted_k(best, bidx, -nv, ni + base, k)
+
+            # Strict <: an element equal to the bound can never change the
+            # merged VALUES (k entries <= it already exist across the two
+            # dedup-merged lists), and id ties are "some k nearest" by
+            # contract — while on tie-heavy (lattice) data and re-scanned
+            # overlap columns strict inequality is what lets tiles skip.
+            bound = jnp.minimum(best[:, k - 1], bnd)
+            return jax.lax.cond(
+                jnp.any(dmat < bound[:, None]), merge, lambda c: c, carry
             )
-            merged = jnp.concatenate([best, -dmat], axis=1)
-            merged_i = jnp.concatenate([bidx, cols], axis=1)
-            new_best, sel = jax.lax.top_k(merged, k)
-            return new_best, jnp.take_along_axis(merged_i, sel, axis=1)
 
         init = (
-            jnp.full((row_tile, k), -jnp.inf, data.dtype),
+            jnp.full((row_tile, k), jnp.inf, data.dtype),
             jnp.full((row_tile, k), -1, jnp.int32),
         )
-        best, bidx = jax.lax.fori_loop(0, n_win_tiles, col_step, init)
-        return -best, bidx
+        return jax.lax.fori_loop(0, n_win_tiles, col_step, init)
 
     def body(t, carry):
         bd, bi = carry
         loc = locs[t]
-        nd, ni = scan_tile(ids[t], col_starts[t])
+        bnd = jnp.take(bd[:, k - 1], loc)
+        nd, ni = scan_tile(ids[t], col_starts[t], bnd)
         md, mi = _merge_knn_device(
             jnp.take(bd, loc, axis=0), jnp.take(bi, loc, axis=0), nd, ni, k
         )
@@ -534,11 +574,23 @@ def _min_out_window_merge_chunk(
     that merged into the row's component is stale FOREVER — components only
     merge — so its weight is inf-ed ahead of the dedup merge). Sequential
     ``lax.fori_loop`` over tiles keeps multi-job rows correct on device.
+
+    Selection guard (r5, as in ``_knn_window_merge_chunk``): the exact
+    ``top_k`` merge per column tile runs under ``lax.cond`` on
+    ``any(w < bound)`` (strict — see the inline comment), with ``bound``
+    the row's worst still-valid retained candidate (inf when any slot is stale or empty — those rows never skip).
+    Exactness of the Borůvka contraction is preserved: the row hosting a
+    component's true minimum outgoing edge has ``bound >= w*`` (its retained
+    candidates are real foreign edges of the same component, so none can be
+    lighter than the component minimum), hence the tile holding that edge
+    always merges.
     """
     inf = jnp.array(jnp.inf, data.dtype)
     row_tile = ids.shape[1]
 
-    def scan_tile(tids, cs):
+    from hdbscan_tpu.ops.tiled import _merge_sorted_k
+
+    def scan_tile(tids, cs, bnd):
         x = jnp.take(data, tids, axis=0)
         c = jnp.take(core, tids)
         kk = jnp.take(comp_sorted, tids)
@@ -554,31 +606,38 @@ def _min_out_window_merge_chunk(
             w = jnp.maximum(dmat, jnp.maximum(c[:, None], cc[None, :]))
             out = (kk[:, None] != kc[None, :]) & vc[None, :]
             w = jnp.where(out, w, inf)
-            cols = base + jax.lax.broadcasted_iota(
-                jnp.int32, (row_tile, col_tile), 1
+
+            def merge(carry):
+                bw, bi = carry
+                nv, ni = jax.lax.top_k(-w, f)  # f smallest, ascending
+                return _merge_sorted_k(bw, bi, -nv, ni + base, f)
+
+            # Strict <: if the component's true min edge ties the bound
+            # exactly, the row's retained candidates at that same weight are
+            # equally valid min edges (the tie-contracted merge forest is
+            # invariant to which equal-weight edge is emitted).
+            bound = jnp.minimum(bw[:, f - 1], bnd)
+            return jax.lax.cond(
+                jnp.any(w < bound[:, None]), merge, lambda c: c, carry
             )
-            merged = jnp.concatenate([bw, -w], axis=1)
-            merged_i = jnp.concatenate([bi, cols], axis=1)
-            nb, sel = jax.lax.top_k(merged, f)
-            return nb, jnp.take_along_axis(merged_i, sel, axis=1)
 
         init = (
-            jnp.full((row_tile, f), -jnp.inf, data.dtype),
+            jnp.full((row_tile, f), jnp.inf, data.dtype),
             jnp.full((row_tile, f), -1, jnp.int32),
         )
-        bw, bi = jax.lax.fori_loop(0, n_win_tiles, col_step, init)
-        return -bw, bi
+        return jax.lax.fori_loop(0, n_win_tiles, col_step, init)
 
     def body(t, carry):
         cw, ci = carry
         loc = locs[t]
-        nw, ni = scan_tile(ids[t], col_starts[t])
         cur_w = jnp.take(cw, loc, axis=0)
         cur_i = jnp.take(ci, loc, axis=0)
         row_comp = jnp.take(comp_local, loc)
         tgt_comp = jnp.take(comp_sorted, jnp.maximum(cur_i, 0))
         stale = (cur_i >= 0) & (tgt_comp == row_comp[:, None])
         cur_w = jnp.where(stale, inf, cur_w)
+        bnd = jnp.max(cur_w, axis=1)
+        nw, ni = scan_tile(ids[t], col_starts[t], bnd)
         mw, mi = _merge_knn_device(cur_w, cur_i, nw, ni, f)
         return cw.at[loc].set(mw), ci.at[loc].set(mi)
 

@@ -260,6 +260,7 @@ def knn_core_distances_pallas(
     order: str = "diag",
     form: str = "diff",
     interpret: bool = False,
+    fetch_knn: bool = True,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Drop-in for ``ops.tiled.knn_core_distances`` (euclidean only).
 
@@ -272,7 +273,10 @@ def knn_core_distances_pallas(
     the distance tiles onto the MXU (full-f32 passes) — faster, but
     near-duplicate distances become approximate (~eps·|x|² absolute), the
     same profile as the XLA dot form; keep ``"diff"`` when duplicate
-    exactness matters.
+    exactness matters. ``fetch_knn=False`` returns ``(core, None)`` and
+    fetches only the k-th column — without it the full (n, k) list crosses
+    the ~10-25 MB/s tunnel even for callers that discard it (caught by the
+    r5 review: the auto-dispatched production path ignored the flag).
     """
     n, d = data.shape
     if d > LANES:
@@ -309,6 +313,17 @@ def knn_core_distances_pallas(
         row_tile=row_tile, col_tile=col_tile, order=order, form=form,
         interpret=interpret,
     )
+    if not fetch_knn:
+        kth_col = min(max(min_pts - 1, 1), n) - 1
+        kth = np.sqrt(
+            np.maximum(np.asarray(d2[:, kth_col], np.float64)[:n], 0.0)
+        )
+        if perm is not None:
+            inv = np.empty_like(perm)
+            inv[perm] = np.arange(n)
+            kth = kth[inv]
+        core = np.zeros(n, np.float64) if min_pts <= 1 else kth
+        return core, None
     knn = np.sqrt(np.maximum(np.asarray(d2, np.float64)[:n, :k], 0.0))
     if perm is not None:
         inv = np.empty_like(perm)
