@@ -46,6 +46,10 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from hdbscan_tpu.utils.cache import enable_persistent_compilation_cache
+
+enable_persistent_compilation_cache()
+
 from hdbscan_tpu.core.distances import pairwise_distance
 from hdbscan_tpu.utils.flops import PEAK_FLOPS
 
@@ -157,6 +161,13 @@ def bench_exact_scan(out_path, n=500_000, d=28, k=15, iters=3, seed=0):
     flops_full = 2.0 * n_pad * n_pad * d
     for guarded in (False, True):
         walls = []
+        # One untimed warmup so the recorded median excludes one-time XLA
+        # compiles (the pre-fix rows mixed up to ~50% compile into the leg
+        # this bench exists to adjudicate — r5 review finding).
+        knn_core_distances(
+            data, k + 1, "euclidean", backend="xla",
+            fetch_knn=False, guarded=guarded,
+        )
         for _ in range(max(1, iters - 1)):
             t0 = time.perf_counter()
             knn_core_distances(
@@ -220,38 +231,29 @@ def bench_rescan_chunk(out_path, n=1_000_000, d=10, k=15, win_tiles=4,
         ids_d, locs_d, starts_d = jax.device_put((ids, locs, starts))
         flops = 2.0 * m * win_tiles * col_tile * d
 
-        def run(prime: bool):
+        def run():
             bd = jnp.full((m + 1, k), jnp.inf, jnp.float32)
             bi = jnp.full((m + 1, k), -1, jnp.int32)
-            bd, bi = _knn_window_merge_chunk(
+            out = _knn_window_merge_chunk(
                 bd, bi, ids_d, locs_d, data_dev, valid_dev, starts_d,
                 k, "euclidean", col_tile, win_tiles,
-            )
-            if prime:
-                # Second pass over the SAME windows with primed buffers —
-                # the production main-phase condition (probe primed the
-                # bounds); measures the guard's skip rate, not just the
-                # fast-lowering effect.
-                bd, bi = _knn_window_merge_chunk(
-                    bd, bi, ids_d, locs_d, data_dev, valid_dev, starts_d,
-                    k, "euclidean", col_tile, win_tiles,
-                )
-            return jnp.sum(jnp.where(jnp.isfinite(bd), bd, 0.0))
+            )[0]
+            return jnp.sum(jnp.where(jnp.isfinite(out), out, 0.0))
 
-        wall_cold, spread = _time_call(lambda: run(False), iters)
-        wall_both, spread2 = _time_call(lambda: run(True), iters)
-        for leg, wall, spr in (
-            (f"rescan_chunk_T{t_chunk}", wall_cold, spread),
-            (f"rescan_chunk_T{t_chunk}_primed",
-             max(wall_both - wall_cold, 1e-9), spread2),
-        ):
-            _emit(out_path, dict(
-                leg=leg, wall_s=round(wall, 4),
-                spread_s=spr, tiles=t_chunk, rows=m,
-                gflops=round(flops / 1e9, 1),
-                gflops_s=round(flops / wall / 1e9, 1),
-                mfu=round(flops / wall / PEAK_FLOPS, 5), **base,
-            ))
+        # (A "primed second pass over the same windows" leg was tried and
+        # removed: identical windows re-merge every sub-k element, so it
+        # models neither the production probe/main split — which EXCLUDES
+        # probed pairs — nor the guard's real skip behavior, and its
+        # derived wall made spread_s incoherent. Production skip evidence
+        # comes from the pipeline phase traces instead.)
+        wall, spread = _time_call(run, iters)
+        _emit(out_path, dict(
+            leg=f"rescan_chunk_T{t_chunk}", wall_s=round(wall, 4),
+            spread_s=spread, tiles=t_chunk, rows=m,
+            gflops=round(flops / 1e9, 1),
+            gflops_s=round(flops / wall / 1e9, 1),
+            mfu=round(flops / wall / PEAK_FLOPS, 5), **base,
+        ))
 
 
 def main():

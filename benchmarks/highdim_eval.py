@@ -29,6 +29,10 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from hdbscan_tpu.utils.cache import enable_persistent_compilation_cache
+
+enable_persistent_compilation_cache()
+
 from hdbscan_tpu import HDBSCANParams
 from hdbscan_tpu.models import exact, mr_hdbscan
 from hdbscan_tpu.utils.datasets import make_gauss

@@ -1,0 +1,112 @@
+"""probe_tighten adjudication (VERDICT r4 item 8).
+
+The knob shipped opt-in in r4 with only no-op measurements (d >= 8). Its
+hypothesized home is LOW-d data (2-3d: forced-split cells have thin
+boundaries, so a probe-tightened at-risk test can actually clear interior
+rows). This harness runs boundary mode with probe_tighten on/off on:
+
+- Skin (245k x 3, the bundled real dataset, lattice-valued), and
+- a 3-d Gauss synthetic (500k x 3, sep 9 — separated, seam-light).
+
+Emits one JSON line per (dataset, probe_tighten) with the boundary-select
+trace fields (m kept vs at-risk), wall, and ARI. Keep-or-attic decision
+lands in ROADMAP. Rows append to benchmarks/probe_tighten_r5.jsonl.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hdbscan_tpu.utils.cache import enable_persistent_compilation_cache
+
+enable_persistent_compilation_cache()
+
+from hdbscan_tpu import HDBSCANParams
+from hdbscan_tpu.models import mr_hdbscan
+from hdbscan_tpu.utils.datasets import make_gauss
+from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+from hdbscan_tpu.utils.io import load_points
+from hdbscan_tpu.utils.tracing import Tracer
+
+SKIN_PATH = "/root/reference/数据集/Skin_NonSkin.txt"
+OUT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "probe_tighten_r5.jsonl")
+
+
+def run(name, data, truth, params):
+    for pt in (False, True):
+        tracer = Tracer(stream=None)
+        t0 = time.time()
+        r = mr_hdbscan.fit(data, params.replace(probe_tighten=pt), trace=tracer)
+        wall = time.time() - t0
+        sel = [e for e in tracer.events if e.name == "boundary_select"]
+        rec = {
+            "dataset": name,
+            "n": len(data),
+            "dims": data.shape[1],
+            "probe_tighten": pt,
+            "wall_s": round(wall, 2),
+            "ari_truth": round(float(adjusted_rand_index(r.labels, truth)), 4)
+            if truth is not None
+            else None,
+            "boundary_select": sel[0].fields if sel else None,
+            "params": {
+                "min_points": params.min_points,
+                "min_cluster_size": params.min_cluster_size,
+                "processing_units": params.processing_units,
+                "k": params.k,
+                "boundary_quality": params.boundary_quality,
+                "seed": params.seed,
+            },
+        }
+        line = json.dumps(rec)
+        print(line, flush=True)
+        with open(OUT_PATH, "a") as f:
+            f.write(line + "\n")
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "skin,gauss3d"
+    if "skin" in which:
+        raw = load_points(SKIN_PATH)
+        data, truth = raw[:, :3], raw[:, 3].astype(np.int64)
+        run(
+            "skin",
+            data,
+            truth,
+            HDBSCANParams(
+                min_points=8,
+                min_cluster_size=3000,
+                processing_units=8192,
+                k=0.03,
+                seed=0,
+                boundary_quality=0.05,
+            ),
+        )
+    if "gauss3d" in which:
+        data, truth = make_gauss(
+            500_000, dims=3, n_clusters=12, separation=9.0, seed=5
+        )
+        run(
+            "gauss3d",
+            data,
+            truth,
+            HDBSCANParams(
+                min_points=8,
+                min_cluster_size=5000,
+                processing_units=16384,
+                k=0.01,
+                seed=0,
+                boundary_quality=0.05,
+            ),
+        )
+
+
+if __name__ == "__main__":
+    main()

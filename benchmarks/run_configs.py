@@ -36,6 +36,10 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from hdbscan_tpu.utils.cache import enable_persistent_compilation_cache
+
+enable_persistent_compilation_cache()
+
 IRIS = "/root/reference/数据集/dataset.txt"
 SKIN = "/root/reference/数据集/Skin_NonSkin.txt"
 SKIN_DB_BASELINE = 60.19

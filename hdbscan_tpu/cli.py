@@ -7,9 +7,9 @@ Usage mirrors the reference's documented contract (``main/Main.java:534-614``)::
         [dist_function={euclidean,cosine,pearson,manhattan,supremum}] \
         [out_dir=DIR] [seed=N] [variant={db,rs}] [dedup={true,false}] \
         [exact_inter_edges={true,false}] [global_cores={true,false}] [refine=N] \
-        [boundary=F] [boundary_alpha=F] [glue_alpha=F] [glue_factor=N] \
-        [glue_rows=N] [block_pruning={true,false}] [consensus=N] \
-        [compat_cf={true,false}] \
+        [boundary=F] [boundary_alpha=F] [boundary_max_frac=F] [glue_alpha=F] \
+        [glue_factor=N] [glue_rows=N] [block_pruning={true,false}] \
+        [consensus=N] [compat_cf={true,false}] \
         [clusterName={local,auto,<host:port>,<pid>,<np>}]
 
 Unlike the reference, argv is actually honored (the reference shadows it with
@@ -60,7 +60,10 @@ def main(argv: list[str] | None = None) -> int:
     import numpy as np
 
     from hdbscan_tpu.models import hdbscan, mr_hdbscan
+    from hdbscan_tpu.utils.cache import enable_persistent_compilation_cache
     from hdbscan_tpu.utils.io import load_points
+
+    enable_persistent_compilation_cache()
 
     # Multi-controller SPMD driving (the reference's Spark master+executors,
     # main/Main.java:89-95, re-mapped): every process runs the SAME
@@ -134,6 +137,14 @@ def main(argv: list[str] | None = None) -> int:
                 )
             for kind, path in paths.items():
                 print(f"  {kind}: {path}")
+            if getattr(result, "consensus_info", None) is not None:
+                print(
+                    "note: consensus run — partition.csv and outlier scores "
+                    "describe the stabilized ensemble reading; hierarchy/tree "
+                    "files describe the representative draw (see the "
+                    "consensus provenance sidecar).",
+                    file=sys.stderr,
+                )
             # Boundary/refine phase summary (VERDICT r3 item 9): walls,
             # selected fractions, and achieved FLOP rates without Python.
             phase_names = (

@@ -358,6 +358,18 @@ def _window_jobs(
 #: per-program size.
 _BATCH_SLOT_BUDGET = 1 << 21
 
+#: Minimum tile-chunk length (tiles) for window dispatch. Small rounds (the
+#: seam probe's per-component jobs, late Borůvka rounds, tiny glue sets)
+#: used to emit pow2 chunks of 1, 2, 4, ... tiles — every distinct length a
+#: fresh XLA compile of the merge kernel (~7-40 s each on the tunneled
+#: chip). Measured r5 at 1M sep-9: the glue phase did 53 GFLOP of real work
+#: in 199 s of wall — almost all shape-variety compiles. Padding every
+#: chunk up to >= 64 tiles caps the compiled-shape set at ~8 per kernel
+#: (64..8192); a pad tile scans one window into the dummy row (~8 GFLOP per
+#: fully-padded chunk at d=10 — milliseconds, vs tens of seconds per
+#: avoided compile).
+_MIN_CHUNK_TILES = 64
+
 
 def _tiled_window_jobs(
     jobs: list[tuple[int, np.ndarray]],
@@ -395,22 +407,28 @@ def _tiled_window_jobs(
         metas.append((col_start, ridx, t_total, t))
         t_total += t
     max_chunk = max(1, _BATCH_SLOT_BUDGET // row_tile)
+    min_chunk = min(_MIN_CHUNK_TILES, max_chunk)
     lo = 0
     mi = 0  # metas index; consumed in order (jobs laid out consecutively)
     while lo < t_total:
-        take = min(max_chunk, t_total - lo)
-        take = 1 << (take.bit_length() - 1)  # pow2 floor: no pad tiles
+        rem = t_total - lo
+        # pow2-ceil the tail (padded with dummy tiles), clamped to
+        # [min_chunk, max_chunk]: the compiled-shape set stays logarithmic
+        # AND bounded below (see _MIN_CHUNK_TILES — sub-64-tile shapes were
+        # a compile storm on probe/late rounds).
+        take = min(max_chunk, max(min_chunk, 1 << (rem - 1).bit_length()))
+        n_real = min(take, rem)
         ids = np.zeros((take, row_tile), np.int32)
         locs = np.full((take, row_tile), dummy, np.int32)
         starts = np.zeros(take, np.int32)
         chunk_metas = []
         while mi < len(metas):
             col_start, ridx, t_lo, t_n = metas[mi]
-            if t_lo >= lo + take:
+            if t_lo >= lo + n_real:
                 break
-            # Portion of this job's tile span inside [lo, lo + take).
+            # Portion of this job's tile span inside [lo, lo + n_real).
             a = max(t_lo, lo)
-            b = min(t_lo + t_n, lo + take)
+            b = min(t_lo + t_n, lo + n_real)
             row_a = (a - t_lo) * row_tile
             row_b = min((b - t_lo) * row_tile, len(ridx))
             if row_b > row_a:
@@ -421,12 +439,12 @@ def _tiled_window_jobs(
                 lflat[: len(seg)] = ridx[row_a:row_b]
                 starts[a - lo : b - lo] = col_start
                 chunk_metas.append((ridx[row_a:row_b], a - lo, b - a))
-            if t_lo + t_n <= lo + take:
+            if t_lo + t_n <= lo + n_real:
                 mi += 1
             else:
                 break
         yield chunk_metas, ids, starts, locs
-        lo += take
+        lo += n_real
 
 
 def _merge_knn_device(cur_d, cur_i, new_d, new_i, k: int):
@@ -554,6 +572,15 @@ def _knn_window_merge_chunk(
 #: upper bounds into later rounds: when a row's best target merges into its
 #: component, the next-best retained candidate (next seam over) takes over.
 _CAND_F = 8
+
+#: Seam-probe rows per geometric-bound component and round: each such
+#: component's best rows (smallest geometric bound) scan their nearest
+#: foreign block before pair extraction, converting the loose
+#: ``d(i,c_B)+r_B`` backstop into a real achievable edge weight. Cost is
+#: ~rows x one window each; the payoff is the pass-B pair population
+#: (ROADMAP r4 lever: mid-round fallbacks to dense at 0.35-0.49 pair
+#: fractions).
+_SEAM_PROBE_ROWS = 8
 
 
 @partial(
@@ -1022,7 +1049,10 @@ def boruvka_glue_edges_blockpruned(
         # first tighten the per-component achievable-edge upper bound
         # (``max(d(i,c_B)+r_B, core_i, maxcore_B)`` upper-bounds a REAL edge
         # into B, so thresholds are always attainable), then keep the (i, B)
-        # pairs whose lower bound could beat the threshold.
+        # pairs whose lower bound could beat the threshold. Sweep 1 also
+        # records each row's best foreign block (the seam-probe targets).
+        row_geo = np.full(m, np.inf)
+        row_geo_b = np.full(m, -1, np.int64)
         for lo in range(0, m, chunk):
             r = slice(lo, lo + chunk)
             dcc = _dc(r)
@@ -1032,7 +1062,94 @@ def boruvka_glue_edges_blockpruned(
                 np.maximum(core[r, None], maxcore_b[None, :]),
             )
             ub2 = np.where(foreign_c, ub2, np.inf)
-            np.minimum.at(upper, cidx[r], ub2.min(axis=1))
+            rb = np.argmin(ub2, axis=1)
+            rv = ub2[np.arange(len(rb)), rb]
+            row_geo[r] = rv
+            row_geo_b[r] = np.where(np.isfinite(rv), rb, -1)
+            np.minimum.at(upper, cidx[r], rv)
+
+        def scan_window_pairs(pr, pb):
+            """Window-scan (row, block) pairs into the cross-round candidate
+            buffers (device-resident merge; shared by the seam probe and the
+            main windowed pass)."""
+            nonlocal cand_w, cand_i
+            jobs = _window_jobs(geom, pr, pb)
+            comp_sorted, comp_local = _comp_dev()
+            if cand_w is None:
+                cand_w = jnp.full(
+                    (m + 1, _CAND_F), jnp.inf, geom.data_sorted.dtype
+                )
+                cand_i = jnp.full((m + 1, _CAND_F), -1, jnp.int32)
+            from hdbscan_tpu.utils.flops import counter as _flops
+
+            win_cols = geom.win_tiles * geom.col_tile
+            n_chunks = 0
+            for _metas, idsc, starts, locs in _tiled_window_jobs(
+                jobs, lambda r: geom.inv_perm[r], row_tile, dummy=m
+            ):
+                _flops.add_scan(
+                    idsc.shape[0] * row_tile,
+                    win_cols,
+                    data.shape[1],
+                    row_tile=row_tile,
+                )
+                cand_w, cand_i = _min_out_window_merge_chunk(
+                    cand_w,
+                    cand_i,
+                    jnp.asarray(idsc),
+                    jnp.asarray(locs),
+                    geom.data_sorted,
+                    core_sorted,
+                    comp_sorted,
+                    comp_local,
+                    geom.valid_sorted,
+                    jnp.asarray(starts),
+                    _CAND_F,
+                    metric,
+                    geom.col_tile,
+                    geom.win_tiles,
+                )
+                n_chunks += 1
+                if n_chunks % _MERGE_SYNC_EVERY == 0:
+                    jax.block_until_ready(cand_w)
+
+        # --- seam probe (r5, VERDICT item 2 / ROADMAP r4 lever): components
+        # whose upper bound is still the loose geometric backstop (no live
+        # k-NN or retained candidate — the "never window-scanned rows" of
+        # mid-Borůvka rounds) get their best seam rows scanned against their
+        # nearest foreign block BEFORE pair extraction. The scan yields REAL
+        # achievable edges, so ``upper`` drops from d(i,c_B)+r_B (a block-
+        # radius-sized overestimate at 16k-point blocks) to ~the true seam
+        # weight, and the lb test prunes the pair population that used to
+        # trip the dense fallback (pair fractions 0.35-0.49 at 4M sep-9).
+        comp_geo = _segment_min(row_geo, cidx, ncomp_dense)
+        geo_bound = upper >= comp_geo * (1 - 1e-12)
+        if geo_bound.any() and g > 1:
+            need = geo_bound[cidx] & (row_geo_b >= 0)
+            rows_n = np.nonzero(need)[0]
+            if len(rows_n):
+                order_p = np.lexsort((row_geo[rows_n], cidx[rows_n]))
+                rows_n = rows_n[order_p]
+                cn = cidx[rows_n]
+                first = np.concatenate([[True], np.diff(cn) != 0])
+                starts_p = np.nonzero(first)[0]
+                rank = np.arange(len(rows_n)) - np.repeat(
+                    starts_p, np.diff(np.concatenate([starts_p, [len(rows_n)]]))
+                )
+                sel_p = rows_n[rank < _SEAM_PROBE_ROWS]
+                scan_window_pairs(sel_p, row_geo_b[sel_p])
+                n_seg_pad = 1 << max(0, (int(ncomp_dense) - 1).bit_length())
+                comp_sorted, comp_local = _comp_dev()
+                cu = np.asarray(
+                    jax.device_get(
+                        _cand_comp_min(
+                            cand_w, cand_i, comp_local, comp_sorted, n_seg_pad
+                        )
+                    ),
+                    np.float64,
+                )[:ncomp_dense]
+                upper = np.minimum(upper, cu)
+
         pair_rows_l, pair_blocks_l = [], []
         for lo in range(0, m, chunk):
             r = slice(lo, lo + chunk)
@@ -1072,45 +1189,8 @@ def boruvka_glue_edges_blockpruned(
                 bestB_w = bw
                 bestB_j = bj
             else:
-                jobs = _window_jobs(geom, pair_rows, pair_blocks)
+                scan_window_pairs(pair_rows, pair_blocks)
                 comp_sorted, comp_local = _comp_dev()
-                if cand_w is None:
-                    cand_w = jnp.full(
-                        (m + 1, _CAND_F), jnp.inf, geom.data_sorted.dtype
-                    )
-                    cand_i = jnp.full((m + 1, _CAND_F), -1, jnp.int32)
-                from hdbscan_tpu.utils.flops import counter as _flops
-
-                win_cols = geom.win_tiles * geom.col_tile
-                n_chunks = 0
-                for _metas, idsc, starts, locs in _tiled_window_jobs(
-                    jobs, lambda r: geom.inv_perm[r], row_tile, dummy=m
-                ):
-                    _flops.add_scan(
-                        idsc.shape[0] * row_tile,
-                        win_cols,
-                        data.shape[1],
-                        row_tile=row_tile,
-                    )
-                    cand_w, cand_i = _min_out_window_merge_chunk(
-                        cand_w,
-                        cand_i,
-                        jnp.asarray(idsc),
-                        jnp.asarray(locs),
-                        geom.data_sorted,
-                        core_sorted,
-                        comp_sorted,
-                        comp_local,
-                        geom.valid_sorted,
-                        jnp.asarray(starts),
-                        _CAND_F,
-                        metric,
-                        geom.col_tile,
-                        geom.win_tiles,
-                    )
-                    n_chunks += 1
-                    if n_chunks % _MERGE_SYNC_EVERY == 0:
-                        jax.block_until_ready(cand_w)
                 # One (m,) fetch: each row's best still-foreign candidate.
                 # Scanned rows offer this round's exact window minimum;
                 # other rows offer retained candidates — real foreign edges,

@@ -1,0 +1,71 @@
+"""Production-budget engaged-tiling mesh leg at >= 100k points (VERDICT r4
+item 7 / r3 item 8b).
+
+The dryrun's 20k engaged-tiling leg lowers ``_BATCH_SLOT_BUDGET`` /
+``_DISPATCH_ROWS`` so multi-chunk window dispatch engages at toy sizes. This
+test runs the boundary-mode pipeline at 131,072 points on the 8-device
+virtual mesh with PRODUCTION budgets untouched, asserting mesh == unsharded
+labels — the window machinery (probe phase, candidate windows, device-side
+best-k merges, pruned glue rounds) all engage at this size under the real
+dispatch parameters.
+
+Honest scope note: multi-CHUNK window dispatch (> 1 pow2 chunk per rescan)
+at the production ``_BATCH_SLOT_BUDGET`` of 2^21 row slots mathematically
+requires > ~2M boundary-row tile slots, which no CPU-mesh test can afford;
+that axis is covered by (a) the forced-chunk-split exactness test
+(tests/e2e/test_mr_pipeline.py, lowered budget, same code path) and (b) the
+real-chip multi-M campaign rows whose ARI-vs-truth pins end-to-end
+correctness (benchmarks/boundary_eval_r*.jsonl).
+
+Slow tier: ~minutes on the CPU mesh — gated behind HDBSCAN_TPU_SLOW=1 so
+the default suite stays fast. Run with:
+    HDBSCAN_TPU_SLOW=1 python -m pytest tests/e2e/test_mesh_100k.py -q
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("HDBSCAN_TPU_SLOW"),
+    reason="slow tier: set HDBSCAN_TPU_SLOW=1 (production-budget 131k mesh leg)",
+)
+
+
+def test_mesh_boundary_131k_production_budgets():
+    import jax
+
+    from hdbscan_tpu.config import HDBSCANParams
+    from hdbscan_tpu.models import mr_hdbscan
+    from hdbscan_tpu.ops import blockscan, tiled
+    from hdbscan_tpu.parallel.mesh import get_mesh
+    from hdbscan_tpu.utils.datasets import make_gauss
+
+    # Production budgets must be in force — this test exists to exercise
+    # them (the dryrun leg lowers both).
+    assert blockscan._BATCH_SLOT_BUDGET == 1 << 21
+    assert tiled._DISPATCH_ROWS == 1 << 17
+
+    n = 1 << 17  # 131,072 >= the verdict's 100k bar
+    data, truth = make_gauss(n, dims=4, n_clusters=12, separation=9.0, seed=3)
+    params = HDBSCANParams(
+        min_points=6,
+        min_cluster_size=n // 100,
+        processing_units=8192,
+        seed=0,
+        k=0.02,
+        boundary_quality=0.05,
+    )
+    mesh = get_mesh(jax.devices()[:8])
+    r_mesh = mr_hdbscan.fit(data, params, mesh=mesh)
+    r_ref = mr_hdbscan.fit(data, params)
+    assert np.array_equal(r_mesh.labels, r_ref.labels), (
+        "production-budget boundary fit diverges between mesh and unsharded"
+    )
+    # Sanity: the run actually exercised the windowed machinery (blocks +
+    # boundary selection happened, quality sane on a separated synthetic).
+    from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+
+    ari = adjusted_rand_index(r_mesh.labels, truth)
+    assert ari > 0.98, f"ARI vs truth {ari:.4f} unexpectedly low"

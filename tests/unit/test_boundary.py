@@ -124,7 +124,9 @@ def test_select_boundary_caps_runaway_adaptive_set():
     warns instead of silently paying a ~full exact scan."""
     import warnings
 
-    from hdbscan_tpu.models.mr_hdbscan import _BOUNDARY_MAX_FRAC
+    from hdbscan_tpu.config import HDBSCANParams as _P
+
+    _BOUNDARY_MAX_FRAC = _P.boundary_max_frac
 
     n = 1000
     margin = np.linspace(0.0, 1.0, n)
