@@ -61,10 +61,122 @@ def oracle_core_check(data, min_pts, sample=512, seed=0):
     return float(abs_err.max()), float(rel_err.max())
 
 
+def bounds_probe(data, y, min_pts, cap, seed=0, n_rows=2048, n_pivots=8,
+                 proj_dims=8):
+    """Exclusion-rate analytics for three rescan pruning bounds at high d
+    (VERDICT r5 item 5's prototype, measured WITHOUT paying the rescan).
+
+    Geometry = the forced-split regime: each true cluster's rows split into
+    cap-sized blocks (what the pipeline's forced splits produce at this
+    separation class). For a row sample with EXACT k-NN cores as ball radii
+    (the tightest possible ub), measures the fraction of (row, block) pairs
+    excluded by:
+
+    - ``ball``: the production centroid/radius bound d(i,c_B) - r_B > ub;
+    - ``pivot``: sample-pivot triangle bounds — max over P pivots of
+      max(d(i,p) - hi_p(B), lo_p(B) - d(i,p)) > ub, with [lo,hi] the
+      block's distance interval to each pivot (strictly tighter family);
+    - ``proj``: orthogonal-projection contraction — the same centroid/radius
+      test in an r-dim projection (projected distances lower-bound true
+      ones; projected radii shrink ~sqrt(r/d)).
+
+    Split by same-cluster vs other-cluster blocks: the high-d question is
+    whether ANY bound can exclude same-cluster blocks (theory says no —
+    covering a d=28 gaussian with balls of radius < core needs exp(d)
+    balls; this measures how far from 'no' the practical bounds land).
+    """
+    rng = np.random.default_rng(seed)
+    n, d = data.shape
+    # Forced-split blocks: cluster-sorted rows cut into cap-sized chunks.
+    order = np.argsort(y, kind="stable")
+    block_of = np.empty(n, np.int64)
+    block_of[order] = np.arange(n) // cap
+    blocks = np.unique(block_of)
+    g = len(blocks)
+    centroid = np.stack([data[block_of == b].mean(axis=0) for b in blocks])
+    radius = np.array([
+        np.sqrt(((data[block_of == b] - centroid[i]) ** 2).sum(axis=1)).max()
+        for i, b in enumerate(blocks)
+    ])
+    block_cluster = np.array([y[block_of == b][0] for b in blocks])
+
+    rows = rng.choice(n, n_rows, replace=False)
+    from hdbscan_tpu.ops.tiled import knn_core_distances_rows
+
+    ub = knn_core_distances_rows(data, rows, min_pts)
+
+    x = data[rows]
+    dc = np.sqrt(
+        np.maximum(
+            (x**2).sum(1)[:, None] + (centroid**2).sum(1)[None, :]
+            - 2 * x @ centroid.T,
+            0,
+        )
+    )
+    ball_lb = dc - radius[None, :]
+
+    piv = data[rng.choice(n, n_pivots, replace=False)]
+    dp_rows = np.sqrt(
+        np.maximum(
+            (x**2).sum(1)[:, None] + (piv**2).sum(1)[None, :]
+            - 2 * x @ piv.T,
+            0,
+        )
+    )  # (rows, P)
+    lo = np.empty((g, n_pivots))
+    hi = np.empty((g, n_pivots))
+    for i, b in enumerate(blocks):
+        seg = data[block_of == b]
+        dpb = np.sqrt(
+            np.maximum(
+                (seg**2).sum(1)[:, None] + (piv**2).sum(1)[None, :]
+                - 2 * seg @ piv.T,
+                0,
+            )
+        )
+        lo[i] = dpb.min(axis=0)
+        hi[i] = dpb.max(axis=0)
+    pivot_lb = np.maximum(
+        dp_rows[:, None, :] - hi[None, :, :], lo[None, :, :] - dp_rows[:, None, :]
+    ).max(axis=2)  # (rows, G)
+    pivot_lb = np.maximum(pivot_lb, ball_lb)  # family includes the ball test
+
+    q, _ = np.linalg.qr(rng.normal(size=(d, proj_dims)))
+    xp = data @ q  # (n, r) orthogonal projection: contraction of distances
+    cp = np.stack([xp[block_of == b].mean(axis=0) for b in blocks])
+    rp = np.array([
+        np.sqrt(((xp[block_of == b] - cp[i]) ** 2).sum(axis=1)).max()
+        for i, b in enumerate(blocks)
+    ])
+    dcp = np.sqrt(
+        np.maximum(
+            (xp[rows] ** 2).sum(1)[:, None] + (cp**2).sum(1)[None, :]
+            - 2 * xp[rows] @ cp.T,
+            0,
+        )
+    )
+    proj_lb = dcp - rp[None, :]
+
+    same = block_cluster[None, :] == y[rows][:, None]
+    out = {}
+    for name, lb in (("ball", ball_lb), ("pivot", pivot_lb), ("proj", proj_lb)):
+        excl = lb > ub[:, None]
+        out[f"{name}_excl_same"] = round(float(excl[same].mean()), 4)
+        out[f"{name}_excl_other"] = round(float(excl[~same].mean()), 4)
+    out.update(
+        n_rows=n_rows, n_blocks=int(g), n_pivots=n_pivots,
+        proj_dims=proj_dims,
+        mean_radius=round(float(radius.mean()), 3),
+        mean_core=round(float(ub.mean()), 3),
+    )
+    return out
+
+
 def main() -> None:
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 500_000
     dims_list = [int(x) for x in (sys.argv[2] if len(sys.argv) > 2 else "28,90").split(",")]
     modes = (sys.argv[3] if len(sys.argv) > 3 else "oracle,exact,bound05").split(",")
+    sep_class = float(sys.argv[4]) if len(sys.argv) > 4 else 9.0
     min_pts = 8
     cap = 16384
     for dims in dims_list:
@@ -72,10 +184,11 @@ def main() -> None:
         # sqrt(d): within-cluster nearest-neighbor distances concentrate at
         # ~sigma*sqrt(2d), so a FIXED center separation that is decisive at
         # d=10 blends clusters at d=90 — 3*sqrt(d) keeps the difficulty in
-        # the same class as the sep-9 rows at d=10.
+        # the same class as the sep-9 rows at d=10 (sep_class argv scales
+        # it: 7 -> the overlapping stress class).
         n_cl = 8
         mcs = max(64, n // 200)
-        sep = 3.0 * float(np.sqrt(dims))
+        sep = (sep_class / 3.0) * float(np.sqrt(dims))
         data, y = make_gauss(n, dims=dims, n_clusters=n_cl, separation=sep, seed=4)
         base = dict(
             min_points=min_pts, min_cluster_size=mcs, processing_units=cap,
@@ -96,6 +209,17 @@ def main() -> None:
                     "core_rel_err_max": round(rel_e, 8),
                     "wall_s": round(time.time() - t0, 2),
                 }
+                print(json.dumps(rec), flush=True)
+                continue
+            if mode == "bounds":
+                rec = {
+                    "config": "bounds_probe",
+                    "n": n,
+                    "dims": dims,
+                    "sep_class": sep_class,
+                    **bounds_probe(data, y, min_pts, cap),
+                }
+                rec["wall_s"] = round(time.time() - t0, 2)
                 print(json.dumps(rec), flush=True)
                 continue
             if mode == "exact":
@@ -135,6 +259,7 @@ def main() -> None:
                 "config": mode,
                 "n": n,
                 "dims": dims,
+                "sep_class": sep_class,
                 "min_cluster_size": mcs,
                 "wall_s": round(wall, 2),
                 "ari_truth": round(float(adjusted_rand_index(r.labels, y)), 4),

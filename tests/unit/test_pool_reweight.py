@@ -1,0 +1,58 @@
+"""f64 pool-reweight contract (r5, VERDICT item 3 groundwork).
+
+Every pooled edge weight the tree is built from must equal the EXACT f64
+mutual reachability of its endpoints under the final core vector — not the
+f32 device-scan value whose ~1e-7 relative jitter sat above the 1e-9 tie
+contraction tolerance and made mathematically tied lattice weights land on
+draw-dependent merge orders.
+"""
+
+import numpy as np
+
+from hdbscan_tpu import HDBSCANParams
+from hdbscan_tpu.core.distances import rowwise_distance_np
+from hdbscan_tpu.models import mr_hdbscan
+
+
+def _lattice_blobs(n_per=400, seed=0):
+    """Integer-lattice clusters (Skin-like tie structure)."""
+    rng = np.random.default_rng(seed)
+    centers = np.array([[0, 0, 0], [40, 0, 0], [0, 40, 0]])
+    pts = np.concatenate(
+        [c + rng.integers(-6, 7, size=(n_per, 3)) for c in centers]
+    ).astype(np.float64)
+    return pts
+
+
+class TestPoolReweight:
+    def test_pool_weights_are_exact_f64_mrd(self):
+        data = _lattice_blobs()
+        p = HDBSCANParams(
+            min_points=5, min_cluster_size=50, processing_units=256, k=0.1,
+            seed=3,
+        )
+        r = mr_hdbscan.fit(data, p, keep_edge_pool=True)
+        u, v, w = r.edge_pool
+        want = np.maximum(
+            rowwise_distance_np(data[u], data[v], "euclidean"),
+            np.maximum(r.core_distances[u], r.core_distances[v]),
+        )
+        np.testing.assert_allclose(w, want, rtol=0, atol=0)
+
+    def test_boundary_pool_weights_are_exact_f64_mrd(self):
+        rng = np.random.default_rng(1)
+        centers = rng.normal(size=(6, 4)) * 12
+        data = np.concatenate(
+            [c + rng.normal(size=(700, 4)) for c in centers]
+        )
+        p = HDBSCANParams(
+            min_points=5, min_cluster_size=120, processing_units=512, k=0.05,
+            seed=2, boundary_quality=0.05,
+        )
+        r = mr_hdbscan.fit(data, p, keep_edge_pool=True)
+        u, v, w = r.edge_pool
+        want = np.maximum(
+            rowwise_distance_np(data[u], data[v], "euclidean"),
+            np.maximum(r.core_distances[u], r.core_distances[v]),
+        )
+        np.testing.assert_allclose(w, want, rtol=0, atol=0)
