@@ -148,6 +148,26 @@ def main() -> None:
             file=sys.stderr,
         )
 
+    # --- DB + flat-cut refinement (r5: the draw-spread closer) -------------
+    # Same DB pipeline plus refine_flat iterations to convergence: the flat
+    # cut collapses onto the exact tree's reading regardless of draw
+    # (45-seed Skin: mean 0.6925 std 0.0000 vs single-draw 0.595/0.035 —
+    # seed_sweep45_skin_r5.jsonl). Reported as its own leg so the mr-db
+    # primary fields stay round-comparable.
+    flat_params = mr_params.replace(refine_flat_iterations=8)
+    mr_hdbscan.fit(data, flat_params, mesh=mesh)  # warm
+    fl_wall, fl_spread, r_fl, _ = timed_runs(
+        lambda: mr_hdbscan.fit(data, flat_params, mesh=mesh)
+    )
+    fl_ari = ari(r_fl.labels)
+    print(
+        f"[bench] mr-db-flat: wall={fl_wall:.2f}s "
+        f"[{fl_spread[0]:.2f}, {fl_spread[1]:.2f}] ARI={fl_ari:.4f} "
+        f"clusters={len(set(r_fl.labels[r_fl.labels > 0].tolist()))} "
+        f"noise={int((r_fl.labels == 0).sum())}",
+        file=sys.stderr,
+    )
+
     print(
         json.dumps(
             {
@@ -177,6 +197,13 @@ def main() -> None:
                 ],
                 "db_pipeline_vs_baseline": round(DB_BASELINE_S / mr_wall, 3),
                 "db_pipeline_ari": round(mr_ari, 4),
+                "db_flat_wall_s": round(fl_wall, 3),
+                "db_flat_spread_s": [
+                    round(fl_spread[0], 3),
+                    round(fl_spread[1], 3),
+                ],
+                "db_flat_vs_baseline": round(DB_BASELINE_S / fl_wall, 3),
+                "db_flat_ari": round(fl_ari, 4),
             }
         )
     )

@@ -9,7 +9,8 @@ and the Gauss synthetic family.
 Emits one JSON line per (dataset, variant) with mean/std ARI + wall stats.
 Usage: python benchmarks/seed_sweep.py [n_seeds] [dataset1,...] [variant1,...]
 Datasets: skin | gauss200k | gauss2_200k | gauss3_200k | gauss2_1m | gauss3_1m.
-Variants: db | rs | consN (N>=2: DB + consensus over N draws). Results land
+Variants: db | rs | dbflat (DB + flat-cut refinement to
+convergence) | consN (N>=2: DB + consensus over N draws). Results land
 in benchmarks/seed_sweep_r*.jsonl via shell redirection.
 """
 
@@ -79,7 +80,7 @@ def load_dataset(name: str):
 def main() -> None:
     n_seeds = int(sys.argv[1]) if len(sys.argv) > 1 else 10
     datasets = (sys.argv[2] if len(sys.argv) > 2 else "skin,gauss200k").split(",")
-    # Variants: db | rs | consN (DB + evidence-accumulation consensus over N
+    # Variants: db | rs | dbflat | consN (consensus over N
     # draws, models/consensus.py — the round-4 lever against the Skin
     # lattice-tie bimodality; each sweep seed uses a disjoint draw-seed block).
     # Validated up front: a typo must die before the first leg runs, not
@@ -93,7 +94,7 @@ def main() -> None:
                     "N >= 2 (e.g. cons5)"
                 )
             variants.append((variant, int(variant[4:])))
-        elif variant in ("db", "rs"):
+        elif variant in ("db", "rs", "dbflat"):
             variants.append((variant, 1))
         else:
             raise SystemExit(f"unknown variant {variant!r}")
@@ -143,9 +144,13 @@ def main() -> None:
             for seed in range(n_seeds):
                 p = HDBSCANParams(
                     **base,
-                    variant="db" if draws > 1 else variant,
+                    variant="rs" if variant == "rs" else "db",
                     seed=seed,
                     consensus_draws=draws,
+                    # dbflat: DB + flat-cut-level refinement to convergence
+                    # (r5 — the spread closer; 8 bounds the loop, early
+                    # stop on fixed labels).
+                    refine_flat_iterations=8 if variant == "dbflat" else 0,
                 )
                 t0 = time.time()
                 r = mr_hdbscan.fit(data, p)  # dispatches consensus inside

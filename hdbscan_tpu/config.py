@@ -63,6 +63,19 @@ class HDBSCANParams:
     #: whole region into a later merge wave and flips the flat cut. 0
     #: disables (reference-faithful: the reference never refines).
     refine_iterations: int = 1
+    #: FLAT-CUT-level refinement rounds (r5): after the tree is built, seed
+    #: tiled Borůvka with the flat labels (noise points as singleton
+    #: components), harvest the exact min MRD edges crossing that partition,
+    #: rebuild, repeat until labels stop changing or the budget runs out.
+    #: This repairs pool incompleteness at the TOP of the tree — the
+    #: measured source of the cross-draw flat-cut spread on lattice data
+    #: (draws' pools miss different top-structure MST edges; leaf-cluster
+    #: refinement is too fine to see them). Measured on the Skin 45-seed
+    #: protocol (seed_sweep45_skin_r5.jsonl): draws converge onto the
+    #: exact tree's reading (ARI 0.6925 vs single-draw mean 0.595 std
+    #: 0.035). Applies to the global-core (non-boundary) pipeline; 0
+    #: disables (reference behavior — the reference never refines).
+    refine_flat_iterations: int = 0
     #: Boundary-aware hybrid quality mode (sub-quadratic at DB quality).
     #: When > 0: the fraction of each final block treated as "boundary" —
     #: points whose seam margin (distance to the nearest other-subset sample
@@ -140,22 +153,6 @@ class HDBSCANParams:
     #: not fixable by refinement — ROADMAP r3). 1 = single draw (reference
     #: behavior).
     consensus_draws: int = 1
-    #: Probe-tightened boundary selection (boundary_quality mode, pruned
-    #: path only): before the exact core rescan, scan each at-risk row's
-    #: own + nearest blocks and re-test the at-risk criterion against the
-    #: resulting k-th distance (<= the per-block core by construction).
-    #: Rows failing margin <= alpha * probe-k-th keep their per-block core
-    #: (undamaged by the same ball-vs-seam argument that justifies the
-    #: selection) and skip the full rescan. MEASURED (r4): a no-op at
-    #: d >= 8 — in high dimension most of a 16k-row forced-split cell's
-    #: volume lies near its boundary, so ~all rows of a split cluster
-    #: genuinely have k-NN across the cut (50k x 8-d sep-9.5: tightening
-    #: kept 30,286 of 30,293 rows while paying an extra probe pass). The
-    #: ~99% at-risk fractions at multi-M are REAL damage, not block-core
-    #: pessimism; the rescan's ~n²/n_clusters FLOP floor follows. Default
-    #: off; worth enabling only on low-d data (2-3d: thin cell boundaries)
-    #: with seam-light structure.
-    probe_tighten: bool = False
     #: Collapse duplicate rows into weighted unique points before the exact
     #: pipeline (``core/dedup.py``). Semantics-preserving (a duplicate group
     #: is a zero-extent bubble; the member-weighted tree equals the full-row
@@ -282,13 +279,13 @@ FLAG_FIELDS = {
     "exact_inter_edges": ("exact_inter_edges", _bool),
     "global_cores": ("global_core_distances", _bool),
     "refine": ("refine_iterations", int),
+    "refine_flat": ("refine_flat_iterations", int),
     "boundary": ("boundary_quality", float),
     "boundary_alpha": ("boundary_alpha", float),
     "boundary_max_frac": ("boundary_max_frac", float),
     "glue_alpha": ("glue_alpha", float),
     "glue_factor": ("glue_max_factor", int),
     "glue_rows": ("glue_row_budget", int),
-    "probe_tighten": ("probe_tighten", _bool),
     "consensus": ("consensus_draws", int),
     "block_pruning": ("boundary_block_pruning", _bool),
     "max_samples": ("max_samples", int),

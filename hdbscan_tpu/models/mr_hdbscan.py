@@ -938,31 +938,11 @@ def _fit_rows(
             glue_row_budget=params.glue_row_budget,
         )
         bset, bset_glue_sel = sel if pruned else (sel, sel)
-        geom_blocks = None
-        if pruned and params.probe_tighten and len(bset):
-            # Probe-tightened selection (opt-in, see config.probe_tighten:
-            # measured a no-op at d >= 8, where ~all rows of a forced-split
-            # cluster genuinely have k-NN across the cut): re-test the
-            # at-risk criterion against the probe's own+nearest-block k-th
-            # (<= the per-block core by construction); rows that clear it
-            # keep a provably-undamaged per-block core and skip the full
-            # rescan. Glue rows always stay (their neighbor lists seed the
-            # glue bounds).
-            from hdbscan_tpu.ops.blockscan import (
-                BlockGeometry,
-                knn_rows_blockpruned,
-            )
-
-            geom_blocks = BlockGeometry.build(data, final_block, metric)
-            kth_p = knn_rows_blockpruned(
-                geom_blocks, bset, core[bset], params.min_points,
-                probe_only=True,
-            )
-            keep = bmargin[bset] <= params.boundary_alpha * kth_p
-            in_glue = np.zeros(n, bool)
-            in_glue[bset_glue_sel] = True
-            keep |= in_glue[bset]
-            bset = bset[keep]
+        # (An opt-in probe-tightened SELECTION pass lived here in r4; it was
+        # atticed in r5 after its adjudication runs: it cleared 104 of 168k
+        # at-risk rows on Skin (3-d) and 1.5% on a separated 3-d synthetic
+        # while paying an extra probe scan — probe_tighten_r5.jsonl. The
+        # at-risk fractions are real damage at every measured d.)
         if trace is not None:
             trace(
                 "boundary_select",
@@ -970,7 +950,6 @@ def _fit_rows(
                 m_glue=len(bset_glue_sel),
                 frac=round(len(bset) / n, 4),
                 pruned=pruned,
-                tightened=bool(pruned and params.probe_tighten),
                 wall_s=round(time.monotonic() - t0, 3),
             )
         # 2) Exact global core distances for boundary points only (their
@@ -997,8 +976,7 @@ def _fit_rows(
             bset_pos = np.full(n, -1, np.int64)
             bset_pos[bset] = np.arange(len(bset))
             sel_pos = bset_pos[bset_glue_sel]
-            if geom_blocks is None:
-                geom_blocks = BlockGeometry.build(data, final_block, metric)
+            geom_blocks = BlockGeometry.build(data, final_block, metric)
             core_b, knn_d_g, knn_j_gl = knn_rows_blockpruned(
                 geom_blocks,
                 bset,
@@ -1202,6 +1180,55 @@ def _fit_rows(
                     wall_s=round(wall, 3),
                     **phase_stats(fsnap, wall),
                 )
+
+    # Flat-cut-level refinement (config.refine_flat_iterations): harvest
+    # the exact min MRD edges crossing the FLAT partition (noise points
+    # as singleton components — coarser than the leaf clusters the loop
+    # above uses), rebuild, repeat until the labels fix. Repairs pool
+    # incompleteness at the top of the tree: the measured source of the
+    # cross-draw flat-cut spread on lattice data (two draws' pools miss
+    # DIFFERENT top-structure MST edges — total pool weights differ —
+    # and the EOM read flips; seed_sweep45_skin_r5.jsonl shows draws
+    # converging onto the exact tree's reading under this loop).
+    # Global-core path only: the boundary path's glue subset does not
+    # cover arbitrary noise singletons, and its sep-9 campaign rows sit
+    # at ARI 0.9995+ without it (extension = ROADMAP r5 next-lever).
+    if global_core and bset is None and params.refine_flat_iterations > 0:
+        from hdbscan_tpu.ops.tiled import boruvka_glue_edges
+
+        from hdbscan_tpu.utils.flops import counter as flops_counter
+        from hdbscan_tpu.utils.flops import phase_stats
+
+        for _ in range(params.refine_flat_iterations):
+            t0 = time.monotonic()
+            fsnap = flops_counter.snapshot()
+            g = labels[:n].copy()
+            noise = g == 0
+            g[noise] = np.arange(int(noise.sum())) + g.max() + 1
+            if len(np.unique(g)) < 2:
+                break
+            ru, rv, rw = boruvka_glue_edges(
+                data, g, metric, core=core, mesh=mesh
+            )
+            if len(ru) == 0:
+                break
+            rw = _reweight_pool(ru, rv, rw, data, core, metric)
+            u = np.concatenate([u, ru])
+            v = np.concatenate([v, rv])
+            w = np.concatenate([w, rw])
+            prev = labels
+            tree, labels, scores, infinite = build_tree(u, v, w)
+            if trace is not None:
+                wall = time.monotonic() - t0
+                trace(
+                    "refine_flat",
+                    new_edges=len(ru),
+                    changed=int((labels != prev).sum()),
+                    wall_s=round(wall, 3),
+                    **phase_stats(fsnap, wall),
+                )
+            if np.array_equal(labels, prev):
+                break
 
     return MRHDBSCANResult(
         labels=labels,

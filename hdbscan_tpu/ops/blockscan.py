@@ -716,10 +716,9 @@ def knn_rows_blockpruned(
     ub: np.ndarray,
     min_pts: int,
     return_neighbors: bool = False,
-    row_tile: int = 256,
+    row_tile: int = 512,
     neighbor_rows: np.ndarray | None = None,
     probe_blocks: int = _KNN_PROBE_BLOCKS,
-    probe_only: bool = False,
 ):
     """Exact core distances of selected rows via block-candidate windows.
 
@@ -739,6 +738,10 @@ def knn_rows_blockpruned(
     phase 2 selects candidate windows under ``min(ub, probe k-th)``,
     skipping the probed pairs, and merges into the same buffers. Exactness
     is unchanged — only the window population shrinks.
+    (The r4 ``probe_only`` selection-tightening mode was atticed in r5 —
+    probe_tighten_r5.jsonl. row_tile default 512 since r5: the window-merge
+    kernel measured +20-30% over 256 at both win widths — top_k/merge cost
+    amortizes over rows — at bounded pad waste for small jobs.)
 
     Returns ``core`` (m,). ``neighbor_rows`` (local indices into
     ``row_ids``) additionally returns those rows' (r, k) ascending neighbor
@@ -799,7 +802,7 @@ def knn_rows_blockpruned(
     ub = np.asarray(ub, np.float64)
     probe = dc_cache = None
     if probe_blocks > 0 and len(geom.block_ids) > probe_blocks:
-        dc_cache = None if probe_only else geom.centroid_distance_cache(rows)
+        dc_cache = geom.centroid_distance_cache(rows)
         ppr, ppb, probe = geom.probe_pairs(
             rows,
             probe_blocks,
@@ -811,18 +814,8 @@ def knn_rows_blockpruned(
         probe_kth = np.asarray(
             jax.device_get(best_d[:m, kth_idx]), np.float64
         )
-        if probe_only:
-            # Selection-tightening mode: the caller only wants the probe's
-            # k-th upper bound (own block forced in, so it never exceeds
-            # the per-block core). min against ub keeps the contract
-            # "never worse than what the caller already knew".
-            return np.where(
-                np.isfinite(probe_kth), np.minimum(ub, probe_kth), ub
-            )
         # inf where the probe found < k valid points; keep the caller's ub.
         ub = np.where(np.isfinite(probe_kth), np.minimum(ub, probe_kth), ub)
-    elif probe_only:
-        return ub
     pair_rows, pair_blocks = geom.candidate_pairs(
         rows, ub, exclude=probe, dc_rows=dc_cache
     )
@@ -865,7 +858,7 @@ def boruvka_glue_edges_blockpruned(
     knn_d: np.ndarray | None = None,
     knn_j: np.ndarray | None = None,
     col_tile: int = 8192,
-    row_tile: int = 256,
+    row_tile: int = 512,
     max_rounds: int = 64,
     dense_work_ratio: float = 0.7,
     init_comp: np.ndarray | None = None,
