@@ -21,6 +21,14 @@ dispatch/transfer-bound, kernels are not worth optimizing" (ROADMAP r4 item
   at production geometry (256-row tiles x 4-tile windows), chained
   donated-buffer calls at two chunk sizes — the dispatch-amortization curve
   of the phase that dominates multi-M walls.
+- ``fused_body`` / ``scan_e2e_fused`` / ``rescan_chunk_fused_T{n}``: the r6
+  fused distance+selection kernel (``ops/pallas_knn``) on the SAME shapes —
+  selection stays in VMEM registers instead of round-tripping tiles through
+  ``lax.top_k``, which r5 measured at ~90% of on-chip scan time
+  (scan_body_guarded vs matmul_floor). Off-TPU these legs run the Pallas
+  INTERPRETER (orders of magnitude slower than compiled XLA), so they are
+  gated to small ``--n`` smoke rows there; interpreter rates validate the
+  wiring, not TPU throughput.
 
 FLOP convention matches ``utils/flops`` (2*rows*cols*d logical; the
 f32-HIGHEST cross matmul runs ~6 bf16 passes, so a perfectly MXU-bound
@@ -28,7 +36,8 @@ euclidean scan tops out near PEAK/6 — compare legs RELATIVE to that
 ceiling). Counterpart being replaced: the reference's runtime tables
 (ResearchReport.pdf §5.4) — here the table is per-kernel, on-device.
 
-Rows append to ``benchmarks/devicebench_r5.jsonl`` with full config echo.
+Rows append to ``benchmarks/devicebench_r6.jsonl`` with full config echo
+(r5 baseline rows: ``devicebench_r5.jsonl``).
 """
 
 from __future__ import annotations
@@ -117,7 +126,9 @@ def bench_exact_scan(out_path, n=500_000, d=28, k=15, iters=3, seed=0):
     row_tile, col_tile, n_pad = _tile_sizes(n, 1024, 8192)
     data_p = jnp.asarray(_pad_rows(data, n_pad))
     valid_p = jnp.asarray(np.arange(n_pad) < n)
-    chunk = 1 << 16  # one big program: ~1.8 TFLOP logical at d=28
+    # One big program: ~1.8 TFLOP logical at the default 500k x 28 (clamped
+    # so small --n smoke runs don't credit rows the slice can't deliver).
+    chunk = min(1 << 16, n_pad)
     rows = data_p[:chunk]
     flops = 2.0 * chunk * n_pad * d
 
@@ -185,6 +196,69 @@ def bench_exact_scan(out_path, n=500_000, d=28, k=15, iters=3, seed=0):
             mfu=round(flops_full / wall / PEAK_FLOPS, 5), **base,
         ))
 
+    # Fused distance+selection legs (r6 tentpole). fused_body is the
+    # kernel-resident analog of scan_body_guarded — one program, chunk rows
+    # vs every column, k-best (distance, id) registers merged in VMEM.
+    # scan_e2e_fused is the public dispatcher under backend="fused" (host
+    # pad + transpose + kth-column fetch included). The gap these legs close
+    # is scan_body_guarded vs matmul_floor (~5x at r5).
+    from hdbscan_tpu.ops import pallas_knn as pk
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu and n > (1 << 14):
+        print(
+            f"# fused legs skipped: platform={jax.devices()[0].platform!r}, "
+            f"n={n} > 16384 — the off-TPU path is the Pallas interpreter "
+            "(impractically slow at bench shapes); rerun with --n 4096 for "
+            "a wiring smoke row",
+            flush=True,
+        )
+        return
+    n_pad_f = max(pk.COL_TILE, pk.ROW_TILE)
+    while n_pad_f < n:
+        n_pad_f *= 2
+    x = np.zeros((n_pad_f, pk.LANES), np.float32)
+    x[:n, :d] = data
+    colmask = np.full((1, n_pad_f), np.inf, np.float32)
+    colmask[0, :n] = 0.0
+    xj, xtj, mj = jax.device_put((x, np.ascontiguousarray(x.T), colmask))
+    chunk_f = min(chunk, n_pad_f)
+    rows_f = xj[:chunk_f]
+    flops_f = 2.0 * chunk_f * n_pad_f * d
+    fbase = dict(base, n_pad_fused=n_pad_f, chunk_rows_fused=chunk_f,
+                 interpret=not on_tpu)
+
+    def run_fused_body():
+        dd, _ = pk.knn_fused_pallas(rows_f, xtj, mj, k, interpret=not on_tpu)
+        return jnp.sum(jnp.where(jnp.isfinite(dd), dd, 0.0))
+
+    wall, spread = _time_call(run_fused_body, iters)
+    _emit(out_path, dict(
+        leg="fused_body", wall_s=round(wall, 4), spread_s=spread,
+        gflops=round(flops_f / 1e9, 1), gflops_s=round(flops_f / wall / 1e9, 1),
+        mfu=round(flops_f / wall / PEAK_FLOPS, 5), **fbase,
+    ))
+
+    flops_ff = 2.0 * n_pad_f * n_pad_f * d
+    knn_core_distances(
+        data, k + 1, "euclidean", backend="fused", fetch_knn=False
+    )
+    walls = []
+    for _ in range(max(1, iters - 1)):
+        t0 = time.perf_counter()
+        knn_core_distances(
+            data, k + 1, "euclidean", backend="fused", fetch_knn=False
+        )
+        walls.append(time.perf_counter() - t0)
+    wall = float(np.median(walls))
+    _emit(out_path, dict(
+        leg="scan_e2e_fused", wall_s=round(wall, 4),
+        spread_s=[round(min(walls), 4), round(max(walls), 4)],
+        gflops=round(flops_ff / 1e9, 1),
+        gflops_s=round(flops_ff / wall / 1e9, 1),
+        mfu=round(flops_ff / wall / PEAK_FLOPS, 5), **fbase,
+    ))
+
 
 def bench_dispatch_latency(out_path, iters=50):
     x = jnp.zeros(8, jnp.float32)
@@ -202,7 +276,11 @@ def bench_rescan_chunk(out_path, n=1_000_000, d=10, k=15, win_tiles=4,
     """``_knn_window_merge_chunk`` at production rescan geometry, chained
     donated-buffer calls: the on-chip rate of the phase that dominates
     multi-M boundary walls (r4: 51.9-94.9 GFLOP/s incl. host time)."""
-    from hdbscan_tpu.ops.blockscan import _knn_window_merge_chunk
+    from hdbscan_tpu.ops.blockscan import (
+        _knn_window_merge_chunk,
+        _knn_window_merge_chunk_fused,
+    )
+    from hdbscan_tpu.ops.pallas_knn import LANES
 
     rng = np.random.default_rng(seed)
     n_pad = -(-n // col_tile) * col_tile
@@ -210,6 +288,24 @@ def bench_rescan_chunk(out_path, n=1_000_000, d=10, k=15, win_tiles=4,
     data_dev = jax.device_put(data)
     valid_dev = jax.device_put(np.arange(n_pad) < n)
     n_tiles = n_pad // col_tile
+    on_tpu = jax.devices()[0].platform == "tpu"
+    fused_ok = on_tpu or n_pad <= (1 << 14)
+    if fused_ok:
+        # Fused-twin operands (BlockGeometry.fused_operands layout): the
+        # lane-padded transpose + 0/inf column mask.
+        data_t = np.zeros((LANES, n_pad), np.float32)
+        data_t[:d] = data.T
+        colmask = np.full((1, n_pad), np.inf, np.float32)
+        colmask[0, :n] = 0.0
+        data_t_dev, colmask_dev = jax.device_put((data_t, colmask))
+    else:
+        print(
+            f"# rescan fused legs skipped: platform="
+            f"{jax.devices()[0].platform!r}, n_pad={n_pad} > 16384 "
+            "(interpreter-only off TPU); rerun with --rescan-n 16384 "
+            "--rescan-col-tile 2048 --rescan-tiles 16 for a smoke row",
+            flush=True,
+        )
     base = dict(
         n=n, d=d, k=k, win_tiles=win_tiles, row_tile=row_tile,
         col_tile=col_tile, iters=iters, seed=seed,
@@ -255,21 +351,57 @@ def bench_rescan_chunk(out_path, n=1_000_000, d=10, k=15, win_tiles=4,
             mfu=round(flops / wall / PEAK_FLOPS, 5), **base,
         ))
 
+        if not fused_ok:
+            continue
+        # Same windows through the fused twin: window tiles reduce to
+        # (distance, id) registers on-chip, one kernel per chunk.
+        starts_tiles_d = jax.device_put((starts // col_tile).astype(np.int32))
+
+        def run_fused():
+            bd = jnp.full((m + 1, k), jnp.inf, jnp.float32)
+            bi = jnp.full((m + 1, k), -1, jnp.int32)
+            out = _knn_window_merge_chunk_fused(
+                bd, bi, ids_d, locs_d, data_dev, data_t_dev, colmask_dev,
+                starts_tiles_d, k, col_tile, win_tiles, not on_tpu,
+            )[0]
+            return jnp.sum(jnp.where(jnp.isfinite(out), out, 0.0))
+
+        wall, spread = _time_call(run_fused, iters)
+        _emit(out_path, dict(
+            leg=f"rescan_chunk_fused_T{t_chunk}", wall_s=round(wall, 4),
+            spread_s=spread, tiles=t_chunk, rows=m, interpret=not on_tpu,
+            gflops=round(flops / 1e9, 1),
+            gflops_s=round(flops / wall / 1e9, 1),
+            mfu=round(flops / wall / PEAK_FLOPS, 5), **base,
+        ))
+
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(
-        os.path.dirname(__file__), "devicebench_r5.jsonl"))
+        os.path.dirname(__file__), "devicebench_r6.jsonl"))
     ap.add_argument("--legs", default="dispatch,exact,rescan")
     ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--n", type=int, default=500_000,
+                    help="exact-scan rows (use ~4096 for off-TPU fused "
+                         "smoke rows — interpreter-mode gate at 16384)")
+    ap.add_argument("--d", type=int, default=28)
+    ap.add_argument("--rescan-n", type=int, default=1_000_000)
+    ap.add_argument("--rescan-col-tile", type=int, default=8192)
+    ap.add_argument("--rescan-tiles", default="64,1024",
+                    help="comma-separated chunk sizes in 256-row tiles")
     args = ap.parse_args()
     legs = args.legs.split(",")
     if "dispatch" in legs:
         bench_dispatch_latency(args.out)
     if "exact" in legs:
-        bench_exact_scan(args.out, iters=args.iters)
+        bench_exact_scan(args.out, n=args.n, d=args.d, iters=args.iters)
     if "rescan" in legs:
-        bench_rescan_chunk(args.out, iters=args.iters)
+        bench_rescan_chunk(
+            args.out, n=args.rescan_n, col_tile=args.rescan_col_tile,
+            chunk_tiles=tuple(int(t) for t in args.rescan_tiles.split(",")),
+            iters=args.iters,
+        )
 
 
 if __name__ == "__main__":

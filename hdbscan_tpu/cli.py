@@ -9,6 +9,7 @@ Usage mirrors the reference's documented contract (``main/Main.java:534-614``)::
         [exact_inter_edges={true,false}] [global_cores={true,false}] [refine=N] \
         [boundary=F] [boundary_alpha=F] [boundary_max_frac=F] [glue_alpha=F] \
         [glue_factor=N] [glue_rows=N] [block_pruning={true,false}] \
+        [knn_backend={auto,xla,pallas,fused}] \
         [consensus=N] [compat_cf={true,false}] \
         [clusterName={local,auto,<host:port>,<pid>,<np>}]
 

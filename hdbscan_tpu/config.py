@@ -182,6 +182,17 @@ class HDBSCANParams:
     #: output parity with a reference RUN rather than with the paper. Off by
     #: default (SURVEY.md §7 parity-vs-bug decisions).
     compat_cf_int_math: bool = False
+    #: Device backend for the exact k-NN scans (``ops/tiled`` core distances
+    #: and the boundary-mode window-merge rescan in ``ops/blockscan``):
+    #: "auto" (default) picks the Pallas distance kernel + XLA top_k on TPU
+    #: and the guarded XLA scan elsewhere; "xla" forces the guarded XLA
+    #: scan; "pallas" forces the distance-only Pallas kernel (raises when
+    #: ineligible); "fused" selects neighbors on-chip next to the distance
+    #: tiles (``ops/pallas_knn.knn_core_distances_fused`` — the r6
+    #: selection-bound fix, see utils/flops.py docstring) and silently
+    #: falls back to the guarded XLA scan when the shape/metric/platform is
+    #: ineligible, so the knob is safe under every parameterization.
+    knn_backend: str = "auto"
     # Output file names derived from the input path (main/Main.java:516-526):
 
     def __post_init__(self):
@@ -212,6 +223,11 @@ class HDBSCANParams:
                              "uncapped deep-crossing tier")
         if self.consensus_draws < 1:
             raise ValueError("consensus_draws must be >= 1")
+        if self.knn_backend not in ("auto", "xla", "pallas", "fused"):
+            raise ValueError(
+                "knn_backend must be 'auto', 'xla', 'pallas' or 'fused', "
+                f"got {self.knn_backend!r}"
+            )
         if self.boundary_quality > 0 and self.dedup_points:
             raise ValueError(
                 "boundary_quality and dedup_points are mutually exclusive "
@@ -288,6 +304,7 @@ FLAG_FIELDS = {
     "glue_rows": ("glue_row_budget", int),
     "consensus": ("consensus_draws", int),
     "block_pruning": ("boundary_block_pruning", _bool),
+    "knn_backend": ("knn_backend", str),
     "max_samples": ("max_samples", int),
     "compat_cf": ("compat_cf_int_math", _bool),
 }

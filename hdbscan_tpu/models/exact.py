@@ -46,6 +46,7 @@ def mst_edges(
     max_rounds: int = 64,
     mesh=None,
     trace=None,
+    knn_backend: str = "auto",
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Blocked Borůvka: (u, v, w) exact mutual-reachability MST + core distances.
 
@@ -54,11 +55,14 @@ def mst_edges(
     graph's MST was tried and reverted: a k-NN-subgraph MST edge is NOT
     necessarily a global MST edge — the cut property needs the minimum over
     ALL crossing edges — and the parity tests caught the difference.)
+
+    ``knn_backend`` selects the core-distance scan backend
+    (``ops/tiled.knn_core_distances``); the Borůvka rounds are unaffected.
     """
     n = len(data)
     core, _ = knn_core_distances(
         data, min_pts, metric, row_tile=row_tile, col_tile=col_tile, dtype=dtype,
-        fetch_knn=False,
+        fetch_knn=False, backend=knn_backend,
     )
     if trace is not None:
         trace("core_distances", n=n)
@@ -187,6 +191,7 @@ def mst_edges_random_blocks(
     dtype=np.float32,
     max_block: int = 8192,
     trace=None,
+    knn_backend: str = "auto",
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """The Random Blocks exact method (paper's RB; the reference's dead
     ``partition/`` + ``UnionFindReducer`` pipeline, SURVEY.md §2.B/§3.5),
@@ -214,7 +219,7 @@ def mst_edges_random_blocks(
     n = len(data)
     core, _ = knn_core_distances(
         data, min_pts, metric, row_tile=row_tile, col_tile=col_tile, dtype=dtype,
-        fetch_knn=False,
+        fetch_knn=False, backend=knn_backend,
     )
     if trace is not None:
         trace("core_distances", n=n)
@@ -300,6 +305,7 @@ def fit(
         dtype=dtype,
         mesh=mesh,
         trace=trace,
+        knn_backend=params.knn_backend,
     )
     from hdbscan_tpu.models._finalize import finalize_clustering
 

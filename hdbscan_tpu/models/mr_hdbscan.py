@@ -138,7 +138,7 @@ def _select_boundary(
     q: float,
     core: np.ndarray | None = None,
     min_per_block: int = 32,
-    max_frac: float = HDBSCANParams.boundary_max_frac,
+    max_frac: float | None = None,
     return_floor: bool = False,
     alpha: float = _BOUNDARY_ALPHA,
     glue_alpha: float = _GLUE_ALPHA,
@@ -163,7 +163,15 @@ def _select_boundary(
     rows, or floor ∪ the whole UNCAPPED deep-crossing tier when
     ``glue_row_budget`` is -1. Always a subset of the returned selection
     (one selection pass covers both).
+
+    ``max_frac=None`` resolves to the ``HDBSCANParams.boundary_max_frac``
+    field default at CALL time — binding the class attribute in the
+    signature froze the value at import and would silently ignore a changed
+    dataclass default or a ``field(default=...)`` rewrite (ADVICE r5 #3);
+    callers with params in hand pass ``params.boundary_max_frac``.
     """
+    if max_frac is None:
+        max_frac = HDBSCANParams.__dataclass_fields__["boundary_max_frac"].default
     n = len(margin)
     _, inv = np.unique(subset, return_inverse=True)
     counts = np.bincount(inv)
@@ -248,6 +256,29 @@ def _select_boundary(
     if return_floor:
         return ids, floor_ids
     return ids
+
+
+def _same_flat_partition(a: np.ndarray, b: np.ndarray) -> bool:
+    """True iff two flat labelings describe the same partition up to cluster
+    renumbering. ``build_tree`` numbers clusters by tree traversal order, so
+    one harvested edge can renumber every cluster while moving no point —
+    a raw ``labels == prev`` fixed-point check then never fires and
+    ``refine_flat`` runs to its iteration cap doing no-op rebuilds (ADVICE
+    r5 #4). Noise (label 0) is pinned, not renumberable: a noise flip IS a
+    partition change."""
+    if np.array_equal(a, b):
+        return True
+    if not np.array_equal(a == 0, b == 0):
+        return False
+    m = a != 0
+    if not m.any():
+        return True
+    # Bijection check: every a-cluster maps to exactly one b-cluster and
+    # vice versa — the co-occurring (a, b) label pairs must be a matching.
+    pairs = np.unique(np.stack([a[m], b[m]]), axis=1)
+    return pairs.shape[1] == len(np.unique(pairs[0])) == len(
+        np.unique(pairs[1])
+    )
 
 
 def _reweight_pool(
@@ -574,7 +605,11 @@ def _fit_rows(
             )
         else:
             core, _ = knn_core_distances(
-                data, params.min_points, metric, fetch_knn=False
+                data,
+                params.min_points,
+                metric,
+                fetch_knn=False,
+                backend=params.knn_backend,
             )
     n_dev = 1
     if mesh is not None:
@@ -983,6 +1018,7 @@ def _fit_rows(
                 core[bset],
                 params.min_points,
                 neighbor_rows=sel_pos,
+                backend=params.knn_backend,
             )
             # The full-dataset device copy is only needed for this rescan —
             # release it before the glue/tree stages pin more HBM.
@@ -996,7 +1032,10 @@ def _fit_rows(
             )
             bset_knn = (knn_d_g, knn_j_g)
         else:
-            core_b = knn_core_distances_rows(data, bset, params.min_points, metric)
+            core_b = knn_core_distances_rows(
+                data, bset, params.min_points, metric,
+                backend=params.knn_backend,
+            )
         core[bset] = np.minimum(core[bset], core_b)
         if trace is not None:
             wall = time.monotonic() - t0
@@ -1218,16 +1257,21 @@ def _fit_rows(
             w = np.concatenate([w, rw])
             prev = labels
             tree, labels, scores, infinite = build_tree(u, v, w)
+            # Relabel-invariant fixed point: build_tree renumbers clusters
+            # by traversal order, so compare partitions, not raw labels
+            # (_same_flat_partition). `changed` counts raw moves only when
+            # the partition actually moved — a pure renumbering is 0.
+            converged = _same_flat_partition(labels, prev)
             if trace is not None:
                 wall = time.monotonic() - t0
                 trace(
                     "refine_flat",
                     new_edges=len(ru),
-                    changed=int((labels != prev).sum()),
+                    changed=0 if converged else int((labels != prev).sum()),
                     wall_s=round(wall, 3),
                     **phase_stats(fsnap, wall),
                 )
-            if np.array_equal(labels, prev):
+            if converged:
                 break
 
     return MRHDBSCANResult(

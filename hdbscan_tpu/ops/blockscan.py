@@ -118,6 +118,9 @@ class BlockGeometry:
     data_sorted: jax.Array  # (n_pad, d) device, scan dtype
     valid_sorted: jax.Array  # (n_pad,) device bool
     data_host: np.ndarray  # (n, d) f64 original rows (unsorted)
+    #: Lazy (LANES, n_pad) transposed copy + (1, n_pad) column mask for the
+    #: fused window kernel (see :meth:`fused_operands`).
+    _fused_ops: tuple | None = None
 
     @staticmethod
     def build(
@@ -279,6 +282,25 @@ class BlockGeometry:
         pos = self.inv_perm[row_ids]
         return np.searchsorted(self.starts, pos, side="right") - 1
 
+    def fused_operands(self) -> tuple[jax.Array, jax.Array]:
+        """Device operands for the fused window kernel, built once per
+        geometry: the (LANES, n_pad) lane-padded TRANSPOSE of the sorted
+        data (the kernel's column stream — an extra n_pad x 128 x 4 B device
+        copy, which is why the fused backend is opt-in) and the (1, n_pad)
+        0/+inf column mask replacing ``valid_sorted``."""
+        if self._fused_ops is None:
+            from hdbscan_tpu.ops.pallas_knn import LANES
+
+            d = self.data_host.shape[1]
+            xt = np.zeros((LANES, self.n_pad), np.float32)
+            xt[:d, : self.n] = np.asarray(
+                self.data_host[self.perm], np.float32
+            ).T
+            mask = np.full((1, self.n_pad), np.inf, np.float32)
+            mask[0, : self.n] = 0.0
+            self._fused_ops = jax.device_put((xt, mask))
+        return self._fused_ops
+
     def probe_pairs(
         self,
         rows: np.ndarray,
@@ -377,6 +399,7 @@ def _tiled_window_jobs(
     row_tile: int,
     *,
     dummy: int,
+    slot_budget: int | None = None,
 ):
     """Flatten window jobs to ROW-TILE granularity for batched dispatch.
 
@@ -392,11 +415,17 @@ def _tiled_window_jobs(
     per-chunk budget regardless of the round's total tile count.
 
     Yields (metas, ids (T, row_tile) int32, col_starts (T,), locs
-    (T, row_tile) int32) where metas is [(ridx_slice, tile_lo, n_tiles),
-    ...] mapping each job's rows back to its contiguous tile span within
-    this chunk, and ``locs`` carries each tile slot's LOCAL row index (the
-    job-space id, for device-side merges keyed by row) with pad slots set
-    to ``dummy``. A job whose tile span crosses a chunk boundary is split
+    (T, row_tile) int32, n_real) where metas is [(ridx_slice, tile_lo,
+    n_tiles), ...] mapping each job's rows back to its contiguous tile span
+    within this chunk, ``locs`` carries each tile slot's LOCAL row index
+    (the job-space id, for device-side merges keyed by row) with pad slots
+    set to ``dummy``, and ``n_real`` is the count of REAL (non-pad) tiles
+    at the front of the chunk — callers split their FLOP credit on it so
+    the _MIN_CHUNK_TILES padding (up to 64x a 1-tile job) never inflates
+    achieved-GFLOP phase rows. ``slot_budget`` overrides the
+    ``_BATCH_SLOT_BUDGET`` row-slot cap per chunk (the fused window path
+    carries (slots, 128) f32+int32 outputs and caps lower).
+    A job whose tile span crosses a chunk boundary is split
     across yields — its per-chunk row slices are disjoint, so callers'
     per-row merges stay correct.
     """
@@ -406,7 +435,7 @@ def _tiled_window_jobs(
         t = -(-len(ridx) // row_tile)
         metas.append((col_start, ridx, t_total, t))
         t_total += t
-    max_chunk = max(1, _BATCH_SLOT_BUDGET // row_tile)
+    max_chunk = max(1, (slot_budget or _BATCH_SLOT_BUDGET) // row_tile)
     min_chunk = min(_MIN_CHUNK_TILES, max_chunk)
     lo = 0
     mi = 0  # metas index; consumed in order (jobs laid out consecutively)
@@ -443,7 +472,7 @@ def _tiled_window_jobs(
                 mi += 1
             else:
                 break
-        yield chunk_metas, ids, starts, locs
+        yield chunk_metas, ids, starts, locs, n_real
         lo += n_real
 
 
@@ -531,7 +560,21 @@ def _knn_window_merge_chunk(
 
             def merge(carry):
                 best, bidx = carry
-                nv, ni = jax.lax.top_k(-dmat, k)  # k smallest, ascending
+                # Clamp the per-tile extraction to the tile width, mirroring
+                # _knn_core_scan: top_k(k > col_tile) fails to trace, and a
+                # k that large is legitimate (min_pts > col_tile on a small
+                # col_tile geometry). Missing slots pad (inf, -1) so the
+                # merge shape stays (row, 2k).
+                kk = min(k, col_tile)
+                nv, ni = jax.lax.top_k(-dmat, kk)  # kk smallest, ascending
+                if kk < k:
+                    pad = jnp.full((row_tile, k - kk), jnp.inf, dmat.dtype)
+                    ipad = jnp.full((row_tile, k - kk), -1, jnp.int32)
+                    return _merge_sorted_k(
+                        best, bidx,
+                        jnp.concatenate([-nv, pad], axis=1),
+                        jnp.concatenate([ni + base, ipad], axis=1), k,
+                    )
                 return _merge_sorted_k(best, bidx, -nv, ni + base, k)
 
             # Strict <: an element equal to the bound can never change the
@@ -561,6 +604,60 @@ def _knn_window_merge_chunk(
         return bd.at[loc].set(md), bi.at[loc].set(mi)
 
     return jax.lax.fori_loop(0, ids.shape[0], body, (best_d, best_i))
+
+
+#: Row-slot cap per FUSED window chunk: the fused kernel emits (slots, 128)
+#: f32 + int32 register outputs plus a (slots, 128) gathered row operand —
+#: ~1.5 KB/slot of chunk-lifetime HBM vs the XLA path's (slots, k). 2^19
+#: slots keeps that under ~800 MB; the XLA _BATCH_SLOT_BUDGET is untouched.
+_FUSED_SLOT_BUDGET = 1 << 19
+
+
+@partial(
+    jax.jit,
+    static_argnames=("k", "col_tile", "n_win_tiles", "interpret"),
+    donate_argnums=(0, 1),
+)
+def _knn_window_merge_chunk_fused(
+    best_d, best_i, ids, locs, data, data_t, colmask, start_tiles, k: int,
+    col_tile: int, n_win_tiles: int, interpret: bool,
+):
+    """Fused-kernel twin of :func:`_knn_window_merge_chunk` (euclidean, f32).
+
+    One ``knn_window_fused_pallas`` call reduces every tile's window to
+    (distance, id) registers ON-CHIP — no (row_tile, col_tile) tile ever
+    returns to XLA for ``top_k`` — then the same sequential dedup-merge
+    folds the per-tile lists into the donated buffers. The priming bound is
+    gathered ONCE per chunk (the XLA path re-gathers per tile): bounds only
+    tighten, so a chunk-stale bound is merely looser — fewer skips, same
+    exactness argument as the XLA guard.
+    """
+    from hdbscan_tpu.ops.pallas_knn import LANES, knn_window_fused_pallas
+
+    row_tile = ids.shape[1]
+    t_chunk = ids.shape[0]
+    d = data.shape[1]
+    xr = jnp.take(data, ids.reshape(-1), axis=0)
+    xr = jnp.pad(xr, ((0, 0), (0, LANES - d)))
+    bnd = jnp.take(best_d[:, k - 1], locs.reshape(-1))[:, None]
+    nd, ni = knn_window_fused_pallas(
+        xr, data_t, colmask, start_tiles, bnd, k,
+        row_tile=row_tile, col_tile=col_tile, n_win_tiles=n_win_tiles,
+        interpret=interpret,
+    )
+    nd = nd[:, :k].reshape(t_chunk, row_tile, k)
+    ni = ni[:, :k].reshape(t_chunk, row_tile, k)
+
+    def body(t, carry):
+        bd, bi = carry
+        loc = locs[t]
+        md, mi = _merge_knn_device(
+            jnp.take(bd, loc, axis=0), jnp.take(bi, loc, axis=0),
+            nd[t], ni[t], k,
+        )
+        return bd.at[loc].set(md), bi.at[loc].set(mi)
+
+    return jax.lax.fori_loop(0, t_chunk, body, (best_d, best_i))
 
 
 #: Foreign candidate edges retained PER ROW across glue rounds. Mid-Borůvka
@@ -719,6 +816,7 @@ def knn_rows_blockpruned(
     row_tile: int = 512,
     neighbor_rows: np.ndarray | None = None,
     probe_blocks: int = _KNN_PROBE_BLOCKS,
+    backend: str = "xla",
 ):
     """Exact core distances of selected rows via block-candidate windows.
 
@@ -749,6 +847,12 @@ def knn_rows_blockpruned(
     bounds with — typically the small glue subset, so the fetch stays tiny).
     ``return_neighbors`` is the all-rows convenience form
     (``neighbor_rows=arange(m)``).
+
+    ``backend="fused"`` routes every rescan chunk through the fused
+    distance+selection kernel (``_knn_window_merge_chunk_fused``) instead
+    of the guarded XLA top_k merge, with the usual fallback rules
+    (euclidean, d <= 128, k <= 128, f32 geometry; interpreter mode off-TPU
+    at small n only).
     """
     m = len(row_ids)
     k = max(min_pts - 1, 1)
@@ -773,27 +877,66 @@ def knn_rows_blockpruned(
     d = geom.data_host.shape[1]
     win_cols = geom.win_tiles * geom.col_tile
 
+    use_fused = False
+    if backend == "fused":
+        from hdbscan_tpu.ops.pallas_knn import LANES
+
+        on_tpu = jax.devices()[0].platform == "tpu"
+        use_fused = (
+            geom.metric == "euclidean"
+            and k <= LANES
+            and d <= LANES
+            and geom.data_sorted.dtype == jnp.float32
+            and (on_tpu or geom.n_pad <= (1 << 14))
+        )
+    if use_fused:
+        data_t_f, colmask_f = geom.fused_operands()
+        interp_f = jax.devices()[0].platform != "tpu"
+
     def scan_jobs(jobs, best_d, best_i):
         n_chunks = 0
-        for _metas, ids, starts, locs in _tiled_window_jobs(
-            jobs, lambda r: rows_sorted_pos[r], row_tile, dummy=m
+        for _metas, ids, starts, locs, n_real in _tiled_window_jobs(
+            jobs, lambda r: rows_sorted_pos[r], row_tile, dummy=m,
+            slot_budget=_FUSED_SLOT_BUDGET if use_fused else None,
         ):
             _flops.add_scan(
-                ids.shape[0] * row_tile, win_cols, d, row_tile=row_tile
+                n_real * row_tile, win_cols, d, row_tile=row_tile
             )
-            best_d, best_i = _knn_window_merge_chunk(
-                best_d,
-                best_i,
-                jnp.asarray(ids),
-                jnp.asarray(locs),
-                geom.data_sorted,
-                geom.valid_sorted,
-                jnp.asarray(starts),
-                k,
-                geom.metric,
-                geom.col_tile,
-                geom.win_tiles,
-            )
+            if ids.shape[0] > n_real:
+                _flops.add_pad_scan(
+                    (ids.shape[0] - n_real) * row_tile, win_cols, d
+                )
+            if use_fused:
+                best_d, best_i = _knn_window_merge_chunk_fused(
+                    best_d,
+                    best_i,
+                    jnp.asarray(ids),
+                    jnp.asarray(locs),
+                    geom.data_sorted,
+                    data_t_f,
+                    colmask_f,
+                    jnp.asarray(
+                        np.asarray(starts, np.int32) // geom.col_tile
+                    ),
+                    k,
+                    geom.col_tile,
+                    geom.win_tiles,
+                    interp_f,
+                )
+            else:
+                best_d, best_i = _knn_window_merge_chunk(
+                    best_d,
+                    best_i,
+                    jnp.asarray(ids),
+                    jnp.asarray(locs),
+                    geom.data_sorted,
+                    geom.valid_sorted,
+                    jnp.asarray(starts),
+                    k,
+                    geom.metric,
+                    geom.col_tile,
+                    geom.win_tiles,
+                )
             n_chunks += 1
             if n_chunks % _MERGE_SYNC_EVERY == 0:
                 jax.block_until_ready(best_d)
@@ -1077,15 +1220,21 @@ def boruvka_glue_edges_blockpruned(
 
             win_cols = geom.win_tiles * geom.col_tile
             n_chunks = 0
-            for _metas, idsc, starts, locs in _tiled_window_jobs(
+            for _metas, idsc, starts, locs, n_real in _tiled_window_jobs(
                 jobs, lambda r: geom.inv_perm[r], row_tile, dummy=m
             ):
                 _flops.add_scan(
-                    idsc.shape[0] * row_tile,
+                    n_real * row_tile,
                     win_cols,
                     data.shape[1],
                     row_tile=row_tile,
                 )
+                if idsc.shape[0] > n_real:
+                    _flops.add_pad_scan(
+                        (idsc.shape[0] - n_real) * row_tile,
+                        win_cols,
+                        data.shape[1],
+                    )
                 cand_w, cand_i = _min_out_window_merge_chunk(
                     cand_w,
                     cand_i,

@@ -43,6 +43,22 @@ Grid: (row_tiles, col_tiles), column-fastest; the output block for a row
 tile is revisited across the column sweep and accumulates the running k-best
 (ascending squared distances). Layout: feature axis padded to 128 lanes, k
 padded to 128 for the output tile; only the first k lanes are selected into.
+
+Round-6 fused selection (``_fused_knn_kernel`` / ``knn_core_distances_fused``):
+the r5 devicebench pinned the XLA scan as SELECTION-bound, not
+distance-bound — the matmul floor runs 3.5-3.6 TFLOP/s on the production
+shapes while the guarded scan achieves 694 GFLOP/s end-to-end, and the
+``lax.top_k`` + merge is ~90% of the on-chip time. The fused variant keeps
+running k-best (distance, index) registers in VMEM next to the MXU dot-form
+distance tiles and reduces every column tile on-chip with a k-pass
+compare-exchange merge, so no (rows, cols) tile is ever materialized for a
+general top-k. Tie-break contract: k smallest by (distance, column id)
+LEXICOGRAPHIC order — exactly what the guarded XLA scan produces (``top_k``
+prefers lower index; ``_merge_sorted_k``'s stable sort keeps earlier tiles,
+which under the ascending sweep are lower ids) — so the fused output matches
+the XLA scan tie-for-tie, indices included, independent of tile visit order.
+``knn_window_fused_pallas`` is the same reduction over scalar-prefetched
+fixed-width column windows (``ops/blockscan`` rescan chunks).
 """
 
 from __future__ import annotations
@@ -334,3 +350,347 @@ def knn_core_distances_pallas(
     else:
         core = knn[:, min(min_pts - 1, n) - 1].copy()
     return core, knn
+
+
+# --------------------------------------------------------------------------
+# Fused distance + top-k selection (round 6)
+# --------------------------------------------------------------------------
+
+
+def _dot_dist_tile(xr, xct, colmask):
+    """(r, c) euclidean DISTANCES of one tile pair, MXU dot form at full-f32
+    passes, masked columns pushed to +inf.
+
+    sqrt happens in-kernel (not on the host like the d2 kernel above): the
+    fused merge selects by (distance, id) and must order ties exactly like
+    the XLA scan, which compares sqrt'd values. Feature padding is zeros, so
+    the recomputed norms are sums of the same addends the unpadded operand
+    would give.
+    """
+    cross = jax.lax.dot_general(
+        xr,
+        xct,
+        (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    nr = jnp.sum(xr * xr, axis=1)
+    nc = jnp.sum(xct * xct, axis=0)
+    d2 = jnp.maximum(nr[:, None] + nc[None, :] - 2.0 * cross, 0.0)
+    return jnp.sqrt(d2) + colmask
+
+
+def _fused_merge_tile(outd_ref, outi_ref, dist, base, k: int):
+    """Merge one distance tile (global column ids ``base`` + column) into the
+    running (distance, id) k-best registers, ascending by (d, id) lex order.
+
+    Two-way merge of two lex-ascending streams: the running best (inserts
+    preserve order) and the tile minima (min-extraction; ``argmin`` takes the
+    first = lowest column among equal distances). Per slot t the lex-smaller
+    head wins; ties on distance go to the smaller global id — which is what
+    makes the result independent of tile visit order AND equal to the XLA
+    scan's arrival-order tie-break (ascending visits = ascending ids).
+    Empty slots carry (+inf, -1): a real inf column (masked padding) never
+    displaces one because its id >= 0 loses the lex tie to -1... the other
+    way around: (inf, id>=0) vs (inf, -1) keeps -1, since id < -1 is false.
+    """
+    r, c = dist.shape
+    col_iota = jax.lax.broadcasted_iota(jnp.int32, (r, c), 1)
+    bd = outd_ref[:]
+    bi = outi_ref[:]
+    cur = dist
+    for t in range(k):
+        m = jnp.min(cur, axis=1)
+        a = jnp.argmin(cur, axis=1).astype(jnp.int32)
+        mi = base + a
+        cd = bd[:, t]
+        ci = bi[:, t]
+        take = (m < cd) | ((m == cd) & (mi < ci))
+        cur = jnp.where((col_iota == a[:, None]) & take[:, None], jnp.inf, cur)
+        bd = _shift_insert(bd, t, jnp.where(take, m, cd), take)
+        bi = _shift_insert(bi, t, jnp.where(take, mi, ci), take)
+    outd_ref[:] = bd
+    outi_ref[:] = bi
+
+
+def _fused_knn_kernel(
+    xr_ref, xct_ref, colmask_ref, outd_ref, outi_ref, *,
+    k: int, col_tile: int, n_col_tiles: int, ratio: int, order: str,
+):
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        outd_ref[:] = jnp.full_like(outd_ref, jnp.inf)
+        outi_ref[:] = jnp.full_like(outi_ref, -1)
+
+    if order == "diag":
+        half = (j + 1) // 2
+        sign = 2 * (j % 2) - 1
+        ct = (i // ratio + sign * half) % n_col_tiles
+    else:
+        ct = j
+    base = ct * col_tile
+
+    dist = _dot_dist_tile(xr_ref[:], xct_ref[:], colmask_ref[:])
+
+    # Whole-tile skip, lex-aware: the tile's per-row head is its lex minimum
+    # (min distance, lowest column at it), so if no row's head lex-beats
+    # that row's current k-th (distance, id), no element of the tile can
+    # change the registers — including an id-only improvement on a distance
+    # tie, which a plain ``min < worst`` guard would wrongly skip under the
+    # out-of-order diag schedule.
+    m = jnp.min(dist, axis=1)
+    a = jnp.argmin(dist, axis=1).astype(jnp.int32)
+    head_i = base + a
+    worst_d = outd_ref[:, k - 1]
+    worst_i = outi_ref[:, k - 1]
+    tile_has_candidate = jnp.any(
+        (m < worst_d) | ((m == worst_d) & (head_i < worst_i))
+    )
+
+    @pl.when(tile_has_candidate)
+    def _():
+        _fused_merge_tile(outd_ref, outi_ref, dist, base, k)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("k", "row_tile", "col_tile", "order", "interpret"),
+)
+def knn_fused_pallas(
+    rows: jax.Array,
+    data_t: jax.Array,
+    colmask: jax.Array,
+    k: int,
+    row_tile: int = ROW_TILE,
+    col_tile: int = COL_TILE,
+    order: str = "scan",
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Fused scan: (m_pad, LANES) row operand vs (LANES, n_pad) transposed
+    column operand -> ((m_pad, LANES) f32 distances, (m_pad, LANES) int32
+    column ids), each row's k nearest ascending by (distance, id) in the
+    first k lanes, (+inf, -1) beyond. Self-scans pass the same data twice;
+    rectangular row subsets are allowed with ``order="scan"``.
+    """
+    m_pad = rows.shape[0]
+    n_pad = data_t.shape[1]
+    assert m_pad % row_tile == 0 and n_pad % col_tile == 0
+    n_col_tiles = n_pad // col_tile
+    if order == "diag":
+        if m_pad != n_pad:
+            raise ValueError("order='diag' needs a square self-scan")
+        if col_tile % row_tile != 0:
+            raise ValueError(
+                f"col_tile ({col_tile}) must be a multiple of row_tile "
+                f"({row_tile}) for the diagonal schedule"
+            )
+        ratio = col_tile // row_tile
+    elif order == "scan":
+        ratio = 1
+    else:  # pragma: no cover - guarded by callers
+        raise ValueError(f"unknown column order {order!r}")
+    grid = (m_pad // row_tile, n_col_tiles)
+
+    def col_at(i, j):
+        if order == "diag":
+            half = (j + 1) // 2
+            sign = 2 * (j % 2) - 1
+            return (i // ratio + sign * half) % n_col_tiles
+        return j
+
+    return pl.pallas_call(
+        partial(
+            _fused_knn_kernel,
+            k=k, col_tile=col_tile, n_col_tiles=n_col_tiles, ratio=ratio,
+            order=order,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (row_tile, LANES), lambda i, j: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (LANES, col_tile),
+                lambda i, j: (0, col_at(i, j)),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, col_tile), lambda i, j: (0, col_at(i, j)), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (row_tile, LANES), lambda i, j: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (row_tile, LANES), lambda i, j: (i, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m_pad, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((m_pad, LANES), jnp.int32),
+        ],
+        interpret=interpret,
+    )(rows, data_t, colmask)
+
+
+def knn_core_distances_fused(
+    data: np.ndarray,
+    min_pts: int,
+    k: int | None = None,
+    row_tile: int = ROW_TILE,
+    col_tile: int = COL_TILE,
+    order: str = "scan",
+    interpret: bool = False,
+    fetch_knn: bool = True,
+    return_indices: bool = False,
+):
+    """Drop-in for ``ops.tiled.knn_core_distances`` via the fused kernel.
+
+    Same return contract: ``(core, knn)``, ``(core, None)`` with
+    ``fetch_knn=False`` (k-th column only crosses the tunnel), or
+    ``(core, knn, idx)`` with ``return_indices`` — and unlike the d2 kernel
+    above, indices come for free from the fused registers. Default
+    ``order="scan"`` keeps the lex (distance, id) tie-break in ORIGINAL id
+    space, matching the XLA scan output exactly, ties included.
+    ``order="diag"`` Morton-sorts rows first: distances are unchanged, but
+    distance ties resolve by Morton-space id (still deterministic).
+    """
+    n, d = data.shape
+    if d > LANES:
+        raise ValueError(f"fused knn kernel supports d <= {LANES}, got {d}")
+    k = max(k or 0, max(min_pts - 1, 1))
+    if k > LANES:
+        raise ValueError(f"fused knn kernel supports k <= {LANES}, got {k}")
+    fetch_knn = fetch_knn or return_indices
+    perm = None
+    if order == "diag":
+        perm = morton_order(data)
+        data = np.asarray(data)[perm]
+    n_pad = max(col_tile, row_tile)
+    while n_pad < n:
+        n_pad *= 2
+    x = np.zeros((n_pad, LANES), np.float32)
+    x[:n, :d] = data
+    colmask = np.full((1, n_pad), np.inf, np.float32)
+    colmask[0, :n] = 0.0
+    from hdbscan_tpu.utils.flops import counter as _flops
+
+    _flops.add_scan(n_pad, n_pad, d, row_tile=row_tile)
+    xj, xtj, mj = jax.device_put((x, np.ascontiguousarray(x.T), colmask))
+    dd, ii = knn_fused_pallas(
+        xj, xtj, mj, k,
+        row_tile=row_tile, col_tile=col_tile, order=order, interpret=interpret,
+    )
+    inv = None
+    if perm is not None:
+        inv = np.empty_like(perm)
+        inv[perm] = np.arange(n)
+    if not fetch_knn:
+        kth_col = min(max(min_pts - 1, 1), n) - 1
+        kth = np.asarray(dd[:, kth_col], np.float64)[:n]
+        if inv is not None:
+            kth = kth[inv]
+        core = np.zeros(n, np.float64) if min_pts <= 1 else kth
+        return core, None
+    knn = np.asarray(dd, np.float64)[:n, :k]
+    idx = np.asarray(ii, np.int64)[:n, :k]
+    if perm is not None:
+        knn = knn[inv]
+        idx = idx[inv]
+        idx = np.where(idx >= 0, perm[np.maximum(idx, 0)], -1)
+    if min_pts <= 1:
+        core = np.zeros(n, np.float64)
+    else:
+        core = knn[:, min(min_pts - 1, n) - 1].copy()
+    if return_indices:
+        return core, knn, idx
+    return core, knn
+
+
+def _fused_window_kernel(
+    wstart_ref, xr_ref, xct_ref, colmask_ref, bnd_ref, outd_ref, outi_ref, *,
+    k: int, col_tile: int,
+):
+    t = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        outd_ref[:] = jnp.full_like(outd_ref, jnp.inf)
+        outi_ref[:] = jnp.full_like(outi_ref, -1)
+
+    base = (wstart_ref[t] + j) * col_tile
+    dist = _dot_dist_tile(xr_ref[:], xct_ref[:], colmask_ref[:])
+
+    # Guard mirrors the XLA window chunk (strict <, see
+    # blockscan._knn_window_merge_chunk): ``bnd`` is the row's CURRENT outer
+    # merge-buffer k-th, and an element >= it can never enter the final
+    # dedup-merged list, so tiles above both bounds skip the merge. Windows
+    # sweep ascending ids only, so no lex-tie term is needed here (an
+    # id-improving distance tie cannot arrive after its distance peer).
+    m = jnp.min(dist, axis=1)
+    worst_d = outd_ref[:, k - 1]
+    bound = jnp.minimum(worst_d, bnd_ref[:, 0])
+    tile_has_candidate = jnp.any(m < bound)
+
+    @pl.when(tile_has_candidate)
+    def _():
+        _fused_merge_tile(outd_ref, outi_ref, dist, base, k)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("k", "row_tile", "col_tile", "n_win_tiles", "interpret"),
+)
+def knn_window_fused_pallas(
+    rows: jax.Array,
+    data_t: jax.Array,
+    colmask: jax.Array,
+    wstart_tiles: jax.Array,
+    bnd: jax.Array,
+    k: int,
+    row_tile: int,
+    col_tile: int,
+    n_win_tiles: int,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Fused per-row-tile WINDOW scan for the blockscan rescan chunks.
+
+    ``rows``: (T*row_tile, LANES) gathered+padded row operand; ``data_t``:
+    (LANES, n_pad) transposed padded column copy; ``colmask``: (1, n_pad)
+    0/+inf; ``wstart_tiles``: (T,) int32 per-tile window origin in COLUMN
+    TILE units, scalar-prefetched so each grid step's column block is
+    ``wstart_tiles[t] + j`` (the window machinery keeps origins
+    col_tile-aligned — ``BlockGeometry.build``); ``bnd``: (T*row_tile, 1)
+    f32 outer-buffer k-th priming bound. Returns the same (d, id) register
+    layout as :func:`knn_fused_pallas`, ids in sorted column space.
+    """
+    t_total = rows.shape[0]
+    assert t_total % row_tile == 0
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(t_total // row_tile, n_win_tiles),
+        in_specs=[
+            pl.BlockSpec((row_tile, LANES), lambda t, j, s: (t, 0)),
+            pl.BlockSpec((LANES, col_tile), lambda t, j, s: (0, s[t] + j)),
+            pl.BlockSpec((1, col_tile), lambda t, j, s: (0, s[t] + j)),
+            pl.BlockSpec((row_tile, 1), lambda t, j, s: (t, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((row_tile, LANES), lambda t, j, s: (t, 0)),
+            pl.BlockSpec((row_tile, LANES), lambda t, j, s: (t, 0)),
+        ],
+    )
+    return pl.pallas_call(
+        partial(_fused_window_kernel, k=k, col_tile=col_tile),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((t_total, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((t_total, LANES), jnp.int32),
+        ],
+        interpret=interpret,
+    )(wstart_tiles, rows, data_t, colmask, bnd)

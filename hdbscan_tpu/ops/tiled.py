@@ -237,8 +237,16 @@ def knn_core_distances(
     (n, k) int64 neighbor-id matrix is appended (self appears at distance 0).
 
     ``backend``: "auto" (XLA scan, except the Pallas MXU dot-form kernel
-    for euclidean at d >= ``_PALLAS_MIN_D`` on a real TPU), "xla", or
-    "pallas" (force the kernel at any d).
+    for euclidean at d >= ``_PALLAS_MIN_D`` on a real TPU), "xla",
+    "pallas" (force the distance kernel at any d), or "fused" (the r6
+    fused distance+selection kernel — on-chip k-best registers instead of
+    ``lax.top_k`` round trips; supports ``return_indices`` and matches this
+    scan tie-for-tie). "fused" falls back to the guarded XLA scan when the
+    kernel cannot run (non-euclidean, d > 128, k > 128, non-f32 dtype) —
+    it is the config-knob backend (``HDBSCANParams.knn_backend``) and must
+    be safe under every parameterization; off-TPU it runs the kernel in
+    interpreter mode at small n (tests) and falls back above that (the
+    emulation is orders of magnitude slower than XLA-on-CPU).
 
     ``fetch_knn=False`` returns ``(core, None)`` and fetches only the
     (rows,) k-th column per chunk instead of the (rows, k) list — a 15x
@@ -251,9 +259,31 @@ def knn_core_distances(
     # Reference semantics: core distance = largest of the (minPts - 1)
     # smallest distances with self included (core/knn.py, HDBSCANStar.java:71-106).
     k = max(k or 0, max(min_pts - 1, 1))
-    if backend not in ("auto", "xla", "pallas"):
-        raise ValueError(f"unknown backend {backend!r}: auto | xla | pallas")
+    if backend not in ("auto", "xla", "pallas", "fused"):
+        raise ValueError(
+            f"unknown backend {backend!r}: auto | xla | pallas | fused"
+        )
     data = np.asarray(data)
+    if backend == "fused":
+        on_tpu = jax.devices()[0].platform == "tpu"
+        fusable = (
+            metric == "euclidean"
+            and k <= 128
+            and data.shape[1] <= 128
+            and dtype is np.float32
+            # Off-TPU the kernel only exists in interpreter mode — fine for
+            # CPU tests at small n, pathological beyond (the interpreter
+            # replays every grid step through XLA-on-CPU).
+            and (on_tpu or n <= (1 << 14))
+        )
+        if fusable:
+            from hdbscan_tpu.ops.pallas_knn import knn_core_distances_fused
+
+            return knn_core_distances_fused(
+                data, min_pts, k=k, fetch_knn=fetch_knn,
+                return_indices=return_indices, interpret=not on_tpu,
+            )
+        backend = "xla"  # guarded scan fallback (documented above)
     eligible = (
         metric == "euclidean"
         and not return_indices
@@ -347,6 +377,7 @@ def knn_core_distances_rows(
     row_tile: int = 1024,
     col_tile: int = 8192,
     dtype=np.float32,
+    backend: str = "xla",
 ) -> np.ndarray:
     """Exact core distances for SELECTED rows against the whole dataset.
 
@@ -355,12 +386,27 @@ def knn_core_distances_rows(
     the full O(n²·d) pass — while interior points keep their per-block core
     distances (their k-NN ball is inside their block by construction).
     Returns (m,) core distances aligned with ``row_ids``.
+
+    ``backend="fused"`` rides the rectangular form of the fused
+    distance+selection kernel (``pallas_knn.knn_fused_pallas``) with the
+    same guarded-XLA fallback rules as :func:`knn_core_distances`.
     """
     n = len(data)
     m = len(row_ids)
     if m == 0:
         return np.zeros(0, np.float64)
     k = max(min_pts - 1, 1)
+    if backend == "fused":
+        on_tpu = jax.devices()[0].platform == "tpu"
+        if (
+            metric == "euclidean"
+            and k <= 128
+            and data.shape[1] <= 128
+            and dtype is np.float32
+            and (on_tpu or n <= (1 << 14))
+        ):
+            return _knn_rows_fused(data, row_ids, min_pts, k, interpret=not on_tpu)
+        # fall through: guarded XLA scan
     row_tile, col_tile, n_pad = _tile_sizes(n, row_tile, col_tile)
     data_p = jnp.asarray(_pad_rows(np.asarray(data, dtype), n_pad))
     valid_p = jnp.asarray(np.arange(n_pad) < n)
@@ -393,6 +439,46 @@ def knn_core_distances_rows(
     if min_pts <= 1:
         return np.zeros(m, np.float64)
     return kth
+
+
+def _knn_rows_fused(
+    data: np.ndarray, row_ids: np.ndarray, min_pts: int, k: int,
+    interpret: bool,
+) -> np.ndarray:
+    """Rectangular fused-kernel leg of :func:`knn_core_distances_rows`:
+    selected rows vs all columns, k-th distance fetched per chunk."""
+    from hdbscan_tpu.ops.pallas_knn import (
+        COL_TILE, LANES, ROW_TILE, knn_fused_pallas,
+    )
+
+    n, d = np.asarray(data).shape
+    m = len(row_ids)
+    n_pad = _round_up(max(n, COL_TILE), COL_TILE)
+    m_pad = _round_up(m, ROW_TILE)
+    x = np.zeros((n_pad, LANES), np.float32)
+    x[:n, :d] = data
+    rows = np.zeros((m_pad, LANES), np.float32)
+    rows[:m, :d] = np.asarray(data)[row_ids]
+    colmask = np.full((1, n_pad), np.inf, np.float32)
+    colmask[0, :n] = 0.0
+    xt_j, mask_j, rows_j = jax.device_put(
+        (np.ascontiguousarray(x.T), colmask, rows)
+    )
+    from hdbscan_tpu.utils.flops import counter as _flops
+
+    _flops.add_scan(m_pad, n_pad, d, row_tile=ROW_TILE)
+    chunk_rows = _chunk_rows(n_pad, ROW_TILE, m_pad)
+    kth_col = min(max(min_pts - 1, 1), n) - 1
+    fetched = _drain_window(
+        knn_fused_pallas(
+            rows_j[a : min(a + chunk_rows, m_pad)], xt_j, mask_j, k,
+            interpret=interpret,
+        )[0][:, kth_col]
+        for a in range(0, m_pad, chunk_rows)
+    )
+    if min_pts <= 1:
+        return np.zeros(m, np.float64)
+    return np.concatenate([np.asarray(c, np.float64) for c in fetched])[:m]
 
 
 def _round_up(x: int, m: int) -> int:

@@ -15,7 +15,22 @@ Conventions (documented, not measured):
 - FLOPs: ``2 * rows * cols * d`` per distance tile — the dominant matmul
   term of the euclidean expansion (manhattan/supremum do comparable VPU
   work per element; the same count keeps phases comparable). Selection
-  (top_k) and masking are ignored: at d >= 3 the distance term dominates.
+  (top_k) and masking are NOT credited — but not because they are cheap:
+  the r5 devicebench measured selection at ~90% of the on-chip scan TIME
+  (devicebench_r5.jsonl, 500k x 28: scan_e2e_guarded 694 GFLOP/s vs the
+  3.5-3.6 TFLOP/s matmul_floor on identical shapes — the distance+min
+  floor is ~0.5 s of a ~5 s guarded scan). The counter stays
+  distance-FLOPs-only as a comparable WORK unit across backends and
+  rounds; achieved-GFLOP gaps against the matmul floor are the selection
+  overhead, which is what the fused kernel (``ops/pallas_knn``,
+  ``scan_e2e_fused`` devicebench leg) attacks.
+- Pad FLOPs: window chunks padded up to ``_MIN_CHUNK_TILES`` (compile-storm
+  cap, ops/blockscan) scan dummy tiles whose work is real device time but
+  not useful output. Dispatch sites credit those tiles to the SEPARATE
+  ``pad_flops`` counter (``add_pad_scan``) so phase GFLOP/MFU rows stay
+  comparable to pre-r5 data — counting them as useful work inflated
+  1-tile jobs up to 64x. ``phase_stats`` reports ``pad_gflops`` when
+  nonzero.
 - Bytes: modeled HBM traffic of the streaming schedule — every ROW TILE
   re-reads its full column window from HBM (``cols * d * itemsize`` per
   tile), plus one pass over the row block. VMEM reuse within a tile is
@@ -44,10 +59,14 @@ F32_SCAN_CEILING = 1.0 / 6.0
 
 @dataclass
 class ScanCounter:
-    """Monotonic analytic counters; phases diff :meth:`snapshot` pairs."""
+    """Monotonic analytic counters; phases diff :meth:`snapshot` tuples."""
 
     flops: float = 0.0
     bytes: float = 0.0
+    #: Distance FLOPs burned on PAD tiles (chunk padding to the compile-storm
+    #: floor) — real device time, not useful work; kept out of ``flops`` so
+    #: achieved-GFLOP rows measure the useful scan.
+    pad_flops: float = 0.0
 
     def add(self, flops: float, nbytes: float) -> None:
         self.flops += flops
@@ -63,22 +82,30 @@ class ScanCounter:
             (n_row_tiles * cols * d + rows * d) * itemsize,
         )
 
-    def snapshot(self) -> tuple[float, float]:
-        return self.flops, self.bytes
+    def add_pad_scan(self, rows: int, cols: int, d: int) -> None:
+        """Credit pad-tile distance work (same model, separate bucket)."""
+        self.pad_flops += 2.0 * rows * cols * d
+
+    def snapshot(self) -> tuple[float, float, float]:
+        return self.flops, self.bytes, self.pad_flops
 
 
 #: The process-wide counter every dispatch site credits.
 counter = ScanCounter()
 
 
-def phase_stats(t0_snap: tuple[float, float], wall_s: float) -> dict:
+def phase_stats(t0_snap: tuple, wall_s: float) -> dict:
     """Trace-field dict for a phase: FLOPs/bytes since ``t0_snap``, achieved
-    GFLOP/s + GB/s, and MFU vs :data:`PEAK_FLOPS` (0 fields dropped)."""
+    GFLOP/s + GB/s, and MFU vs :data:`PEAK_FLOPS` (0 fields dropped).
+    Accepts legacy 2-tuple snapshots (no pad counter)."""
     df = counter.flops - t0_snap[0]
     db = counter.bytes - t0_snap[1]
-    if df <= 0 and db <= 0:
+    dp = counter.pad_flops - (t0_snap[2] if len(t0_snap) > 2 else 0.0)
+    if df <= 0 and db <= 0 and dp <= 0:
         return {}
     out = {"gflops": round(df / 1e9, 1), "gbytes": round(db / 1e9, 2)}
+    if dp > 0:
+        out["pad_gflops"] = round(dp / 1e9, 1)
     if wall_s > 0:
         out["gflops_s"] = round(df / wall_s / 1e9, 1)
         out["gbytes_s"] = round(db / wall_s / 1e9, 2)

@@ -18,7 +18,13 @@ real-chip multi-M campaign rows whose ARI-vs-truth pins end-to-end
 correctness (benchmarks/boundary_eval_r*.jsonl).
 
 Slow tier: ~minutes on the CPU mesh — gated behind HDBSCAN_TPU_SLOW=1 so
-the default suite stays fast. Run with:
+the default suite stays fast. The DOCUMENTED entry point is the slow lane
+(README "Testing"), which self-provisions the 8-device virtual mesh and
+runs this test body after the dry run::
+
+    python -c "import __graft_entry__ as g; g.dryrun_multichip(8, slow=True)"
+
+Direct pytest invocation still works for iterating on the test itself:
     HDBSCAN_TPU_SLOW=1 python -m pytest tests/e2e/test_mesh_100k.py -q
 """
 

@@ -164,6 +164,83 @@ class TestPrunedCoreScan:
         )
 
 
+class TestWindowMergeKClamp:
+    def test_k_exceeds_col_tile(self, rng):
+        """min_pts - 1 > col_tile must trace — kk = min(k, col_tile) clamp
+        + (inf, -1) padding in _knn_window_merge_chunk, mirroring
+        _knn_core_scan (ADVICE r5 #1) — and stay exact vs the full sweep."""
+        pts = rng.normal(size=(400, 3))
+        geom = BlockGeometry.build(pts, np.arange(400) // 100, col_tile=128)
+        min_pts = 130  # k = 129 > col_tile = 128
+        got = knn_rows_blockpruned(
+            geom, np.arange(400), np.full(400, np.inf), min_pts, row_tile=64
+        )
+        want = tiled.knn_core_distances_rows(
+            pts, np.arange(400), min_pts, row_tile=64, col_tile=256
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestFusedWindowBackend:
+    """backend="fused" through knn_rows_blockpruned: the window-merge rescan
+    under the r6 fused kernel must match the XLA chunk path tie-for-tie.
+    Integer-lattice data makes both forms bitwise exact (see
+    test_pallas_knn._lattice) with abundant real ties."""
+
+    def test_fused_matches_xla_exactly(self, rng):
+        pts, block_of = _blocky_data(rng, n=1200, d=3)
+        pts = np.round(pts * 2.0)  # lattice-ize: exact f32 in both forms
+        min_pts = 6
+        ub = _per_block_cores(pts, block_of, min_pts)
+        bset = np.arange(0, 1200, 2)
+        geom = BlockGeometry.build(pts, block_of, col_tile=256)
+        core_x, kd_x, kj_x = knn_rows_blockpruned(
+            geom, bset, ub[bset], min_pts, return_neighbors=True,
+            row_tile=64, backend="xla",
+        )
+        core_f, kd_f, kj_f = knn_rows_blockpruned(
+            geom, bset, ub[bset], min_pts, return_neighbors=True,
+            row_tile=64, backend="fused",
+        )
+        np.testing.assert_array_equal(core_f, core_x)
+        np.testing.assert_array_equal(kd_f, kd_x)
+        np.testing.assert_array_equal(kj_f, kj_x)
+
+    def test_fused_under_forced_chunk_splits(self, rng, monkeypatch):
+        """The fused path has its own slot budget (_FUSED_SLOT_BUDGET);
+        squeeze it so multi-chunk dispatch + cross-chunk merges engage."""
+        import hdbscan_tpu.ops.blockscan as bs
+
+        monkeypatch.setattr(bs, "_FUSED_SLOT_BUDGET", 256)  # 4 tiles/chunk
+        pts, block_of = _blocky_data(rng, n=900, d=3)
+        pts = np.round(pts * 2.0)
+        min_pts = 6
+        ub = _per_block_cores(pts, block_of, min_pts)
+        bset = np.arange(900)
+        geom = BlockGeometry.build(pts, block_of, col_tile=256)
+        core_f = knn_rows_blockpruned(
+            geom, bset, ub[bset], min_pts, row_tile=64, backend="fused"
+        )
+        core_x = knn_rows_blockpruned(
+            geom, bset, ub[bset], min_pts, row_tile=64, backend="xla"
+        )
+        np.testing.assert_array_equal(core_f, core_x)
+
+    def test_fused_non_euclidean_falls_back(self, rng):
+        pts, block_of = _blocky_data(rng, n=600, d=3)
+        min_pts = 5
+        ub = _per_block_cores(pts, block_of, min_pts, "manhattan")
+        geom = BlockGeometry.build(pts, block_of, "manhattan", col_tile=256)
+        bset = np.arange(0, 600, 2)
+        got = knn_rows_blockpruned(
+            geom, bset, ub[bset], min_pts, row_tile=64, backend="fused"
+        )
+        want = knn_rows_blockpruned(
+            geom, bset, ub[bset], min_pts, row_tile=64, backend="xla"
+        )
+        np.testing.assert_array_equal(got, want)
+
+
 class TestPrunedGlue:
     def _knn_graph(self, pts, block_of, core, min_pts):
         geom = BlockGeometry.build(pts, block_of, col_tile=256)

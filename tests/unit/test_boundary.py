@@ -180,6 +180,38 @@ def test_boundary_config_validation():
     assert p.boundary_quality == 0.05
 
 
+def test_knn_backend_flag():
+    p = HDBSCANParams.from_args(["knn_backend=fused"])
+    assert p.knn_backend == "fused"
+    assert HDBSCANParams().knn_backend == "auto"
+    with pytest.raises(ValueError, match="knn_backend"):
+        HDBSCANParams(knn_backend="mxu")
+
+
+def test_select_boundary_default_max_frac_resolves_late(monkeypatch):
+    """max_frac=None resolves the dataclass field default AT CALL TIME, not
+    import time (r6 satellite: the old ``max_frac=HDBSCANParams.
+    boundary_max_frac`` default froze the class attribute into the
+    signature, so tuning the class default had no effect on callers)."""
+    import warnings
+
+    from hdbscan_tpu import config as config_mod
+
+    n = 1000
+    margin = np.linspace(0.0, 1.0, n)
+    subset = np.zeros(n, np.int64)
+    core = np.full(n, 10.0)  # runaway adaptive set -> cap engages
+    monkeypatch.setattr(
+        config_mod.HDBSCANParams.__dataclass_fields__["boundary_max_frac"],
+        "default",
+        0.125,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        sel = _select_boundary(margin, subset, q=0.01, core=core, min_per_block=1)
+    assert len(sel) == int(np.ceil(0.125 * n))
+
+
 def test_boundary_mode_recovers_exact_tree(rng):
     # Anisotropic blobs with touching tails: per-block cores alone distort
     # the seams; the boundary pass must bring the fit to the exact flat cut.

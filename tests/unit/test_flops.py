@@ -27,6 +27,35 @@ class TestScanCounter:
         snap = flops_mod.counter.snapshot()
         assert flops_mod.phase_stats(snap, 1.0) == {}
 
+    def test_pad_scan_separate_bucket(self):
+        """Pad-tile work (chunk padding to _MIN_CHUNK_TILES) goes to its own
+        counter: useful-work gflops stay clean, pad_gflops is reported
+        separately (ADVICE r5 #2 — counting pads inflated 1-tile jobs 64x)."""
+        c = flops_mod.ScanCounter()
+        c.add_pad_scan(rows=256, cols=1024, d=8)
+        assert c.pad_flops == 2.0 * 256 * 1024 * 8
+        assert c.flops == 0.0
+        assert len(c.snapshot()) == 3
+
+    def test_phase_stats_reports_pad_gflops(self):
+        snap = flops_mod.counter.snapshot()
+        flops_mod.counter.add(2e9, 1e9)
+        flops_mod.counter.add_pad_scan(rows=1000, cols=1000, d=500)  # 1e9
+        stats = flops_mod.phase_stats(snap, wall_s=2.0)
+        assert stats["gflops"] == 2.0  # pads NOT in the useful-work figure
+        assert stats["pad_gflops"] == 1.0
+        # Legacy 2-tuple snapshots (pre-r6 checkpointed phases) still work.
+        legacy = flops_mod.phase_stats(snap[:2], wall_s=2.0)
+        assert legacy["gflops"] == 2.0
+
+    def test_pad_only_phase_not_empty(self):
+        """A phase whose only device work was pad tiles still reports."""
+        snap = flops_mod.counter.snapshot()
+        flops_mod.counter.add_pad_scan(rows=1000, cols=1000, d=500)
+        stats = flops_mod.phase_stats(snap, 1.0)
+        assert stats["pad_gflops"] == 1.0
+        assert stats["gflops"] == 0.0
+
 
 class TestDispatchSitesCredit:
     def test_tiled_knn_credits(self):

@@ -69,6 +69,131 @@ class TestPallasKnnKernel:
         )
 
 
+def _lattice(rng, n=500, d=3, hi=6):
+    """Small-integer data: every f32 distance is exact in BOTH the diff and
+    dot forms (squared distances are small integers), so fused-vs-XLA
+    comparisons can demand bitwise equality — with abundant genuine ties to
+    exercise the lex (distance, id) tie-break contract."""
+    return rng.integers(0, hi, size=(n, d)).astype(np.float64)
+
+
+class TestFusedKnnKernel:
+    """Fused distance+selection kernel (r6): on-chip k-best registers must
+    match the guarded XLA scan EXACTLY — indices and distances, tie-for-tie
+    (ISSUE r6 acceptance)."""
+
+    def test_exact_match_xla_on_integer_ties(self, rng):
+        from hdbscan_tpu.ops.pallas_knn import knn_core_distances_fused
+
+        data = _lattice(rng)
+        core_f, knn_f, idx_f = knn_core_distances_fused(
+            data, 8, row_tile=64, col_tile=128, interpret=True,
+            return_indices=True,
+        )
+        core_x, knn_x, idx_x = knn_core_distances(
+            data, 8, return_indices=True, backend="xla"
+        )
+        np.testing.assert_array_equal(core_f, core_x)
+        np.testing.assert_array_equal(knn_f, knn_x)
+        np.testing.assert_array_equal(idx_f, idx_x)
+
+    def test_kth_only_fast_path_exact(self, rng):
+        from hdbscan_tpu.ops.pallas_knn import knn_core_distances_fused
+
+        data = _lattice(rng, n=700)
+        core_f, none = knn_core_distances_fused(
+            data, 8, row_tile=64, col_tile=128, interpret=True,
+            fetch_knn=False,
+        )
+        assert none is None
+        core_x, _ = knn_core_distances(data, 8, fetch_knn=False, backend="xla")
+        np.testing.assert_array_equal(core_f, core_x)
+
+    def test_diag_order_matches_scan_order(self, rng):
+        """The out-of-order diag schedule is pure visit order: the lex
+        merge makes results schedule-invariant, so diag == scan exactly
+        (continuous data — diag resolves ties in Morton id space by
+        design, so tie equality is asserted on the scan order only)."""
+        from hdbscan_tpu.ops.pallas_knn import knn_core_distances_fused
+
+        data = rng.normal(size=(600, 5))
+        out_d = knn_core_distances_fused(
+            data, 8, row_tile=64, col_tile=128, order="diag",
+            interpret=True, return_indices=True,
+        )
+        out_s = knn_core_distances_fused(
+            data, 8, row_tile=64, col_tile=128, order="scan",
+            interpret=True, return_indices=True,
+        )
+        for a, b in zip(out_d, out_s):
+            np.testing.assert_array_equal(a, b)
+
+    def test_random_data_within_dot_form_cancellation(self, rng):
+        """Continuous data: the dot form's self/near-duplicate cancellation
+        (~sqrt(eps)*|x|) is the only deviation from the XLA diff-form scan."""
+        from hdbscan_tpu.ops.pallas_knn import knn_core_distances_fused
+
+        data = rng.normal(size=(500, 10))
+        core_f, knn_f = knn_core_distances_fused(
+            data, 8, row_tile=64, col_tile=128, interpret=True
+        )
+        core_x, knn_x = knn_core_distances(data, 8, backend="xla")
+        np.testing.assert_allclose(core_f, core_x, atol=5e-3, rtol=1e-4)
+        np.testing.assert_allclose(knn_f, knn_x, atol=5e-3, rtol=1e-4)
+
+    def test_duplicate_ties_pick_lowest_ids(self, rng):
+        """Heavy duplication: every distance in a duplicate group ties at 0
+        and the ids must come back ascending from the lowest column id —
+        the XLA top_k contract the fused merge pins."""
+        from hdbscan_tpu.ops.pallas_knn import knn_core_distances_fused
+
+        data = np.repeat(_lattice(rng, n=60, hi=20), 8, axis=0)
+        out_f = knn_core_distances_fused(
+            data, 6, row_tile=64, col_tile=128, interpret=True,
+            return_indices=True,
+        )
+        out_x = knn_core_distances(data, 6, return_indices=True, backend="xla")
+        for a, b in zip(out_f, out_x):
+            np.testing.assert_array_equal(a, b)
+
+    def test_dispatcher_backend_fused(self, rng):
+        """backend="fused" through the public tiled entry point: equal to
+        the XLA scan on integer data; silent guarded-XLA fallback where the
+        kernel is ineligible (non-euclidean metric)."""
+        data = _lattice(rng, n=400)
+        core_f, knn_f = knn_core_distances(data, 8, backend="fused")
+        core_x, knn_x = knn_core_distances(data, 8, backend="xla")
+        np.testing.assert_array_equal(core_f, core_x)
+        np.testing.assert_array_equal(knn_f, knn_x)
+        core_m, _ = knn_core_distances(
+            data, 8, "manhattan", backend="fused"
+        )
+        core_mx, _ = knn_core_distances(data, 8, "manhattan", backend="xla")
+        np.testing.assert_array_equal(core_m, core_mx)
+
+    def test_rows_backend_fused(self, rng):
+        """The rectangular row-subset form under backend="fused"."""
+        from hdbscan_tpu.ops.tiled import knn_core_distances_rows
+
+        data = _lattice(rng, n=900)
+        row_ids = np.arange(0, 900, 3)
+        got = knn_core_distances_rows(data, row_ids, 8, backend="fused")
+        want = knn_core_distances_rows(data, row_ids, 8, backend="xla")
+        np.testing.assert_array_equal(got, want)
+
+    def test_dimension_and_k_limits(self, rng):
+        from hdbscan_tpu.ops.pallas_knn import knn_core_distances_fused
+
+        with pytest.raises(ValueError):
+            knn_core_distances_fused(
+                rng.normal(size=(10, 200)), 4, interpret=True
+            )
+        with pytest.raises(ValueError):
+            knn_core_distances_fused(
+                rng.normal(size=(300, 3)), 200, interpret=True
+            )
+
+
 class TestMortonOrder:
     def test_is_permutation(self, rng):
         from hdbscan_tpu.ops.pallas_knn import morton_order

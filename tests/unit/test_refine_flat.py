@@ -58,6 +58,26 @@ class TestRefineFlat:
         assert len(evs) <= 8
         assert evs[-1].fields["changed"] == 0 or len(evs) == 8
 
+    def test_convergence_is_relabel_invariant(self):
+        """A pure cluster renumbering between passes must read as converged
+        (r6 satellite: the old ``labels != prev`` test kept iterating on
+        permuted-but-identical partitions until the budget ran out)."""
+        f = mr_hdbscan._same_flat_partition
+        a = np.array([0, 1, 1, 2, 2, 2, 0])
+        # Renumbered (1<->2 swapped): same partition.
+        assert f(a, np.array([0, 2, 2, 1, 1, 1, 0]))
+        assert f(a, a)
+        # A genuine membership move is NOT converged.
+        assert not f(a, np.array([0, 1, 2, 2, 2, 2, 0]))
+        # Noise (label 0) is pinned, not a renumberable cluster: a point
+        # flipping between noise and a cluster changes the partition.
+        assert not f(a, np.array([1, 1, 1, 2, 2, 2, 0]))
+        # Two clusters merging into one is not a bijection.
+        assert not f(a, np.array([0, 1, 1, 1, 1, 1, 0]))
+        # All-noise vs all-noise trivially converged.
+        z = np.zeros(4, np.int64)
+        assert f(z, z.copy())
+
     def test_zero_iterations_is_default_noop(self):
         data = _lattice(5)
         p0 = HDBSCANParams(**BASE, seed=0)
