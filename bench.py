@@ -3,6 +3,13 @@
 Prints ONE JSON line ``{"metric": ..., "value": <wall s>, "unit": "s",
 "vs_baseline": <x>}`` plus context fields.
 
+Telemetry: ``--trace-out PATH`` / ``--report PATH`` (same contract as the
+CLI, README "Observability") persist every pipeline stage event across the
+warm+timed runs as JSONL and write a run-report JSON with the manifest,
+per-phase aggregates, device memory samples and per-phase compile counts.
+Flags absent = no telemetry I/O, fit calls get ``trace=None`` exactly as
+before.
+
 Headline metric (BASELINE.md north star: "cluster Skin_NonSkin end-to-end on
 a single TPU slice faster than the 8-worker MapReduce CPU baseline with an
 identical condensed cluster tree"): the EXACT blocked-Borůvka path
@@ -36,14 +43,37 @@ CAL_MIN_PTS = 8  # calibrated macro-structure setting
 MIN_CL_SIZE = 3000
 
 
-def main() -> None:
+def main(argv: list[str] | None = None) -> None:
     import jax
 
+    from hdbscan_tpu.cli import _pop_path_flag
     from hdbscan_tpu.config import HDBSCANParams
     from hdbscan_tpu.models import exact, mr_hdbscan
     from hdbscan_tpu.parallel.mesh import get_mesh
     from hdbscan_tpu.utils.cache import enable_persistent_compilation_cache
     from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    argv_full = list(argv)
+    trace_out = _pop_path_flag(argv, "--trace-out")
+    report_out = _pop_path_flag(argv, "--report")
+    if argv:
+        raise SystemExit(f"bench.py: unknown arguments {argv!r}")
+
+    tracer = None
+    mem_start = None
+    if trace_out is not None or report_out is not None:
+        from hdbscan_tpu.utils import telemetry
+        from hdbscan_tpu.utils.tracing import JsonlSink, Tracer
+
+        sinks = []
+        if trace_out is not None:
+            sinks.append(JsonlSink(trace_out, static={"bench": True}))
+        tracer = Tracer(
+            sinks=sinks, counters={"jit_compiles": telemetry.compile_counter()}
+        )
+        if report_out is not None:
+            mem_start = telemetry.sample_device_memory()
 
     # Persistent XLA cache (r5): compiles are a one-time per-machine cost,
     # as in any production JAX deployment; the in-process median-of-3
@@ -90,9 +120,11 @@ def main() -> None:
         return med, (walls[0], walls[-1]), r, stats
 
     def run_exact(params, tag):
-        exact.fit(data, params, mesh=mesh)  # warm XLA compiles
+        if tracer is not None:
+            tracer("bench_leg", leg=f"exact/{tag}")
+        exact.fit(data, params, mesh=mesh, trace=tracer)  # warm XLA compiles
         wall, (lo, hi), r, stats = timed_runs(
-            lambda: exact.fit(data, params, mesh=mesh)
+            lambda: exact.fit(data, params, mesh=mesh, trace=tracer)
         )
         a = ari(r.labels)
         print(
@@ -127,9 +159,11 @@ def main() -> None:
         seed=0,
         dedup_points=True,
     )
-    mr_hdbscan.fit(data, mr_params, mesh=mesh)  # warm full-shape compiles
+    if tracer is not None:
+        tracer("bench_leg", leg="mr-db")
+    mr_hdbscan.fit(data, mr_params, mesh=mesh, trace=tracer)  # warm full-shape compiles
     mr_wall, mr_spread, r_mr, _ = timed_runs(
-        lambda: mr_hdbscan.fit(data, mr_params, mesh=mesh)
+        lambda: mr_hdbscan.fit(data, mr_params, mesh=mesh, trace=tracer)
     )
     mr_ari = ari(r_mr.labels)
     print(
@@ -155,9 +189,11 @@ def main() -> None:
     # seed_sweep45_skin_r5.jsonl). Reported as its own leg so the mr-db
     # primary fields stay round-comparable.
     flat_params = mr_params.replace(refine_flat_iterations=8)
-    mr_hdbscan.fit(data, flat_params, mesh=mesh)  # warm
+    if tracer is not None:
+        tracer("bench_leg", leg="mr-db-flat")
+    mr_hdbscan.fit(data, flat_params, mesh=mesh, trace=tracer)  # warm
     fl_wall, fl_spread, r_fl, _ = timed_runs(
-        lambda: mr_hdbscan.fit(data, flat_params, mesh=mesh)
+        lambda: mr_hdbscan.fit(data, flat_params, mesh=mesh, trace=tracer)
     )
     fl_ari = ari(r_fl.labels)
     print(
@@ -207,6 +243,27 @@ def main() -> None:
             }
         )
     )
+
+    if tracer is not None:
+        from hdbscan_tpu.utils import telemetry
+
+        tracer.close()
+        if report_out is not None:
+            telemetry.write_report(
+                report_out,
+                telemetry.build_report(
+                    tracer,
+                    manifest=telemetry.run_manifest(
+                        None,
+                        argv=argv_full,
+                        extra={"entrypoint": "bench.py", "dataset": SKIN_PATH},
+                    ),
+                    memory={
+                        "start": mem_start,
+                        "end": telemetry.sample_device_memory(),
+                    },
+                ),
+            )
 
 
 if __name__ == "__main__":

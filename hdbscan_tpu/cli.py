@@ -11,7 +11,16 @@ Usage mirrors the reference's documented contract (``main/Main.java:534-614``)::
         [glue_factor=N] [glue_rows=N] [block_pruning={true,false}] \
         [knn_backend={auto,xla,pallas,fused}] \
         [consensus=N] [compat_cf={true,false}] \
-        [clusterName={local,auto,<host:port>,<pid>,<np>}]
+        [clusterName={local,auto,<host:port>,<pid>,<np>}] \
+        [--trace-out PATH] [--report PATH]
+
+Telemetry (README "Observability"): ``--trace-out PATH`` appends every
+pipeline stage event as a schema-versioned JSON line (multi-host runs write
+one ``PATH``-derived file per process: ``trace.<process_index>.jsonl``);
+``--report PATH`` writes a run-report JSON — manifest (config, backends,
+device topology, env overrides), per-phase wall/GFLOP/MFU/compile aggregates,
+sampled device memory, and per-host phase walls when several processes ran.
+With both flags absent no telemetry file I/O happens.
 
 Unlike the reference, argv is actually honored (the reference shadows it with
 hard-coded args, ``main/Main.java:71`` — treated as a bug, SURVEY.md §7), and
@@ -30,12 +39,39 @@ from hdbscan_tpu.config import HDBSCANParams
 HELP = __doc__
 
 
+def _pop_path_flag(argv: list[str], flag: str) -> str | None:
+    """Extract ``--flag PATH`` or ``--flag=PATH`` from argv (in place).
+
+    The telemetry flags are run-artifact concerns, not clustering parameters,
+    so they stay out of the reference's ``key=value`` vocabulary
+    (``HDBSCANParams.from_args`` would reject them as unknown flags).
+    """
+    value = None
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == flag:
+            if i + 1 >= len(argv):
+                raise ValueError(f"{flag} requires a PATH argument")
+            value = argv[i + 1]
+            del argv[i : i + 2]
+        elif a.startswith(flag + "="):
+            value = a[len(flag) + 1 :]
+            del argv[i]
+        else:
+            i += 1
+    return value
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or any(a in ("-h", "--help", "help") for a in argv):
         print(HELP)
         return 0
+    argv_full = list(argv)  # manifest records argv as given, flags included
     try:
+        trace_out = _pop_path_flag(argv, "--trace-out")
+        report_out = _pop_path_flag(argv, "--report")
         params = HDBSCANParams.from_args(argv)
     except ValueError as e:
         print(f"error: {e}\n{HELP}", file=sys.stderr)
@@ -89,25 +125,56 @@ def main(argv: list[str] | None = None) -> int:
     # Per-stage tracing: always collected so the end-of-run summary can show
     # phase walls, selected fractions, and FLOP rates (the reference's only
     # progress output is println of filenames — SURVEY.md §5.1). Set
-    # HDBSCAN_TPU_TRACE=1 to also live-stream logfmt lines to stderr.
+    # HDBSCAN_TPU_TRACE=1 to also live-stream logfmt lines to stderr;
+    # --trace-out/--report persist the run as JSONL events + a report JSON
+    # (utils/telemetry.py). With both flags absent the tracer is the same
+    # collect-only object as before — zero telemetry file I/O.
     import os
 
-    from hdbscan_tpu.utils.tracing import Tracer
+    from hdbscan_tpu.utils.tracing import JsonlSink, Tracer
 
+    telemetry_on = trace_out is not None or report_out is not None
+    sinks = []
+    counters = None
+    trace_path = None
+    if telemetry_on:
+        from hdbscan_tpu.utils import telemetry
+
+        # Per-phase jit-compile attribution rides the tracer's counter hook.
+        counters = {"jit_compiles": telemetry.compile_counter()}
+        if trace_out is not None:
+            trace_path = telemetry.trace_path_for_process(
+                trace_out, jax.process_index(), n_proc
+            )
+            sinks.append(JsonlSink(trace_path, static={"process": jax.process_index()}))
     tracer = Tracer(
-        stream=sys.stderr if os.environ.get("HDBSCAN_TPU_TRACE") else None
+        stream=sys.stderr if os.environ.get("HDBSCAN_TPU_TRACE") else None,
+        sinks=sinks,
+        counters=counters,
     )
+    mem_start = None
+    if report_out is not None:
+        from hdbscan_tpu.utils import telemetry
+
+        mem_start = telemetry.sample_device_memory()
 
     fit_done = False
     try:
+        t0 = time.monotonic()
         data = load_points(params.input_file)
         if data.ndim == 1:
             data = data[:, None]
         n = len(data)
+        tracer(
+            "load_points",
+            rows=n,
+            dims=int(data.shape[1]),
+            wall_s=round(time.monotonic() - t0, 6),
+        )
         t0 = time.monotonic()
         if n <= params.processing_units:
             # Single-block exact path: dense local compute (no mesh to shard).
-            result = hdbscan.fit(data, params)
+            result = hdbscan.fit(data, params, trace=tracer)
             mode = "exact"
         else:
             # consensus_draws > 1 dispatches to consensus.fit inside.
@@ -118,10 +185,13 @@ def main(argv: list[str] | None = None) -> int:
                 else f"mr ({result.n_levels} levels)"
             )
         wall = time.monotonic() - t0
+        tracer("fit", mode=mode.split(" ")[0], rows=n, wall_s=round(wall, 6))
         fit_done = True
 
         if is_main:
+            t0 = time.monotonic()
             paths = hdbscan.write_outputs(result, params)
+            tracer("write_outputs", wall_s=round(time.monotonic() - t0, 6))
             n_clusters = len(set(result.labels[result.labels > 0].tolist()))
             n_noise = int(np.sum(result.labels == 0))
             print(
@@ -146,23 +216,18 @@ def main(argv: list[str] | None = None) -> int:
                     "consensus provenance sidecar).",
                     file=sys.stderr,
                 )
-            # Boundary/refine phase summary (VERDICT r3 item 9): walls,
-            # selected fractions, and achieved FLOP rates without Python.
-            phase_names = (
-                "dedup",
-                "boundary_select",
-                "boundary_cores",
-                "boundary_reweight",
-                "boundary_phase",
-                "refine",
-                "consensus",
-            )
-            summary = [e for e in tracer.events if e.name in phase_names]
+            # Phase summary (VERDICT r3 item 9): every traced stage's count
+            # and summed wall, expensive first — no allowlist, so new stages
+            # are never silently dropped.
+            summary = tracer.summary()
             if summary:
                 print("phases:", file=sys.stderr)
-                for ev in summary:
-                    print(f"  {ev.format()}", file=sys.stderr)
+                for line in summary.splitlines():
+                    print(f"  {line}", file=sys.stderr)
     finally:
+        # Flush/close trace sinks BEFORE the exit barrier: the coordinator
+        # reads every rank's trace file right after the barrier releases.
+        tracer.close()
         if n_proc > 1 and fit_done:
             # Barrier before exit — in a finally so a rank that fails AFTER
             # the pipeline (e.g. unwritable out_dir on process 0) still
@@ -174,6 +239,29 @@ def main(argv: list[str] | None = None) -> int:
             from jax.experimental import multihost_utils
 
             multihost_utils.sync_global_devices("hdbscan_tpu_cli_done")
+
+    if report_out is not None and is_main:
+        # After the barrier: every rank's trace file is closed, so the
+        # coordinator can merge per-host phase walls into one report.
+        from hdbscan_tpu.utils import telemetry
+
+        per_host = None
+        if n_proc > 1 and trace_out is not None:
+            per_host = telemetry.merge_host_traces(
+                telemetry.host_trace_paths(trace_out, n_proc)
+            )
+        telemetry.write_report(
+            report_out,
+            telemetry.build_report(
+                tracer,
+                manifest=telemetry.run_manifest(params, argv=argv_full),
+                memory={
+                    "start": mem_start,
+                    "end": telemetry.sample_device_memory(),
+                },
+                per_host=per_host,
+            ),
+        )
     return 0
 
 

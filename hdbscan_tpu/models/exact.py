@@ -59,13 +59,23 @@ def mst_edges(
     ``knn_backend`` selects the core-distance scan backend
     (``ops/tiled.knn_core_distances``); the Borůvka rounds are unaffected.
     """
+    import time
+
+    from hdbscan_tpu.utils.flops import counter as _flops
+    from hdbscan_tpu.utils.flops import phase_stats
+
     n = len(data)
+    t0 = time.monotonic()
+    fsnap = _flops.snapshot()
     core, _ = knn_core_distances(
         data, min_pts, metric, row_tile=row_tile, col_tile=col_tile, dtype=dtype,
         fetch_knn=False, backend=knn_backend,
     )
     if trace is not None:
-        trace("core_distances", n=n)
+        wall = time.monotonic() - t0
+        trace(
+            "core_distances", n=n, wall_s=round(wall, 6), **phase_stats(fsnap, wall)
+        )
     u, v, w = mst_edges_from_core(
         data,
         core,
@@ -93,7 +103,14 @@ def mst_edges_from_core(
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """The Borůvka round loop of :func:`mst_edges` for PRE-COMPUTED core
     distances (the weighted/dedup path supplies multiset-weighted cores)."""
+    import time
+
+    from hdbscan_tpu.utils.flops import counter as _flops
+    from hdbscan_tpu.utils.flops import phase_stats
+
     n = len(data)
+    t0 = time.monotonic()
+    fsnap = _flops.snapshot()
     scanner = BoruvkaScanner(
         data, core, metric, row_tile=row_tile, col_tile=col_tile, dtype=dtype,
         mesh=mesh,
@@ -102,6 +119,7 @@ def mst_edges_from_core(
     comp = np.arange(n, dtype=np.int64)
     eu, ev, ew = [], [], []
     n_comp = n
+    rounds = 0
     for rnd in range(max_rounds):
         if n_comp <= 1:
             break
@@ -116,8 +134,18 @@ def mst_edges_from_core(
         ev.append(bj[emit])
         ew.append(bw[emit])
         n_comp = new_count
+        rounds = rnd + 1
         if trace is not None:
             trace("boruvka_round", round=rnd, components=n_comp, edges_added=len(emit))
+    if trace is not None:
+        wall = time.monotonic() - t0
+        trace(
+            "boruvka_mst",
+            rounds=rounds,
+            edges=int(sum(len(e) for e in eu)),
+            wall_s=round(wall, 6),
+            **phase_stats(fsnap, wall),
+        )
     return (
         np.concatenate(eu) if eu else np.zeros(0, np.int64),
         np.concatenate(ev) if ev else np.zeros(0, np.int64),
@@ -210,19 +238,26 @@ def mst_edges_random_blocks(
     This is the capability path; :func:`mst_edges` (tiled global Borůvka) is
     the faster way to the same tree.
     """
+    import time
+
     from hdbscan_tpu.parallel.blocks import (
         _next_pow2,
         pack_blocks,
         run_packed_blocks,
     )
+    from hdbscan_tpu.utils.flops import counter as flops_counter
+    from hdbscan_tpu.utils.flops import phase_stats
 
     n = len(data)
+    t0 = time.monotonic()
+    fsnap = flops_counter.snapshot()
     core, _ = knn_core_distances(
         data, min_pts, metric, row_tile=row_tile, col_tile=col_tile, dtype=dtype,
         fetch_knn=False, backend=knn_backend,
     )
     if trace is not None:
-        trace("core_distances", n=n)
+        wall = time.monotonic() - t0
+        trace("core_distances", n=n, wall_s=round(wall, 6), **phase_stats(fsnap, wall))
 
     # A pair-block holds ~2n/n_parts points and its dense MRD matrix must fit
     # HBM: raise n_parts until blocks respect max_block (pow2-padded cap).
@@ -250,6 +285,7 @@ def mst_edges_random_blocks(
     data_c = data.astype(dtype, copy=False)
     ku = kv = kw = None
     for lo in range(0, b, chunk):
+        t0 = time.monotonic()
         packed = pack_blocks(data_c, blocks[lo : lo + chunk], cap, core=core)
         eu, ev, ew, _ = run_packed_blocks(packed, min_pts, metric)
         if ku is not None:
@@ -258,7 +294,12 @@ def mst_edges_random_blocks(
             ew = np.concatenate([kw, ew])
         ku, kv, kw = pool_mst(eu, ev, ew, n)
         if trace is not None:
-            trace("block_msts", blocks=min(lo + chunk, b), total_blocks=b)
+            trace(
+                "block_msts",
+                blocks=min(lo + chunk, b),
+                total_blocks=b,
+                wall_s=round(time.monotonic() - t0, 6),
+            )
 
     return ku, kv, kw, core
 

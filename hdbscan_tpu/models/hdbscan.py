@@ -67,22 +67,30 @@ def fit(
     params: HDBSCANParams | None = None,
     *,
     num_constraints_satisfied: np.ndarray | None = None,
+    trace=None,
 ) -> HDBSCANResult:
     """Run exact HDBSCAN* on one block.
 
     Equivalent capability to the canonical single-node pipeline the reference
     documents (``main/Main.java:534-614``; call stack SURVEY.md §3.4).
+    ``trace``: optional per-stage event callable
+    (:class:`~hdbscan_tpu.utils.tracing.Tracer`).
     """
+    import time
+
     params = params or HDBSCANParams()
     data = np.asarray(data, np.float64)
     n = len(data)
     if n == 0:
         raise ValueError("empty dataset")
+    t0 = time.monotonic()
     u, v, w, core = hdbscan_block_edges(data, params.min_points, params.dist_function)
+    if trace is not None:
+        trace("block_edges", n=n, wall_s=round(time.monotonic() - t0, 6))
     from hdbscan_tpu.models._finalize import finalize_clustering
 
     tree, labels, scores, infinite = finalize_clustering(
-        n, u, v, w, core, params, num_constraints_satisfied
+        n, u, v, w, core, params, num_constraints_satisfied, trace=trace
     )
     return HDBSCANResult(
         labels=labels,

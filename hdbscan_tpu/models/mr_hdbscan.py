@@ -1019,6 +1019,7 @@ def _fit_rows(
                 params.min_points,
                 neighbor_rows=sel_pos,
                 backend=params.knn_backend,
+                trace=trace,
             )
             # The full-dataset device copy is only needed for this rescan —
             # release it before the glue/tree stages pin more HBM.

@@ -817,6 +817,7 @@ def knn_rows_blockpruned(
     neighbor_rows: np.ndarray | None = None,
     probe_blocks: int = _KNN_PROBE_BLOCKS,
     backend: str = "xla",
+    trace=None,
 ):
     """Exact core distances of selected rows via block-candidate windows.
 
@@ -853,6 +854,10 @@ def knn_rows_blockpruned(
     of the guarded XLA top_k merge, with the usual fallback rules
     (euclidean, d <= 128, k <= 128, f32 geometry; interpreter mode off-TPU
     at small n only).
+
+    ``trace``: optional event callable (``utils.tracing.Tracer``); emits one
+    ``knn_probe_scan`` / ``knn_window_scan`` event per dispatch phase with
+    the chunk/tile dispatch shape and that phase's achieved-FLOP figures.
     """
     m = len(row_ids)
     k = max(min_pts - 1, 1)
@@ -873,6 +878,7 @@ def knn_rows_blockpruned(
     best_d = jnp.full((m + 1, k), jnp.inf, geom.data_sorted.dtype)
     best_i = jnp.full((m + 1, k), -1, jnp.int32)
     from hdbscan_tpu.utils.flops import counter as _flops
+    from hdbscan_tpu.utils.flops import phase_stats as _phase_stats
 
     d = geom.data_host.shape[1]
     win_cols = geom.win_tiles * geom.col_tile
@@ -893,8 +899,16 @@ def knn_rows_blockpruned(
         data_t_f, colmask_f = geom.fused_operands()
         interp_f = jax.devices()[0].platform != "tpu"
 
-    def scan_jobs(jobs, best_d, best_i):
-        n_chunks = 0
+    def scan_jobs(jobs, best_d, best_i, stage=None):
+        # ``stage``: trace event name for this dispatch phase. When tracing,
+        # the phase ends with a device sync so its wall is the real scan
+        # time — with trace=None the dispatch loop is byte-identical to the
+        # untraced path (no extra syncs, no timing calls in the hot loop).
+        import time as _time
+
+        t0 = _time.monotonic()
+        fsnap = _flops.snapshot()
+        n_chunks = n_tiles = n_pad_tiles = 0
         for _metas, ids, starts, locs, n_real in _tiled_window_jobs(
             jobs, lambda r: rows_sorted_pos[r], row_tile, dummy=m,
             slot_budget=_FUSED_SLOT_BUDGET if use_fused else None,
@@ -906,6 +920,8 @@ def knn_rows_blockpruned(
                 _flops.add_pad_scan(
                     (ids.shape[0] - n_real) * row_tile, win_cols, d
                 )
+            n_tiles += n_real
+            n_pad_tiles += ids.shape[0] - n_real
             if use_fused:
                 best_d, best_i = _knn_window_merge_chunk_fused(
                     best_d,
@@ -940,6 +956,20 @@ def knn_rows_blockpruned(
             n_chunks += 1
             if n_chunks % _MERGE_SYNC_EVERY == 0:
                 jax.block_until_ready(best_d)
+        if trace is not None and stage is not None and n_chunks:
+            jax.block_until_ready(best_d)
+            wall = _time.monotonic() - t0
+            trace(
+                stage,
+                rows=m,
+                chunks=n_chunks,
+                tiles=n_tiles,
+                pad_tiles=n_pad_tiles,
+                row_tile=row_tile,
+                fused=use_fused,
+                wall_s=round(wall, 6),
+                **_phase_stats(fsnap, wall),
+            )
         return best_d, best_i
 
     ub = np.asarray(ub, np.float64)
@@ -952,7 +982,9 @@ def knn_rows_blockpruned(
             dc_rows=dc_cache,
             self_blocks=geom.block_of_rows(row_ids),
         )
-        best_d, best_i = scan_jobs(_window_jobs(geom, ppr, ppb), best_d, best_i)
+        best_d, best_i = scan_jobs(
+            _window_jobs(geom, ppr, ppb), best_d, best_i, stage="knn_probe_scan"
+        )
         kth_idx = min(k, geom.n) - 1
         probe_kth = np.asarray(
             jax.device_get(best_d[:m, kth_idx]), np.float64
@@ -963,7 +995,8 @@ def knn_rows_blockpruned(
         rows, ub, exclude=probe, dc_rows=dc_cache
     )
     best_d, best_i = scan_jobs(
-        _window_jobs(geom, pair_rows, pair_blocks), best_d, best_i
+        _window_jobs(geom, pair_rows, pair_blocks), best_d, best_i,
+        stage="knn_window_scan",
     )
 
     if min_pts > 1:

@@ -1,0 +1,328 @@
+"""Run telemetry: manifests, reports, memory/compile tracking, host merge.
+
+The durable half of the observability layer (``utils/tracing.py`` is the
+in-process half): a run can persist (1) a JSONL event trace per process
+(:class:`~hdbscan_tpu.utils.tracing.JsonlSink`), and (2) a single JSON
+**run report** tying together a manifest (config, resolved backends, device
+topology, env overrides, package version), per-phase aggregates (count, wall,
+and the analytic GFLOP/GB/MFU figures the dispatch sites credit through
+``utils/flops``), sampled device memory, and per-phase jit compile counts.
+Multi-host runs write one trace file per process
+(``trace.<process_index>.jsonl``) and the coordinator merges them into the
+report's ``per_host`` section so a straggling host's phase walls are visible
+next to its peers'.
+
+Everything here is host-side bookkeeping: no device computation, no effect
+on traced code beyond the ``trace`` hooks models already expose, and zero
+file I/O unless a sink or report path was requested.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from hdbscan_tpu.utils.tracing import TRACE_SCHEMA, Tracer
+
+#: Version tag carried by the run report. Bump the integer suffix on any
+#: backwards-incompatible report-shape change.
+REPORT_SCHEMA = "hdbscan-tpu-report/1"
+
+#: Env vars echoed into the manifest when set: anything that changes what the
+#: run computes or how its figures are derived, without appearing in argv.
+_MANIFEST_ENV_VARS = (
+    "HDBSCAN_TPU_PEAK_FLOPS",
+    "HDBSCAN_TPU_TRACE",
+    "HDBSCAN_TPU_CACHE_DIR",
+    "HDBSCAN_TPU_SLOW",
+    "JAX_PLATFORMS",
+    "JAX_ENABLE_X64",
+    "XLA_FLAGS",
+)
+
+#: The jax.monitoring duration event emitted once per backend (XLA) compile.
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def json_sanitize(obj):
+    """Recursively coerce numpy scalars/arrays, tuples and other non-JSON
+    values to plain Python so ``json.dumps`` never trips on a trace field."""
+    import numpy as np
+
+    if isinstance(obj, dict):
+        return {str(k): json_sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [json_sanitize(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return json_sanitize(obj.tolist())
+    if isinstance(obj, (np.bool_, bool)):
+        return bool(obj)
+    if isinstance(obj, (np.integer, int)):
+        return int(obj)
+    if isinstance(obj, (np.floating, float)):
+        return float(obj)
+    if obj is None or isinstance(obj, str):
+        return obj
+    return str(obj)
+
+
+# --------------------------------------------------------------------------
+# Compile tracking
+# --------------------------------------------------------------------------
+
+_compile_count = [0]
+_compile_listener_installed = [False]
+
+
+def compile_counter():
+    """A zero-arg callable returning the process-wide XLA backend-compile
+    count. Pass it as a :class:`Tracer` counter (``{"jit_compiles": ...}``)
+    to attribute compiles to phases. The ``jax.monitoring`` listener is
+    installed once per process on first call (jax exposes no unregister, so
+    installation is permanent — an int increment per compile, nothing more).
+    """
+    if not _compile_listener_installed[0]:
+        import jax.monitoring
+
+        def _on_duration(name, secs, **kw):
+            if name == _COMPILE_EVENT:
+                _compile_count[0] += 1
+
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+        _compile_listener_installed[0] = True
+    return lambda: _compile_count[0]
+
+
+# --------------------------------------------------------------------------
+# Manifest: what did this run resolve to
+# --------------------------------------------------------------------------
+
+
+def device_topology() -> dict:
+    """Device/process topology from ``jax.devices()`` — enough to read a
+    report without the machine: platform, counts, and per-device kind/host."""
+    import jax
+
+    devices = jax.devices()
+    return {
+        "platform": devices[0].platform if devices else "none",
+        "device_count": len(devices),
+        "local_device_count": jax.local_device_count(),
+        "process_count": jax.process_count(),
+        "process_index": jax.process_index(),
+        "devices": [
+            {
+                "id": d.id,
+                "kind": d.device_kind,
+                "process_index": d.process_index,
+            }
+            for d in devices
+        ],
+    }
+
+
+def env_overrides() -> dict:
+    """The run-shaping env vars that are actually set (see
+    ``_MANIFEST_ENV_VARS``) — the manifest's answer to "what did the
+    environment quietly change"."""
+    return {k: os.environ[k] for k in _MANIFEST_ENV_VARS if k in os.environ}
+
+
+def run_manifest(params=None, argv=None, extra: dict | None = None) -> dict:
+    """The run's identity card: config dataclass, resolved backends, device
+    topology, env overrides, package version. ``params`` is an
+    ``HDBSCANParams`` (or None for library runs without one)."""
+    import dataclasses
+
+    import jax
+
+    from hdbscan_tpu import __version__
+    from hdbscan_tpu.utils import flops
+
+    manifest = {
+        "package_version": __version__,
+        "jax_version": jax.__version__,
+        "argv": list(argv) if argv is not None else None,
+        "params": (
+            json_sanitize(dataclasses.asdict(params)) if params is not None else None
+        ),
+        "backends": {
+            "default_backend": jax.default_backend(),
+            "knn_backend": getattr(params, "knn_backend", None),
+        },
+        "topology": device_topology(),
+        "env": env_overrides(),
+        "peak_flops": flops.PEAK_FLOPS,
+    }
+    if extra:
+        manifest.update(json_sanitize(extra))
+    return manifest
+
+
+# --------------------------------------------------------------------------
+# Device memory sampling
+# --------------------------------------------------------------------------
+
+
+def sample_device_memory() -> dict:
+    """Per-device memory figures: ``device.memory_stats()`` where the backend
+    implements it (TPU/GPU — bytes_in_use, peak_bytes_in_use), else the
+    ``jax.live_arrays()`` fallback (CPU backends return no allocator stats;
+    summed live-array bytes is the observable proxy)."""
+    import jax
+
+    per_device = []
+    any_stats = False
+    for d in jax.devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            any_stats = True
+            per_device.append(
+                {
+                    "id": d.id,
+                    "bytes_in_use": stats.get("bytes_in_use"),
+                    "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+                    "bytes_limit": stats.get("bytes_limit"),
+                }
+            )
+        else:
+            per_device.append({"id": d.id})
+    sample = {"source": "memory_stats" if any_stats else "live_arrays"}
+    if any_stats:
+        sample["devices"] = per_device
+    else:
+        live = jax.live_arrays()
+        sample["live_array_count"] = len(live)
+        sample["live_array_bytes"] = int(sum(int(a.nbytes) for a in live))
+    return json_sanitize(sample)
+
+
+# --------------------------------------------------------------------------
+# Report: per-phase aggregates over the trace
+# --------------------------------------------------------------------------
+
+#: Event fields summed into the per-phase aggregates (the analytic figures
+#: ``utils/flops.phase_stats`` attaches, plus the compile counter field).
+_SUMMED_FIELDS = ("gflops", "gbytes", "pad_gflops", "jit_compiles")
+
+
+def phase_aggregates(events) -> dict:
+    """``{stage: {count, wall_s, gflops?, gbytes?, pad_gflops?,
+    jit_compiles?, gflops_s?, mfu?}}`` over a list of
+    :class:`~hdbscan_tpu.utils.tracing.TraceEvent` (or JSONL line dicts).
+    Wall totals are plain float sums of the events' ``wall_s`` — exactly
+    ``Tracer.total(stage)``. Rates re-derive from the SUMMED figures (a
+    phase's aggregate MFU over its total wall, not a mean of per-event
+    rates)."""
+    from hdbscan_tpu.utils import flops
+
+    agg: dict[str, dict] = {}
+    for ev in events:
+        if isinstance(ev, dict):
+            name, wall, fields = ev.get("stage"), ev.get("wall_s", 0.0), ev
+        else:
+            name, wall, fields = ev.name, ev.wall_s, ev.fields
+        row = agg.setdefault(name, {"count": 0, "wall_s": 0.0})
+        row["count"] += 1
+        row["wall_s"] += float(wall)
+        for key in _SUMMED_FIELDS:
+            val = fields.get(key)
+            if val is not None:
+                row[key] = row.get(key, 0.0) + float(val)
+    for row in agg.values():
+        gf = row.get("gflops")
+        if gf and row["wall_s"] > 0:
+            row["gflops_s"] = round(gf / row["wall_s"], 1)
+            row["mfu"] = round(gf * 1e9 / row["wall_s"] / flops.PEAK_FLOPS, 6)
+        if "jit_compiles" in row:
+            row["jit_compiles"] = int(row["jit_compiles"])
+    # Expensive phases first, matching Tracer.summary().
+    return dict(sorted(agg.items(), key=lambda kv: -kv[1]["wall_s"]))
+
+
+def build_report(
+    tracer: Tracer,
+    manifest: dict | None = None,
+    memory: dict | None = None,
+    per_host: dict | None = None,
+) -> dict:
+    """Assemble the run report dict from a tracer's collected events.
+
+    ``memory``: e.g. ``{"start": sample, "end": sample}`` from
+    :func:`sample_device_memory`. ``per_host``: the
+    :func:`merge_host_traces` result for multi-host runs.
+    """
+    phases = phase_aggregates(tracer.events)
+    report = {
+        "schema": REPORT_SCHEMA,
+        "manifest": manifest or {},
+        "phases": phases,
+        "total_wall_s": round(sum(p["wall_s"] for p in phases.values()), 6),
+        "event_count": len(tracer.events),
+    }
+    if memory is not None:
+        report["memory"] = json_sanitize(memory)
+    if per_host is not None:
+        report["per_host"] = per_host
+    return report
+
+
+def write_report(path: str, report: dict) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(json_sanitize(report), f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+# --------------------------------------------------------------------------
+# Multi-host: per-process trace files and the coordinator merge
+# --------------------------------------------------------------------------
+
+
+def trace_path_for_process(path: str, process_index: int, process_count: int) -> str:
+    """Per-process trace file name: the literal path for single-process runs;
+    ``<stem>.<process_index><ext>`` (``trace.3.jsonl``) when several
+    processes share the requested base path."""
+    if process_count <= 1:
+        return path
+    stem, ext = os.path.splitext(path)
+    return f"{stem}.{process_index}{ext}"
+
+
+def host_trace_paths(path: str, process_count: int) -> list[str]:
+    """Every process's trace path for a given base path (coordinator side)."""
+    return [
+        trace_path_for_process(path, i, process_count) for i in range(process_count)
+    ]
+
+
+def read_trace(path: str) -> list[dict]:
+    """Parse a JSONL trace file into its line dicts (schema-checked softly:
+    non-matching lines are kept — the validator is ``scripts/check_trace.py``)."""
+    events = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                events.append(json.loads(line))
+    return events
+
+
+def merge_host_traces(paths: list[str]) -> dict:
+    """Merge per-process JSONL traces into ``{host: {stage: {count, wall_s,
+    ...}}}`` — one phase-aggregate table per host, so a straggler's phase
+    walls sit next to its peers'. The host key is the trace's ``process``
+    field when present, else the file's position in ``paths``. Missing files
+    appear as ``{"missing": true}`` (a rank that died before writing is
+    itself a finding)."""
+    merged: dict[str, dict] = {}
+    for i, path in enumerate(paths):
+        if not os.path.exists(path):
+            merged[str(i)] = {"missing": True}
+            continue
+        events = read_trace(path)
+        host = str(events[0].get("process", i)) if events else str(i)
+        merged[host] = phase_aggregates(events)
+    return merged

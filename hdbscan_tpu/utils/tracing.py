@@ -4,16 +4,25 @@ The reference's only progress reporting is ``System.out.println`` of iteration
 numbers and HDFS file names, partly in Portuguese (``main/Main.java:108,200,
 232-233,316,383``; SURVEY.md §5.1). Here every pipeline stage can emit a
 structured event (name, wall seconds, counters) through a :class:`Tracer`,
-which the CLI/bench can print as logfmt lines or aggregate; an optional
-``jax.profiler`` context captures full XLA traces for TensorBoard.
+which streams to pluggable sinks: logfmt lines on a text stream for live
+progress, or schema-versioned JSON lines on disk (:class:`JsonlSink`) for the
+durable per-run artifact the report builder (``utils/telemetry.py``)
+aggregates. An optional ``jax.profiler`` context captures full XLA traces for
+TensorBoard.
 """
 
 from __future__ import annotations
 
+import json
 import sys
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
+
+#: Version tag carried by every JSONL trace line. Bump the integer suffix on
+#: any backwards-incompatible line-shape change; ``scripts/check_trace.py``
+#: validates the prefix.
+TRACE_SCHEMA = "hdbscan-tpu-trace/1"
 
 
 @dataclass
@@ -28,8 +37,57 @@ class TraceEvent:
         return " ".join(parts)
 
 
+class LogfmtSink:
+    """Prints events as logfmt lines on a text stream (live progress)."""
+
+    def __init__(self, stream):
+        self._stream = stream
+
+    def emit(self, ev: TraceEvent) -> None:
+        print(ev.format(), file=self._stream, flush=True)
+
+    def close(self) -> None:  # the stream is owned by the caller
+        pass
+
+
+class JsonlSink:
+    """Appends schema-versioned JSON event lines to a file.
+
+    Each line is a self-describing dict ``{"schema": TRACE_SCHEMA, "seq": i,
+    "stage": name, "wall_s": float, ...fields}`` plus any ``static`` fields
+    given at construction (e.g. ``process`` for multi-host runs). Values are
+    sanitized to plain JSON types (numpy scalars appear in trace fields).
+    Lines flush as they happen so a killed run keeps its partial trace.
+    """
+
+    def __init__(self, path: str, static: dict | None = None):
+        self.path = path
+        self._static = dict(static or {})
+        self._seq = 0
+        self._f = open(path, "w", encoding="utf-8")
+
+    def emit(self, ev: TraceEvent) -> None:
+        from hdbscan_tpu.utils.telemetry import json_sanitize
+
+        rec = {
+            "schema": TRACE_SCHEMA,
+            "seq": self._seq,
+            **self._static,
+            "stage": ev.name,
+            "wall_s": float(ev.wall_s),
+            **json_sanitize(ev.fields),
+        }
+        self._seq += 1
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+
 class Tracer:
-    """Collects :class:`TraceEvent` records; optionally streams them.
+    """Collects :class:`TraceEvent` records; optionally streams them to sinks.
 
     Pass an instance anywhere a ``trace`` hook is accepted
     (``models.exact.fit``, ``models.mr_hdbscan.fit``); calling it records an
@@ -37,12 +95,33 @@ class Tracer:
 
     Args:
       stream: file-like; events print as logfmt lines as they happen
-        (``sys.stderr`` for live progress). None = collect only.
+        (``sys.stderr`` for live progress). None = collect only. Sugar for
+        ``sinks=[LogfmtSink(stream)]``.
+      sinks: additional sink objects (``emit(event)`` / ``close()``), e.g.
+        :class:`JsonlSink` for the durable artifact.
+      counters: ``{field_name: zero-arg callable -> number}``; at every emit
+        the DELTA since the previous emit is attached as an event field when
+        nonzero. This is how per-phase jit-compile counts ride along
+        (``utils/telemetry.compile_counter``): phase events are emitted at
+        the END of their phase, so compiles-since-last-event land on the
+        phase that triggered them.
     """
 
-    def __init__(self, stream=None):
+    def __init__(self, stream=None, sinks=None, counters=None):
         self.events: list[TraceEvent] = []
-        self._stream = stream
+        self._sinks = list(sinks or [])
+        if stream is not None:
+            self._sinks.append(LogfmtSink(stream))
+        self._counters = dict(counters or {})
+        self._counter_last = {k: fn() for k, fn in self._counters.items()}
+
+    def add_sink(self, sink) -> None:
+        self._sinks.append(sink)
+
+    def close(self) -> None:
+        """Close all sinks (flushes JSONL files). Idempotent."""
+        for s in self._sinks:
+            s.close()
 
     def __call__(self, name: str, **fields) -> None:
         # An explicit wall_s field becomes the event's wall (several sites
@@ -60,24 +139,31 @@ class Tracer:
             self._emit(TraceEvent(name, time.monotonic() - t0, fields))
 
     def _emit(self, ev: TraceEvent) -> None:
+        for key, fn in self._counters.items():
+            cur = fn()
+            delta = cur - self._counter_last[key]
+            self._counter_last[key] = cur
+            if delta:
+                ev.fields[key] = delta
         self.events.append(ev)
-        if self._stream is not None:
-            print(ev.format(), file=self._stream, flush=True)
+        for s in self._sinks:
+            s.emit(ev)
 
     def total(self, name: str) -> float:
         """Summed wall seconds of all events with this stage name."""
         return sum(e.wall_s for e in self.events if e.name == name)
 
     def summary(self) -> str:
-        """One line per distinct stage: count and summed wall."""
+        """One line per distinct stage — count and summed wall — sorted by
+        summed wall descending, so the expensive phases lead and new stages
+        are never silently dropped (no allowlist)."""
         agg: dict[str, list] = {}
         for e in self.events:
             agg.setdefault(e.name, [0, 0.0])
             agg[e.name][0] += 1
             agg[e.name][1] += e.wall_s
-        return "\n".join(
-            f"{name}: n={n} wall_s={w:.3f}" for name, (n, w) in agg.items()
-        )
+        rows = sorted(agg.items(), key=lambda kv: -kv[1][1])
+        return "\n".join(f"{name}: n={n} wall_s={w:.3f}" for name, (n, w) in rows)
 
 
 def stderr_tracer() -> Tracer:

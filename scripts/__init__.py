@@ -1,0 +1,2 @@
+"""Repo maintenance/validation scripts (import as ``scripts.<name>`` from
+the repo root; each is also a standalone stdlib-only CLI)."""

@@ -1,0 +1,161 @@
+#!/usr/bin/env python3
+"""Validate hdbscan-tpu telemetry artifacts (README "Observability").
+
+Usage::
+
+    python scripts/check_trace.py TRACE.jsonl [REPORT.json]
+
+Checks every JSONL line against the trace schema contract
+(``utils/tracing.TRACE_SCHEMA``): parses as JSON, carries a matching
+``schema`` tag, a string ``stage`` and a finite numeric ``wall_s``, and
+``seq`` strictly increases per process. Given a report
+(``utils/telemetry.REPORT_SCHEMA``), additionally cross-checks that the
+report's per-phase wall totals equal the trace's per-stage wall sums within
+1e-6 — the round-trip guarantee the tier-1 e2e test pins.
+
+Exit code 0 = valid; 1 = any violation (all violations printed). Pure
+stdlib on purpose: the validator must run where the run artifacts land,
+without the package or jax installed.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+
+#: Kept in sync with ``hdbscan_tpu.utils.tracing.TRACE_SCHEMA`` /
+#: ``hdbscan_tpu.utils.telemetry.REPORT_SCHEMA`` — stdlib-only duplicate so
+#: the validator runs without the package importable.
+TRACE_SCHEMA_PREFIX = "hdbscan-tpu-trace/"
+REPORT_SCHEMA_PREFIX = "hdbscan-tpu-report/"
+WALL_TOLERANCE = 1e-6
+
+
+def validate_trace(path: str) -> tuple[list[dict], list[str]]:
+    """Parse + validate one JSONL trace file.
+
+    Returns ``(events, errors)`` — events that parsed (even if invalid), and
+    human-readable violation strings (empty = valid).
+    """
+    events: list[dict] = []
+    errors: list[str] = []
+    last_seq: dict = {}  # per-process strictly-increasing seq check
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError as e:
+                errors.append(f"{path}:{lineno}: not valid JSON ({e})")
+                continue
+            if not isinstance(ev, dict):
+                errors.append(f"{path}:{lineno}: line is not a JSON object")
+                continue
+            events.append(ev)
+            schema = ev.get("schema")
+            if not isinstance(schema, str) or not schema.startswith(
+                TRACE_SCHEMA_PREFIX
+            ):
+                errors.append(
+                    f"{path}:{lineno}: schema={schema!r} (want "
+                    f"{TRACE_SCHEMA_PREFIX}<n>)"
+                )
+            if not isinstance(ev.get("stage"), str) or not ev.get("stage"):
+                errors.append(f"{path}:{lineno}: missing/non-string 'stage'")
+            wall = ev.get("wall_s")
+            if not isinstance(wall, (int, float)) or isinstance(wall, bool) or (
+                isinstance(wall, float) and not math.isfinite(wall)
+            ):
+                errors.append(f"{path}:{lineno}: wall_s={wall!r} not finite number")
+            seq = ev.get("seq")
+            proc = ev.get("process")
+            if isinstance(seq, int):
+                prev = last_seq.get(proc)
+                if prev is not None and seq <= prev:
+                    errors.append(
+                        f"{path}:{lineno}: seq {seq} not increasing (prev {prev})"
+                    )
+                last_seq[proc] = seq
+    return events, errors
+
+
+def validate_report(
+    path: str, trace_events: list[dict] | None = None
+) -> tuple[dict, list[str]]:
+    """Validate a run-report JSON; cross-check phase walls against a trace.
+
+    Returns ``(report, errors)``.
+    """
+    errors: list[str] = []
+    with open(path, encoding="utf-8") as f:
+        try:
+            report = json.load(f)
+        except json.JSONDecodeError as e:
+            return {}, [f"{path}: not valid JSON ({e})"]
+    schema = report.get("schema")
+    if not isinstance(schema, str) or not schema.startswith(REPORT_SCHEMA_PREFIX):
+        errors.append(
+            f"{path}: schema={schema!r} (want {REPORT_SCHEMA_PREFIX}<n>)"
+        )
+    phases = report.get("phases")
+    if not isinstance(phases, dict):
+        errors.append(f"{path}: 'phases' missing or not an object")
+        phases = {}
+    if not isinstance(report.get("manifest"), dict):
+        errors.append(f"{path}: 'manifest' missing or not an object")
+    for stage, row in phases.items():
+        if not isinstance(row, dict) or not isinstance(
+            row.get("wall_s"), (int, float)
+        ):
+            errors.append(f"{path}: phase {stage!r} lacks numeric wall_s")
+    if trace_events is not None:
+        # Round-trip: report per-phase walls == trace per-stage wall sums.
+        sums: dict[str, float] = {}
+        for ev in trace_events:
+            stage = ev.get("stage")
+            if isinstance(stage, str):
+                sums[stage] = sums.get(stage, 0.0) + float(ev.get("wall_s") or 0.0)
+        for stage, want in sums.items():
+            row = phases.get(stage)
+            if row is None:
+                errors.append(f"{path}: trace stage {stage!r} missing from report")
+                continue
+            got = float(row.get("wall_s", float("nan")))
+            if not math.isclose(got, want, rel_tol=0.0, abs_tol=WALL_TOLERANCE):
+                errors.append(
+                    f"{path}: phase {stage!r} wall_s {got} != trace sum "
+                    f"{want} (tol {WALL_TOLERANCE})"
+                )
+        for stage in phases:
+            if stage not in sums:
+                errors.append(f"{path}: report phase {stage!r} absent from trace")
+    return report, errors
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or len(argv) > 2:
+        print(__doc__, file=sys.stderr)
+        return 1
+    trace_path = argv[0]
+    events, errors = validate_trace(trace_path)
+    if len(argv) == 2:
+        _, report_errors = validate_report(argv[1], trace_events=events)
+        errors += report_errors
+    for err in errors:
+        print(f"FAIL {err}", file=sys.stderr)
+    if errors:
+        print(f"{len(errors)} violation(s)", file=sys.stderr)
+        return 1
+    print(
+        f"OK {trace_path}: {len(events)} events, "
+        f"{len({e.get('stage') for e in events})} stages"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -22,11 +22,19 @@ import numpy as np
 import pytest
 
 
+#: The reference checkout's bundled 149x4 dataset. Optional at test time:
+#: containers without the checkout SKIP the golden/oracle tests that need it
+#: instead of erroring (tests that hard-code the path carry their own
+#: ``skipif``, e.g. tests/e2e/test_cli.py).
+REFERENCE_DATASET = "/root/reference/数据集/dataset.txt"
+
+
 @pytest.fixture(scope="session")
 def iris():
     """The bundled 149x4 dataset (reference 数据集/dataset.txt)."""
-    path = "/root/reference/数据集/dataset.txt"
-    return np.loadtxt(path)
+    if not os.path.exists(REFERENCE_DATASET):
+        pytest.skip(f"reference dataset not available ({REFERENCE_DATASET})")
+    return np.loadtxt(REFERENCE_DATASET)
 
 
 @pytest.fixture

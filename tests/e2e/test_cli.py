@@ -3,15 +3,23 @@
 import os
 
 import numpy as np
+import pytest
 
 from hdbscan_tpu.cli import main
 
+REFERENCE_DATASET = "/root/reference/数据集/dataset.txt"
+require_reference_dataset = pytest.mark.skipif(
+    not os.path.exists(REFERENCE_DATASET),
+    reason=f"reference dataset not available ({REFERENCE_DATASET})",
+)
+
 
 class TestCLI:
+    @require_reference_dataset
     def test_iris_exact_path(self, tmp_path, capsys):
         rc = main(
             [
-                "file=/root/reference/数据集/dataset.txt",
+                f"file={REFERENCE_DATASET}",
                 "minPts=4",
                 "minClSize=4",
                 "processing_units=200",
@@ -29,10 +37,11 @@ class TestCLI:
         assert part.shape == (150,)
         assert set(np.unique(part)) == {2.0, 3.0}
 
+    @require_reference_dataset
     def test_mr_path_with_flags(self, tmp_path, capsys):
         rc = main(
             [
-                "file=/root/reference/数据集/dataset.txt",
+                f"file={REFERENCE_DATASET}",
                 "minPts=4",
                 "minClSize=4",
                 "processing_units=60",

@@ -1,0 +1,224 @@
+"""Telemetry artifacts end-to-end: ``--trace-out``/``--report`` round-trip
+through the CLI on a tiny synthetic dataset (tier-1), the zero-file-I/O
+guarantee with both flags absent, and the multi-process merged report
+(skipped where the multi-controller collectives backend is unavailable)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.cli import main
+from scripts import check_trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _write_blobs(tmp_path, n_per=60, centers=((0, 0, 0), (6, 6, 6))):
+    rng = np.random.default_rng(5)
+    pts = np.concatenate([rng.normal(c, 0.3, size=(n_per, 3)) for c in centers])
+    path = str(tmp_path / "blobs.txt")
+    np.savetxt(path, pts, fmt="%.6f")
+    return path, len(pts)
+
+
+class TestTelemetryCLI:
+    def test_exact_path_roundtrip(self, tmp_path):
+        """Every JSONL line parses with {schema, stage, wall_s}; the report's
+        per-phase walls equal the trace sums within 1e-6 (the validator's
+        cross-check); manifest/memory/compile figures are present."""
+        dataset, n = _write_blobs(tmp_path)
+        trace = str(tmp_path / "trace.jsonl")
+        report = str(tmp_path / "report.json")
+        rc = main(
+            [
+                f"file={dataset}",
+                "minPts=4",
+                "minClSize=10",
+                "processing_units=200",
+                f"out_dir={tmp_path / 'out'}",
+                "--trace-out",
+                trace,
+                f"--report={report}",
+            ]
+        )
+        assert rc == 0
+        events, errors = check_trace.validate_trace(trace)
+        assert errors == [], errors
+        assert events, "trace must carry events"
+        stages = {e["stage"] for e in events}
+        assert {"load_points", "block_edges", "fit", "write_outputs"} <= stages
+        rep, errors = check_trace.validate_report(report, trace_events=events)
+        assert errors == [], errors
+
+        man = rep["manifest"]
+        assert man["params"]["min_points"] == 4
+        assert man["argv"][0] == f"file={dataset}"
+        assert "--trace-out" in man["argv"]  # argv recorded pre-pop
+        assert man["backends"]["default_backend"] == "cpu"
+        assert man["topology"]["device_count"] >= 1
+        assert rep["phases"]["fit"]["wall_s"] > 0
+        assert rep["memory"]["start"]["source"] in ("memory_stats", "live_arrays")
+        # Compile tracking: the run jits fresh shapes, so at least one phase
+        # must carry a jit_compiles attribution.
+        assert any("jit_compiles" in row for row in rep["phases"].values())
+        # load_points reports the dataset shape it actually read.
+        load = next(e for e in events if e["stage"] == "load_points")
+        assert load["rows"] == n and load["dims"] == 3
+
+    def test_mr_path_roundtrip(self, tmp_path):
+        """The recursive-sampling path traces its level/boundary stages into
+        the same artifact pair."""
+        dataset, n = _write_blobs(tmp_path, n_per=80)
+        trace = str(tmp_path / "trace.jsonl")
+        report = str(tmp_path / "report.json")
+        rc = main(
+            [
+                f"file={dataset}",
+                "minPts=4",
+                "minClSize=20",
+                "processing_units=60",
+                "k=0.3",
+                "seed=1",
+                f"out_dir={tmp_path / 'out'}",
+                "--trace-out",
+                trace,
+                "--report",
+                report,
+            ]
+        )
+        assert rc == 0
+        events, errors = check_trace.validate_trace(trace)
+        assert errors == [], errors
+        stages = {e["stage"] for e in events}
+        assert "level" in stages and "fit" in stages
+        _, errors = check_trace.validate_report(report, trace_events=events)
+        assert errors == [], errors
+
+    def test_no_flags_no_artifacts(self, tmp_path):
+        """Both flags absent: the run creates the five canonical outputs and
+        NOTHING else — no trace, no report, no stray telemetry files."""
+        dataset, _ = _write_blobs(tmp_path)
+        out = tmp_path / "out"
+        before = set(os.listdir(tmp_path))
+        rc = main(
+            [
+                f"file={dataset}",
+                "minPts=4",
+                "minClSize=10",
+                "processing_units=200",
+                f"out_dir={out}",
+            ]
+        )
+        assert rc == 0
+        assert set(os.listdir(tmp_path)) - before == {"out"}
+        assert not [f for f in os.listdir(out) if f.endswith((".jsonl", ".json"))]
+
+    def test_summary_prints_all_stages(self, tmp_path, capsys):
+        """The end-of-run phase summary lists every traced stage (no
+        allowlist), expensive first."""
+        dataset, _ = _write_blobs(tmp_path)
+        rc = main(
+            [
+                f"file={dataset}",
+                "minPts=4",
+                "minClSize=10",
+                "processing_units=200",
+                f"out_dir={tmp_path / 'out'}",
+            ]
+        )
+        assert rc == 0
+        err = capsys.readouterr().err
+        assert "phases:" in err
+        for stage in ("load_points", "block_edges", "fit", "write_outputs"):
+            assert f"{stage}: n=" in err
+        # Sorted by summed wall descending.
+        walls = [
+            float(line.split("wall_s=")[1])
+            for line in err.split("phases:")[1].splitlines()
+            if "wall_s=" in line
+        ]
+        assert walls == sorted(walls, reverse=True)
+
+
+class TestMultiProcessMergedReport:
+    def test_two_process_merged_report(self, tmp_path):
+        """2 controllers x 2 virtual devices: each rank writes
+        ``trace.<rank>.jsonl``; the coordinator's report carries per-host
+        phase walls for both ranks."""
+        from hdbscan_tpu.parallel.distributed import (
+            communicate_all,
+            free_local_port,
+            hermetic_child_env,
+        )
+
+        rng = np.random.default_rng(7)
+        pts = np.concatenate(
+            [rng.normal(c, 0.4, size=(400, 3)) for c in ((0, 0, 0), (8, 0, 0), (0, 8, 8))]
+        )
+        dataset = str(tmp_path / "blobs.txt")
+        np.savetxt(dataset, pts, fmt="%.6f")
+        trace = str(tmp_path / "trace.jsonl")
+        report = str(tmp_path / "report.json")
+        port = free_local_port()
+        args = lambda pid: [  # noqa: E731
+            f"file={dataset}",
+            "minPts=4",
+            "minClSize=50",
+            "processing_units=256",
+            "k=0.1",
+            "seed=3",
+            f"out_dir={tmp_path / 'out'}",
+            f"clusterName=127.0.0.1:{port},{pid},2",
+            "--trace-out",
+            trace,
+            "--report",
+            report,
+        ]
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-m", "hdbscan_tpu", *args(pid)],
+                env=hermetic_child_env(2, repo_root=REPO),
+                cwd=REPO,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+            for pid in (0, 1)
+        ]
+        outs = communicate_all(procs)
+        if any(p.returncode != 0 for p in procs):
+            # The multi-controller collectives backend does not come up in
+            # every container (pre-existing, tests/e2e/test_multiprocess.py
+            # fails the same way); the merge logic itself is covered by
+            # tests/unit/test_telemetry.py::TestHostMerge.
+            pytest.skip(
+                "multi-controller run unavailable here: "
+                + outs[0][1][-400:].replace("\n", " | ")
+            )
+
+        # One trace file per rank, every line valid.
+        per_rank_events = {}
+        for pid in (0, 1):
+            rank_path = str(tmp_path / f"trace.{pid}.jsonl")
+            assert os.path.exists(rank_path), f"rank {pid} trace missing"
+            events, errors = check_trace.validate_trace(rank_path)
+            assert errors == [], errors
+            assert all(e["process"] == pid for e in events)
+            per_rank_events[pid] = events
+
+        rep = json.load(open(report))
+        assert rep["schema"].startswith("hdbscan-tpu-report/")
+        assert rep["manifest"]["topology"]["process_count"] == 2
+        hosts = rep["per_host"]
+        assert set(hosts) == {"0", "1"}
+        for pid, events in per_rank_events.items():
+            table = hosts[str(pid)]
+            fit_sum = sum(
+                e["wall_s"] for e in events if e["stage"] == "fit"
+            )
+            assert abs(table["fit"]["wall_s"] - fit_sum) < 1e-6
+            assert table["fit"]["count"] >= 1

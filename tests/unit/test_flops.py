@@ -56,6 +56,34 @@ class TestScanCounter:
         assert stats["pad_gflops"] == 1.0
         assert stats["gflops"] == 0.0
 
+    def test_phase_stats_zero_wall_drops_rates(self):
+        """wall_s=0 (instant/unmeasured phases): absolute work figures stay,
+        every per-second rate and MFU is dropped rather than divided by 0."""
+        snap = flops_mod.counter.snapshot()
+        flops_mod.counter.add(2e9, 1e9)
+        flops_mod.counter.add_pad_scan(rows=1000, cols=1000, d=500)
+        stats = flops_mod.phase_stats(snap, wall_s=0.0)
+        assert stats["gflops"] == 2.0
+        assert stats["gbytes"] == 1.0
+        assert stats["pad_gflops"] == 1.0
+        for key in ("gflops_s", "gbytes_s", "mfu"):
+            assert key not in stats
+
+    def test_legacy_two_tuple_snapshot_pad_semantics(self):
+        """Pre-pad-counter 2-tuple snapshots: flops/bytes still diff against
+        the snapshot, while the pad delta has no baseline and reports the
+        FULL current pad counter (the documented legacy reading)."""
+        flops_mod.counter.add_pad_scan(rows=1000, cols=1000, d=500)
+        snap = flops_mod.counter.snapshot()
+        legacy = snap[:2]
+        flops_mod.counter.add(2e9, 1e9)
+        stats = flops_mod.phase_stats(legacy, wall_s=2.0)
+        assert stats["gflops"] == 2.0
+        assert stats["gflops_s"] == 1.0
+        assert stats["pad_gflops"] == round(flops_mod.counter.pad_flops / 1e9, 1)
+        # The full 3-tuple baseline nets the pre-existing pads to zero.
+        assert "pad_gflops" not in flops_mod.phase_stats(snap, wall_s=2.0)
+
 
 class TestDispatchSitesCredit:
     def test_tiled_knn_credits(self):

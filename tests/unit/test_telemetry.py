@@ -1,0 +1,273 @@
+"""Observability layer (utils/tracing sinks + utils/telemetry): JSONL sink
+line contract, counter attribution, report aggregates, manifest/memory
+sampling, and the multi-host trace merge."""
+
+import json
+
+import numpy as np
+
+from hdbscan_tpu.utils import telemetry
+from hdbscan_tpu.utils.tracing import TRACE_SCHEMA, JsonlSink, Tracer
+
+
+class TestJsonlSink:
+    def test_line_contract(self, tmp_path):
+        """Every line: schema tag, increasing seq, stage, float wall_s,
+        static fields, sanitized event fields."""
+        path = str(tmp_path / "trace.jsonl")
+        t = Tracer(sinks=[JsonlSink(path, static={"process": 3})])
+        t("alpha", wall_s=0.5, rows=np.int64(7), frac=np.float32(0.25))
+        t("beta", flag=np.bool_(True), arr=np.arange(2))
+        t.close()
+        lines = [json.loads(s) for s in open(path) if s.strip()]
+        assert [ev["seq"] for ev in lines] == [0, 1]
+        for ev in lines:
+            assert ev["schema"] == TRACE_SCHEMA
+            assert ev["process"] == 3
+            assert isinstance(ev["stage"], str)
+            assert isinstance(ev["wall_s"], float)
+        assert lines[0]["rows"] == 7 and lines[0]["frac"] == 0.25
+        assert lines[1]["flag"] is True and lines[1]["arr"] == [0, 1]
+
+    def test_close_idempotent_and_flush_per_line(self, tmp_path):
+        path = str(tmp_path / "trace.jsonl")
+        sink = JsonlSink(path)
+        t = Tracer(sinks=[sink])
+        t("work", wall_s=1.0)
+        # Flushed before close: a killed run keeps its partial trace.
+        assert json.loads(open(path).readline())["stage"] == "work"
+        t.close()
+        t.close()
+
+    def test_stream_sugar_unchanged(self, tmp_path):
+        """Tracer(stream=...) still prints logfmt lines (pre-sink API)."""
+        out = open(tmp_path / "log.txt", "w+")
+        t = Tracer(stream=out)
+        t("work", n=1, wall_s=0.25)
+        out.seek(0)
+        assert "stage=work" in out.read()
+        out.close()
+
+
+class TestTracerCounters:
+    def test_delta_attribution(self):
+        """Counter deltas since the previous emit ride the NEXT event; zero
+        deltas are omitted (phase events emit at phase END, so the compiles
+        a phase triggered land on its own event)."""
+        box = [0]
+        t = Tracer(counters={"jit_compiles": lambda: box[0]})
+        box[0] = 2
+        t("phase_a", wall_s=0.1)
+        t("phase_b", wall_s=0.2)  # no compiles since phase_a
+        box[0] = 5
+        t("phase_c", wall_s=0.3)
+        assert t.events[0].fields["jit_compiles"] == 2
+        assert "jit_compiles" not in t.events[1].fields
+        assert t.events[2].fields["jit_compiles"] == 3
+
+    def test_summary_sorted_by_wall_desc(self):
+        t = Tracer()
+        t("cheap", wall_s=0.1)
+        t("dear", wall_s=2.0)
+        t("cheap", wall_s=0.2)
+        lines = t.summary().splitlines()
+        assert lines[0].startswith("dear:")
+        assert lines[1] == "cheap: n=2 wall_s=0.300"
+
+
+class TestSanitize:
+    def test_numpy_and_nested(self):
+        out = telemetry.json_sanitize(
+            {
+                "i": np.int32(3),
+                "f": np.float64(0.5),
+                "b": np.bool_(False),
+                "a": np.array([[1, 2]]),
+                "t": (1, np.int8(2)),
+                "n": None,
+                "o": object(),
+            }
+        )
+        assert out["i"] == 3 and out["f"] == 0.5 and out["b"] is False
+        assert out["a"] == [[1, 2]] and out["t"] == [1, 2] and out["n"] is None
+        assert isinstance(out["o"], str)
+        json.dumps(out)  # round-trips
+
+
+class TestPhaseAggregates:
+    def test_sums_and_rates(self):
+        t = Tracer()
+        t("scan", wall_s=1.0, gflops=10.0, gbytes=1.0, pad_gflops=2.0)
+        t("scan", wall_s=1.0, gflops=30.0, gbytes=3.0, jit_compiles=4)
+        t("tree", wall_s=0.5)
+        agg = telemetry.phase_aggregates(t.events)
+        assert list(agg) == ["scan", "tree"]  # wall-descending
+        scan = agg["scan"]
+        assert scan["count"] == 2 and scan["wall_s"] == 2.0
+        assert scan["gflops"] == 40.0 and scan["pad_gflops"] == 2.0
+        assert scan["gflops_s"] == 20.0  # summed gflops over summed wall
+        assert scan["jit_compiles"] == 4
+        assert agg["tree"] == {"count": 1, "wall_s": 0.5}
+
+    def test_accepts_jsonl_dicts(self):
+        events = [
+            {"stage": "scan", "wall_s": 1.5, "gflops": 3.0},
+            {"stage": "scan", "wall_s": 0.5},
+        ]
+        agg = telemetry.phase_aggregates(events)
+        assert agg["scan"]["count"] == 2
+        assert agg["scan"]["wall_s"] == 2.0
+        assert agg["scan"]["gflops"] == 3.0
+
+    def test_report_walls_match_tracer_total(self):
+        t = Tracer()
+        for w in (0.125, 0.25, 0.0625):
+            t("scan", wall_s=w)
+        report = telemetry.build_report(t)
+        assert report["schema"] == telemetry.REPORT_SCHEMA
+        assert abs(report["phases"]["scan"]["wall_s"] - t.total("scan")) < 1e-6
+        assert report["event_count"] == 3
+
+
+class TestManifestAndMemory:
+    def test_manifest_fields(self, monkeypatch):
+        from hdbscan_tpu.config import HDBSCANParams
+
+        monkeypatch.setenv("HDBSCAN_TPU_PEAK_FLOPS", "1e12")
+        m = telemetry.run_manifest(
+            HDBSCANParams(min_points=5), argv=["file=x"], extra={"tag": "t"}
+        )
+        assert m["params"]["min_points"] == 5
+        assert m["argv"] == ["file=x"]
+        assert m["topology"]["device_count"] >= 1
+        assert m["backends"]["default_backend"] == "cpu"
+        assert m["env"]["HDBSCAN_TPU_PEAK_FLOPS"] == "1e12"
+        assert m["tag"] == "t"
+        json.dumps(m)
+
+    def test_memory_sample_shape(self):
+        s = telemetry.sample_device_memory()
+        # CPU backend has no allocator stats -> live-array fallback.
+        assert s["source"] in ("memory_stats", "live_arrays")
+        if s["source"] == "live_arrays":
+            assert s["live_array_count"] >= 0
+            assert s["live_array_bytes"] >= 0
+        json.dumps(s)
+
+    def test_compile_counter_counts_new_compiles(self):
+        import jax
+        import jax.numpy as jnp
+
+        fn = telemetry.compile_counter()
+        before = fn()
+
+        @jax.jit
+        def f(x):
+            return x * 2 + 1
+
+        f(jnp.arange(7))  # fresh jaxpr + shape -> one backend compile
+        assert fn() > before
+
+
+class TestHostMerge:
+    def test_trace_path_for_process(self):
+        assert telemetry.trace_path_for_process("t.jsonl", 0, 1) == "t.jsonl"
+        assert telemetry.trace_path_for_process("t.jsonl", 2, 4) == "t.2.jsonl"
+        assert telemetry.host_trace_paths("t.jsonl", 2) == [
+            "t.0.jsonl",
+            "t.1.jsonl",
+        ]
+
+    def test_merge_two_hosts_and_missing(self, tmp_path):
+        base = str(tmp_path / "trace.jsonl")
+        for pid, wall in ((0, 1.0), (1, 3.0)):
+            t = Tracer(
+                sinks=[
+                    JsonlSink(
+                        telemetry.trace_path_for_process(base, pid, 3),
+                        static={"process": pid},
+                    )
+                ]
+            )
+            t("scan", wall_s=wall)
+            t("scan", wall_s=wall)
+            t.close()
+        merged = telemetry.merge_host_traces(telemetry.host_trace_paths(base, 3))
+        assert merged["0"]["scan"]["wall_s"] == 2.0
+        assert merged["1"]["scan"]["wall_s"] == 6.0
+        assert merged["1"]["scan"]["count"] == 2
+        assert merged["2"] == {"missing": True}  # dead rank is a finding
+
+    def test_read_trace_roundtrip(self, tmp_path):
+        path = str(tmp_path / "t.jsonl")
+        t = Tracer(sinks=[JsonlSink(path)])
+        t("a", wall_s=0.5, rows=3)
+        t.close()
+        events = telemetry.read_trace(path)
+        assert events[0]["stage"] == "a" and events[0]["rows"] == 3
+
+
+class TestCheckTraceScript:
+    def test_valid_artifacts_pass(self, tmp_path):
+        from scripts import check_trace
+
+        trace = str(tmp_path / "t.jsonl")
+        report = str(tmp_path / "r.json")
+        t = Tracer(sinks=[JsonlSink(trace)])
+        t("scan", wall_s=0.5, gflops=1.0)
+        t("scan", wall_s=0.25)
+        t("tree", wall_s=0.125)
+        t.close()
+        telemetry.write_report(report, telemetry.build_report(t))
+        events, errors = check_trace.validate_trace(trace)
+        assert len(events) == 3 and errors == []
+        _, errors = check_trace.validate_report(report, trace_events=events)
+        assert errors == []
+        assert check_trace.main([trace, report]) == 0
+
+    def test_violations_detected(self, tmp_path):
+        from scripts import check_trace
+
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text(
+            json.dumps({"schema": "wrong/1", "stage": "s", "wall_s": 0.1})
+            + "\nnot json\n"
+            + json.dumps({"schema": TRACE_SCHEMA, "stage": 5, "wall_s": "x"})
+            + "\n"
+        )
+        _, errors = check_trace.validate_trace(str(bad))
+        assert len(errors) >= 3
+        assert check_trace.main([str(bad)]) == 1
+
+    def test_wall_mismatch_detected(self, tmp_path):
+        from scripts import check_trace
+
+        trace = str(tmp_path / "t.jsonl")
+        report = str(tmp_path / "r.json")
+        t = Tracer(sinks=[JsonlSink(trace)])
+        t("scan", wall_s=0.5)
+        t.close()
+        rep = telemetry.build_report(t)
+        rep["phases"]["scan"]["wall_s"] += 1e-3  # outside tolerance
+        telemetry.write_report(report, rep)
+        events, _ = check_trace.validate_trace(trace)
+        _, errors = check_trace.validate_report(report, trace_events=events)
+        assert any("wall_s" in e for e in errors)
+
+
+def test_check_trace_is_stdlib_only():
+    """The validator must run where artifacts land, without jax/numpy."""
+    import ast
+
+    from scripts import check_trace
+
+    src = open(check_trace.__file__).read()
+    for node in ast.walk(ast.parse(src)):
+        if isinstance(node, ast.Import):
+            mods = [a.name for a in node.names]
+        elif isinstance(node, ast.ImportFrom):
+            mods = [node.module or ""]
+        else:
+            continue
+        for mod in mods:
+            assert mod.split(".")[0] in ("__future__", "json", "math", "sys"), mod
