@@ -57,6 +57,7 @@ def main(argv: list[str] | None = None) -> None:
     argv_full = list(argv)
     trace_out = _pop_path_flag(argv, "--trace-out")
     report_out = _pop_path_flag(argv, "--report")
+    compile_cache = _pop_path_flag(argv, "--compile-cache") or "auto"
     if argv:
         raise SystemExit(f"bench.py: unknown arguments {argv!r}")
 
@@ -70,7 +71,11 @@ def main(argv: list[str] | None = None) -> None:
         if trace_out is not None:
             sinks.append(JsonlSink(trace_out, static={"bench": True}))
         tracer = Tracer(
-            sinks=sinks, counters={"jit_compiles": telemetry.compile_counter()}
+            sinks=sinks,
+            counters={
+                "jit_compiles": telemetry.compile_counter(),
+                "cache_hits": telemetry.cache_hit_counter(),
+            },
         )
         if report_out is not None:
             mem_start = telemetry.sample_device_memory()
@@ -78,8 +83,9 @@ def main(argv: list[str] | None = None) -> None:
     # Persistent XLA cache (r5): compiles are a one-time per-machine cost,
     # as in any production JAX deployment; the in-process median-of-3
     # protocol already excluded warm-run compiles — this excludes them from
-    # the first run too once the machine has seen the shapes.
-    enable_persistent_compilation_cache()
+    # the first run too once the machine has seen the shapes. --compile-cache
+    # {auto,off,DIR} overrides (reports then show cache_hits per phase).
+    enable_persistent_compilation_cache(compile_cache)
 
     # Multi-chip-ready: on a host with >1 accelerator the same bench shards
     # the scans and block batches over the full mesh (row shards over ICI);
@@ -149,6 +155,44 @@ def main(argv: list[str] | None = None) -> None:
         ),
         "calibrated",
     )
+
+    # --- exact path over the ring-sharded scan engine (ring_e2e leg) -------
+    # Same literal config, scan_backend=ring: row shards own the k-NN and
+    # Borůvka sweeps, column panels circulate over the mesh ring (README
+    # "Scaling out"). Needs >1 device; on a 1-chip/CPU host the leg is
+    # skipped with a note so the headline rows stay comparable. CPU meshes
+    # (forced-device smoke runs) are MARKED in the row — TPU targets live in
+    # benchmarks/devicebench.py: >= 0.8x linear scaling efficiency on 8
+    # chips and no 1-chip regression vs the host path.
+    ring_fields = {}
+    if mesh is not None:
+        ring_wall, ring_spread, ring_ari, _ = run_exact(
+            HDBSCANParams(
+                min_points=LIT_MIN_PTS,
+                min_cluster_size=MIN_CL_SIZE,
+                scan_backend="ring",
+            ),
+            "ring",
+        )
+        ring_fields = {
+            "ring_e2e_wall_s": round(ring_wall, 3),
+            "ring_e2e_spread_s": [
+                round(ring_spread[0], 3),
+                round(ring_spread[1], 3),
+            ],
+            "ring_e2e_vs_baseline": round(RB_BASELINE_S / ring_wall, 3),
+            "ring_e2e_vs_host": round(lit_wall / ring_wall, 3),
+            "ring_e2e_ari": round(ring_ari, 4),
+            "ring_e2e_devices": int(np.prod(mesh.devices.shape)),
+            "ring_e2e_platform": jax.devices()[0].platform,
+            "ring_e2e_cpu_smoke": jax.devices()[0].platform != "tpu",
+        }
+    else:
+        print(
+            "[bench] ring_e2e: skipped (single device — ring scan needs a "
+            "multi-device mesh)",
+            file=sys.stderr,
+        )
 
     # --- distributed DB pipeline (reference's live method) -----------------
     mr_params = HDBSCANParams(
@@ -240,6 +284,7 @@ def main(argv: list[str] | None = None) -> None:
                 ],
                 "db_flat_vs_baseline": round(DB_BASELINE_S / fl_wall, 3),
                 "db_flat_ari": round(fl_ari, 4),
+                **ring_fields,
             }
         )
     )
@@ -256,7 +301,15 @@ def main(argv: list[str] | None = None) -> None:
                     manifest=telemetry.run_manifest(
                         None,
                         argv=argv_full,
-                        extra={"entrypoint": "bench.py", "dataset": SKIN_PATH},
+                        extra={
+                            "entrypoint": "bench.py",
+                            "dataset": SKIN_PATH,
+                            "compile_cache": {
+                                "setting": compile_cache,
+                                "jit_compiles": telemetry.compile_counter()(),
+                                "cache_hits": telemetry.cache_hit_counter()(),
+                            },
+                        },
                     ),
                     memory={
                         "start": mem_start,

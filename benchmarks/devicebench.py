@@ -29,6 +29,11 @@ dispatch/transfer-bound, kernels are not worth optimizing" (ROADMAP r4 item
   INTERPRETER (orders of magnitude slower than compiled XLA), so they are
   gated to small ``--n`` smoke rows there; interpreter rates validate the
   wiring, not TPU throughput.
+- ``ring_scan`` / ``ring_e2e``: the ring-sharded scan engine
+  (``parallel/ring.py``, README "Scaling out") vs the host path on the same
+  rows — raw scan and ``exact.fit`` end-to-end. TPU targets: >= 0.8x linear
+  scaling efficiency on 8 chips, no 1-chip regression vs host; CPU rows
+  are wiring smoke checks marked ``cpu_smoke`` (see ``bench_ring_scan``).
 
 FLOP convention matches ``utils/flops`` (2*rows*cols*d logical; the
 f32-HIGHEST cross matmul runs ~6 bf16 passes, so a perfectly MXU-bound
@@ -57,7 +62,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from hdbscan_tpu.utils.cache import enable_persistent_compilation_cache
 
-enable_persistent_compilation_cache()
+def _early_flag(name: str, default: str) -> str:
+    """Read ``--name VALUE``/``--name=VALUE`` from sys.argv before argparse
+    runs — the compile-cache config must win before the first jit."""
+    argv = sys.argv
+    for i, a in enumerate(argv):
+        if a == name and i + 1 < len(argv):
+            return argv[i + 1]
+        if a.startswith(name + "="):
+            return a.split("=", 1)[1]
+    return default
+
+
+enable_persistent_compilation_cache(_early_flag("--compile-cache", "auto"))
 
 from hdbscan_tpu.core.distances import pairwise_distance
 from hdbscan_tpu.utils.flops import PEAK_FLOPS
@@ -376,12 +393,118 @@ def bench_rescan_chunk(out_path, n=1_000_000, d=10, k=15, win_tiles=4,
         ))
 
 
+def bench_ring_scan(out_path, n=100_000, d=8, min_pts=16, iters=3, seed=0):
+    """Ring-sharded scan engine legs (README "Scaling out").
+
+    - ``ring_scan``: ``parallel.ring.ring_knn_core_distances`` — row shards
+      compute against column panels circulating over ``lax.ppermute`` —
+      against the host ``knn_core_distances`` on the same rows. The raw
+      scan-engine comparison.
+    - ``ring_e2e``: ``models.exact.fit`` under ``scan_backend=ring`` vs
+      ``scan_backend=host`` — the end-to-end path the CLI ships (core scan
+      + every Borůvka round on the ring).
+
+    TPU targets (the numbers this bench exists to adjudicate):
+
+    - 8-chip slice: scaling efficiency ``host_wall / (ring_wall * n_dev)``
+      >= 0.8x linear on both legs (panels are in flight during compute, so
+      the ring should hide nearly all ICI time at production shapes).
+    - 1-chip: no regression vs host (ratio ~1.0 — a 1-device ring is the
+      host schedule plus an identity permute).
+
+    CPU meshes exist only via ``--xla_force_host_platform_device_count``
+    and share one socket, so CPU ratios say nothing about scaling — those
+    rows are wiring smoke checks and are marked ``cpu_smoke=true``.
+    """
+    from hdbscan_tpu.config import HDBSCANParams
+    from hdbscan_tpu.models import exact
+    from hdbscan_tpu.ops.tiled import knn_core_distances
+    from hdbscan_tpu.parallel.mesh import get_mesh
+    from hdbscan_tpu.parallel.ring import ring_knn_core_distances
+
+    if len(jax.devices()) < 2:
+        print(
+            "# ring legs skipped: single device — the ring scan needs a "
+            "multi-device mesh (TPU slice, or "
+            "--xla_force_host_platform_device_count for a CPU smoke row)",
+            flush=True,
+        )
+        return
+    mesh = get_mesh()
+    n_dev = int(np.prod(mesh.devices.shape))
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(seed)
+    data = rng.normal(size=(n, d)).astype(np.float32)
+    base = dict(
+        n=n, d=d, min_pts=min_pts, iters=iters, seed=seed, devices=n_dev,
+        platform=platform, cpu_smoke=platform != "tpu",
+        device=str(jax.devices()[0]), peak_flops=PEAK_FLOPS,
+    )
+    flops = 2.0 * n * n * d  # logical; host/ring pad differently
+
+    def timed(fn):
+        fn()  # untimed warmup — exclude one-time XLA compiles
+        walls = []
+        for _ in range(max(1, iters - 1)):
+            t0 = time.perf_counter()
+            fn()
+            walls.append(time.perf_counter() - t0)
+        return float(np.median(walls)), [
+            round(min(walls), 4), round(max(walls), 4),
+        ]
+
+    host_wall, host_spread = timed(
+        lambda: knn_core_distances(
+            data, min_pts, "euclidean", backend="xla", fetch_knn=False
+        )
+    )
+    ring_wall, ring_spread = timed(
+        lambda: ring_knn_core_distances(
+            data, min_pts, "euclidean", fetch_knn=False, mesh=mesh
+        )
+    )
+    _emit(out_path, dict(
+        leg="ring_scan", wall_s=round(ring_wall, 4), spread_s=ring_spread,
+        host_wall_s=round(host_wall, 4), host_spread_s=host_spread,
+        vs_host=round(host_wall / ring_wall, 3),
+        scaling_efficiency=round(host_wall / (ring_wall * n_dev), 3),
+        gflops=round(flops / 1e9, 1),
+        gflops_s=round(flops / ring_wall / 1e9, 1),
+        mfu=round(flops / ring_wall / PEAK_FLOPS, 5), **base,
+    ))
+
+    params_host = HDBSCANParams(
+        min_points=min_pts, min_cluster_size=64, scan_backend="host"
+    )
+    params_ring = params_host.replace(scan_backend="ring")
+    e2e_host, e2e_host_spread = timed(
+        lambda: exact.fit(data, params_host, mesh=mesh)
+    )
+    e2e_ring, e2e_ring_spread = timed(
+        lambda: exact.fit(data, params_ring, mesh=mesh)
+    )
+    _emit(out_path, dict(
+        leg="ring_e2e", wall_s=round(e2e_ring, 4), spread_s=e2e_ring_spread,
+        host_wall_s=round(e2e_host, 4), host_spread_s=e2e_host_spread,
+        vs_host=round(e2e_host / e2e_ring, 3),
+        scaling_efficiency=round(e2e_host / (e2e_ring * n_dev), 3),
+        **base,
+    ))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(__file__), "devicebench_r6.jsonl"))
-    ap.add_argument("--legs", default="dispatch,exact,rescan")
+    ap.add_argument("--legs", default="dispatch,exact,rescan,ring")
     ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--compile-cache", default="auto",
+                    help="persistent XLA cache: auto, off, or a directory "
+                         "(consumed before argparse — listed for --help)")
+    ap.add_argument("--ring-n", type=int, default=100_000,
+                    help="ring-leg rows (needs a multi-device mesh; CPU "
+                         "smoke rows are marked cpu_smoke)")
+    ap.add_argument("--ring-d", type=int, default=8)
     ap.add_argument("--n", type=int, default=500_000,
                     help="exact-scan rows (use ~4096 for off-TPU fused "
                          "smoke rows — interpreter-mode gate at 16384)")
@@ -401,6 +524,10 @@ def main():
             args.out, n=args.rescan_n, col_tile=args.rescan_col_tile,
             chunk_tiles=tuple(int(t) for t in args.rescan_tiles.split(",")),
             iters=args.iters,
+        )
+    if "ring" in legs:
+        bench_ring_scan(
+            args.out, n=args.ring_n, d=args.ring_d, iters=args.iters,
         )
 
 

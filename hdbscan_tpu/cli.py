@@ -10,9 +10,10 @@ Usage mirrors the reference's documented contract (``main/Main.java:534-614``)::
         [boundary=F] [boundary_alpha=F] [boundary_max_frac=F] [glue_alpha=F] \
         [glue_factor=N] [glue_rows=N] [block_pruning={true,false}] \
         [knn_backend={auto,xla,pallas,fused}] \
+        [scan_backend={auto,host,ring}] \
         [consensus=N] [compat_cf={true,false}] \
         [clusterName={local,auto,<host:port>,<pid>,<np>}] \
-        [--trace-out PATH] [--report PATH]
+        [--trace-out PATH] [--report PATH] [--compile-cache {auto,off,DIR}]
 
 Telemetry (README "Observability"): ``--trace-out PATH`` appends every
 pipeline stage event as a schema-versioned JSON line (multi-host runs write
@@ -21,6 +22,16 @@ one ``PATH``-derived file per process: ``trace.<process_index>.jsonl``);
 device topology, env overrides), per-phase wall/GFLOP/MFU/compile aggregates,
 sampled device memory, and per-host phase walls when several processes ran.
 With both flags absent no telemetry file I/O happens.
+
+``scan_backend`` picks the device scan engine for the k-NN/core and
+Borůvka sweeps (README "Scaling out"): ``host`` keeps the single-program
+tiled scans, ``ring`` shards rows over the mesh and circulates column
+panels via ``ppermute``, and ``auto`` selects ring only on a multi-device
+TPU mesh. ``--compile-cache`` controls jax's persistent XLA compile cache:
+``auto`` (default) resolves JAX_COMPILATION_CACHE_DIR then the per-user
+default dir, ``off`` disables it, anything else is the cache directory.
+Reports record per-phase ``cache_hits`` next to ``jit_compiles`` so warmed
+vs cold compile bills are visible.
 
 Unlike the reference, argv is actually honored (the reference shadows it with
 hard-coded args, ``main/Main.java:71`` — treated as a bug, SURVEY.md §7), and
@@ -72,7 +83,15 @@ def main(argv: list[str] | None = None) -> int:
     try:
         trace_out = _pop_path_flag(argv, "--trace-out")
         report_out = _pop_path_flag(argv, "--report")
+        compile_cache_flag = _pop_path_flag(argv, "--compile-cache")
         params = HDBSCANParams.from_args(argv)
+        if compile_cache_flag is not None:
+            import dataclasses
+
+            # replace() re-runs __post_init__ validation on the new value.
+            params = dataclasses.replace(
+                params, compile_cache=compile_cache_flag
+            )
     except ValueError as e:
         print(f"error: {e}\n{HELP}", file=sys.stderr)
         return 2
@@ -100,7 +119,7 @@ def main(argv: list[str] | None = None) -> int:
     from hdbscan_tpu.utils.cache import enable_persistent_compilation_cache
     from hdbscan_tpu.utils.io import load_points
 
-    enable_persistent_compilation_cache()
+    cache_dir = enable_persistent_compilation_cache(params.compile_cache)
 
     # Multi-controller SPMD driving (the reference's Spark master+executors,
     # main/Main.java:89-95, re-mapped): every process runs the SAME
@@ -140,8 +159,12 @@ def main(argv: list[str] | None = None) -> int:
     if telemetry_on:
         from hdbscan_tpu.utils import telemetry
 
-        # Per-phase jit-compile attribution rides the tracer's counter hook.
-        counters = {"jit_compiles": telemetry.compile_counter()}
+        # Per-phase jit-compile + cache-hit attribution rides the tracer's
+        # counter hook (cache_hits ~= jit_compiles on a warmed machine).
+        counters = {
+            "jit_compiles": telemetry.compile_counter(),
+            "cache_hits": telemetry.cache_hit_counter(),
+        }
         if trace_out is not None:
             trace_path = telemetry.trace_path_for_process(
                 trace_out, jax.process_index(), n_proc
@@ -254,7 +277,17 @@ def main(argv: list[str] | None = None) -> int:
             report_out,
             telemetry.build_report(
                 tracer,
-                manifest=telemetry.run_manifest(params, argv=argv_full),
+                manifest=telemetry.run_manifest(
+                    params,
+                    argv=argv_full,
+                    extra={
+                        "compile_cache": {
+                            "dir": cache_dir,
+                            "jit_compiles": telemetry.compile_counter()(),
+                            "cache_hits": telemetry.cache_hit_counter()(),
+                        }
+                    },
+                ),
                 memory={
                     "start": mem_start,
                     "end": telemetry.sample_device_memory(),

@@ -193,6 +193,22 @@ class HDBSCANParams:
     #: falls back to the guarded XLA scan when the shape/metric/platform is
     #: ineligible, so the knob is safe under every parameterization.
     knn_backend: str = "auto"
+    #: Scale-out engine for the exact-path scans (core distances, Borůvka
+    #: rounds, the mr-hdbscan glue + boundary rescan): "host" keeps the
+    #: column-replicated scans (each device holds a full data copy; the
+    #: pre-ring behavior), "ring" shards rows AND columns over the mesh and
+    #: circulates column panels via ``lax.ppermute`` (``parallel/ring.py``
+    #: — per-device HBM drops to O(n/devices · d), neighbor exchange
+    #: overlaps compute), "auto" (default) picks ring on multi-device TPU
+    #: meshes and host elsewhere. Outputs are bitwise identical across
+    #: backends (ring parity tests, tests/unit/test_ring.py).
+    scan_backend: str = "auto"
+    #: Persistent XLA compilation cache: "auto" (default) enables it at the
+    #: default directory (``utils/cache.py`` — ``$JAX_COMPILATION_CACHE_DIR``
+    #: or ``~/.cache/hdbscan_tpu_xla``), "off" disables it, any other value
+    #: is used as the cache directory path. Cache hits vs fresh compiles are
+    #: recorded in the run report (``utils/telemetry.cache_hit_counter``).
+    compile_cache: str = "auto"
     # Output file names derived from the input path (main/Main.java:516-526):
 
     def __post_init__(self):
@@ -223,6 +239,15 @@ class HDBSCANParams:
                              "uncapped deep-crossing tier")
         if self.consensus_draws < 1:
             raise ValueError("consensus_draws must be >= 1")
+        if self.scan_backend not in ("auto", "host", "ring"):
+            raise ValueError(
+                "scan_backend must be 'auto', 'host' or 'ring', "
+                f"got {self.scan_backend!r}"
+            )
+        if not self.compile_cache:
+            raise ValueError(
+                "compile_cache must be 'auto', 'off' or a directory path"
+            )
         if self.knn_backend not in ("auto", "xla", "pallas", "fused"):
             raise ValueError(
                 "knn_backend must be 'auto', 'xla', 'pallas' or 'fused', "
@@ -305,6 +330,8 @@ FLAG_FIELDS = {
     "consensus": ("consensus_draws", int),
     "block_pruning": ("boundary_block_pruning", _bool),
     "knn_backend": ("knn_backend", str),
+    "scan_backend": ("scan_backend", str),
+    "compile_cache": ("compile_cache", str),
     "max_samples": ("max_samples", int),
     "compat_cf": ("compat_cf_int_math", _bool),
 }

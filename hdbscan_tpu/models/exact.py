@@ -47,6 +47,7 @@ def mst_edges(
     mesh=None,
     trace=None,
     knn_backend: str = "auto",
+    scan_backend: str = "auto",
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Blocked Borůvka: (u, v, w) exact mutual-reachability MST + core distances.
 
@@ -56,21 +57,35 @@ def mst_edges(
     necessarily a global MST edge — the cut property needs the minimum over
     ALL crossing edges — and the parity tests caught the difference.)
 
-    ``knn_backend`` selects the core-distance scan backend
-    (``ops/tiled.knn_core_distances``); the Borůvka rounds are unaffected.
+    ``knn_backend`` selects the core-distance scan kernel
+    (``ops/tiled.knn_core_distances``); ``scan_backend`` selects the
+    scale-out engine for BOTH the core scan and the Borůvka rounds — "host"
+    (replicated columns) or "ring" (ring-systolic row/panel sharding,
+    ``parallel/ring.py``), "auto" picking ring on multi-device TPU meshes.
+    Results are bitwise identical across scan backends.
     """
     import time
 
+    from hdbscan_tpu.parallel.ring import resolve_scan_backend
     from hdbscan_tpu.utils.flops import counter as _flops
     from hdbscan_tpu.utils.flops import phase_stats
 
     n = len(data)
     t0 = time.monotonic()
     fsnap = _flops.snapshot()
-    core, _ = knn_core_distances(
-        data, min_pts, metric, row_tile=row_tile, col_tile=col_tile, dtype=dtype,
-        fetch_knn=False, backend=knn_backend,
-    )
+    if resolve_scan_backend(scan_backend, mesh) == "ring":
+        from hdbscan_tpu.parallel.ring import ring_knn_core_distances
+
+        core, _ = ring_knn_core_distances(
+            data, min_pts, metric, row_tile=row_tile, col_tile=col_tile,
+            dtype=dtype, fetch_knn=False, mesh=mesh, trace=trace,
+            knn_backend=knn_backend,
+        )
+    else:
+        core, _ = knn_core_distances(
+            data, min_pts, metric, row_tile=row_tile, col_tile=col_tile,
+            dtype=dtype, fetch_knn=False, backend=knn_backend,
+        )
     if trace is not None:
         wall = time.monotonic() - t0
         trace(
@@ -86,6 +101,7 @@ def mst_edges(
         max_rounds=max_rounds,
         mesh=mesh,
         trace=trace,
+        scan_backend=scan_backend,
     )
     return u, v, w, core
 
@@ -100,21 +116,35 @@ def mst_edges_from_core(
     max_rounds: int = 64,
     mesh=None,
     trace=None,
+    scan_backend: str = "auto",
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """The Borůvka round loop of :func:`mst_edges` for PRE-COMPUTED core
-    distances (the weighted/dedup path supplies multiset-weighted cores)."""
+    distances (the weighted/dedup path supplies multiset-weighted cores).
+
+    ``scan_backend="ring"`` swaps the column-replicated scanner for the
+    ring-systolic sharded one (``parallel/ring.py``) — same edges bitwise.
+    """
     import time
 
+    from hdbscan_tpu.parallel.ring import resolve_scan_backend
     from hdbscan_tpu.utils.flops import counter as _flops
     from hdbscan_tpu.utils.flops import phase_stats
 
     n = len(data)
     t0 = time.monotonic()
     fsnap = _flops.snapshot()
-    scanner = BoruvkaScanner(
-        data, core, metric, row_tile=row_tile, col_tile=col_tile, dtype=dtype,
-        mesh=mesh,
-    )
+    if resolve_scan_backend(scan_backend, mesh) == "ring":
+        from hdbscan_tpu.parallel.ring import RingBoruvkaScanner
+
+        scanner = RingBoruvkaScanner(
+            data, core, metric, row_tile=row_tile, col_tile=col_tile,
+            dtype=dtype, mesh=mesh, trace=trace,
+        )
+    else:
+        scanner = BoruvkaScanner(
+            data, core, metric, row_tile=row_tile, col_tile=col_tile,
+            dtype=dtype, mesh=mesh,
+        )
 
     comp = np.arange(n, dtype=np.int64)
     eu, ev, ew = [], [], []
@@ -347,6 +377,7 @@ def fit(
         mesh=mesh,
         trace=trace,
         knn_backend=params.knn_backend,
+        scan_backend=getattr(params, "scan_backend", "auto"),
     )
     from hdbscan_tpu.models._finalize import finalize_clustering
 

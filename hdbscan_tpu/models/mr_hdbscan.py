@@ -604,13 +604,28 @@ def _fit_rows(
                 data, weights, params.min_points, metric
             )
         else:
-            core, _ = knn_core_distances(
-                data,
-                params.min_points,
-                metric,
-                fetch_knn=False,
-                backend=params.knn_backend,
-            )
+            from hdbscan_tpu.parallel.ring import resolve_scan_backend
+
+            if resolve_scan_backend(params.scan_backend, mesh) == "ring":
+                from hdbscan_tpu.parallel.ring import ring_knn_core_distances
+
+                core, _ = ring_knn_core_distances(
+                    data,
+                    params.min_points,
+                    metric,
+                    fetch_knn=False,
+                    mesh=mesh,
+                    trace=trace,
+                    knn_backend=params.knn_backend,
+                )
+            else:
+                core, _ = knn_core_distances(
+                    data,
+                    params.min_points,
+                    metric,
+                    fetch_knn=False,
+                    backend=params.knn_backend,
+                )
     n_dev = 1
     if mesh is not None:
         n_dev = math.prod(mesh.devices.shape)
@@ -647,6 +662,8 @@ def _fit_rows(
                 metric,
                 core=core[act] if global_core else None,
                 mesh=mesh,
+                scan_backend=params.scan_backend,
+                trace=trace,
             )
             pool_u.append(act[gu_l])
             pool_v.append(act[gv_l])
@@ -1033,10 +1050,22 @@ def _fit_rows(
             )
             bset_knn = (knn_d_g, knn_j_g)
         else:
-            core_b = knn_core_distances_rows(
-                data, bset, params.min_points, metric,
-                backend=params.knn_backend,
-            )
+            from hdbscan_tpu.parallel.ring import resolve_scan_backend
+
+            if resolve_scan_backend(params.scan_backend, mesh) == "ring":
+                from hdbscan_tpu.parallel.ring import (
+                    ring_knn_core_distances_rows,
+                )
+
+                core_b = ring_knn_core_distances_rows(
+                    data, bset, params.min_points, metric, mesh=mesh,
+                    trace=trace,
+                )
+            else:
+                core_b = knn_core_distances_rows(
+                    data, bset, params.min_points, metric,
+                    backend=params.knn_backend,
+                )
         core[bset] = np.minimum(core[bset], core_b)
         if trace is not None:
             wall = time.monotonic() - t0
@@ -1082,11 +1111,12 @@ def _fit_rows(
                     geom=geom_bset,
                     mesh=mesh,
                     trace=trace,
+                    scan_backend=params.scan_backend,
                 )
             else:
                 gu, gv, gw = boruvka_glue_edges(
                     data[bset_g], final_block[bset_g], metric, core=core[bset_g],
-                    mesh=mesh,
+                    mesh=mesh, scan_backend=params.scan_backend, trace=trace,
                 )
             # Exact-f64 weights for the appended glue edges (same tie-
             # determinism rationale as the final-pool reweight): the
@@ -1186,11 +1216,13 @@ def _fit_rows(
                         geom=geom_bset,
                         mesh=mesh,
                         trace=trace,
+                        scan_backend=params.scan_backend,
                     )
                 else:
                     ru, rv, rw = boruvka_glue_edges(
                         data[bset_g], groups_r[bset_g], metric, core=core[bset_g],
-                        mesh=mesh,
+                        mesh=mesh, scan_backend=params.scan_backend,
+                        trace=trace,
                     )
                 ru, rv = bset_g[ru], bset_g[rv]
             else:
@@ -1198,7 +1230,7 @@ def _fit_rows(
                     break
                 ru, rv, rw = boruvka_glue_edges(
                     data, groups_r, metric, core=core if global_core else None,
-                    mesh=mesh,
+                    mesh=mesh, scan_backend=params.scan_backend, trace=trace,
                 )
             if len(ru) == 0:
                 break
@@ -1248,7 +1280,8 @@ def _fit_rows(
             if len(np.unique(g)) < 2:
                 break
             ru, rv, rw = boruvka_glue_edges(
-                data, g, metric, core=core, mesh=mesh
+                data, g, metric, core=core, mesh=mesh,
+                scan_backend=params.scan_backend, trace=trace,
             )
             if len(ru) == 0:
                 break

@@ -476,6 +476,35 @@ def _tiled_window_jobs(
         lo += n_real
 
 
+def _prestage_chunks(chunks, stage_fn):
+    """Double-buffered host dispatch over ``_tiled_window_jobs`` chunks.
+
+    ``jax.device_put`` is asynchronous: it enqueues the H2D copy on the
+    transfer engine and returns immediately. Holding ONE staged chunk back
+    therefore overlaps chunk t+1's host-side assembly *and* its H2D
+    transfer with chunk t's merge program — by the time the dispatch loop
+    asks for the next chunk its operands are already device-resident
+    instead of uploading synchronously inside the ``jnp.asarray`` call on
+    the critical path. Exactly one extra chunk is staged at a time, so the
+    footprint stays at 2x the per-chunk budget (the big (m+1, k) merge
+    carries are still recycled via ``donate_argnums`` on the chunk jits).
+
+    ``stage_fn(ids, starts, locs) -> tuple`` builds whatever device
+    operands the call site's merge program needs (the fused k-NN path
+    derives tile indices from ``starts`` before upload). Yields
+    ``(metas, staged, n_slots, n_real)`` with ``n_slots`` the chunk's
+    padded tile count (``ids.shape[0]`` of the source chunk).
+    """
+    prev = None
+    for metas, ids, starts, locs, n_real in chunks:
+        item = (metas, stage_fn(ids, starts, locs), ids.shape[0], n_real)
+        if prev is not None:
+            yield prev
+        prev = item
+    if prev is not None:
+        yield prev
+
+
 def _merge_knn_device(cur_d, cur_i, new_d, new_i, k: int):
     """Rowwise dedup-merge of two (r, k) ascending neighbor lists on device.
 
@@ -909,31 +938,46 @@ def knn_rows_blockpruned(
         t0 = _time.monotonic()
         fsnap = _flops.snapshot()
         n_chunks = n_tiles = n_pad_tiles = 0
-        for _metas, ids, starts, locs, n_real in _tiled_window_jobs(
-            jobs, lambda r: rows_sorted_pos[r], row_tile, dummy=m,
-            slot_budget=_FUSED_SLOT_BUDGET if use_fused else None,
+
+        if use_fused:
+
+            def _stage(ids, starts, locs):
+                # Fused chunks index windows by TILE, not column — derive
+                # before upload so the division never rides the device.
+                return jax.device_put((ids, locs, starts // geom.col_tile))
+
+        else:
+
+            def _stage(ids, starts, locs):
+                return jax.device_put((ids, locs, starts))
+
+        for _metas, staged, n_slots, n_real in _prestage_chunks(
+            _tiled_window_jobs(
+                jobs, lambda r: rows_sorted_pos[r], row_tile, dummy=m,
+                slot_budget=_FUSED_SLOT_BUDGET if use_fused else None,
+            ),
+            _stage,
         ):
+            ids_d, locs_d, starts_d = staged
             _flops.add_scan(
                 n_real * row_tile, win_cols, d, row_tile=row_tile
             )
-            if ids.shape[0] > n_real:
+            if n_slots > n_real:
                 _flops.add_pad_scan(
-                    (ids.shape[0] - n_real) * row_tile, win_cols, d
+                    (n_slots - n_real) * row_tile, win_cols, d
                 )
             n_tiles += n_real
-            n_pad_tiles += ids.shape[0] - n_real
+            n_pad_tiles += n_slots - n_real
             if use_fused:
                 best_d, best_i = _knn_window_merge_chunk_fused(
                     best_d,
                     best_i,
-                    jnp.asarray(ids),
-                    jnp.asarray(locs),
+                    ids_d,
+                    locs_d,
                     geom.data_sorted,
                     data_t_f,
                     colmask_f,
-                    jnp.asarray(
-                        np.asarray(starts, np.int32) // geom.col_tile
-                    ),
+                    starts_d,
                     k,
                     geom.col_tile,
                     geom.win_tiles,
@@ -943,11 +987,11 @@ def knn_rows_blockpruned(
                 best_d, best_i = _knn_window_merge_chunk(
                     best_d,
                     best_i,
-                    jnp.asarray(ids),
-                    jnp.asarray(locs),
+                    ids_d,
+                    locs_d,
                     geom.data_sorted,
                     geom.valid_sorted,
-                    jnp.asarray(starts),
+                    starts_d,
                     k,
                     geom.metric,
                     geom.col_tile,
@@ -967,6 +1011,7 @@ def knn_rows_blockpruned(
                 pad_tiles=n_pad_tiles,
                 row_tile=row_tile,
                 fused=use_fused,
+                double_buffered=True,
                 wall_s=round(wall, 6),
                 **_phase_stats(fsnap, wall),
             )
@@ -1041,6 +1086,7 @@ def boruvka_glue_edges_blockpruned(
     geom: BlockGeometry | None = None,
     mesh=None,
     trace=None,
+    scan_backend: str = "host",
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Exact inter-group MST glue with block-candidate column windows.
 
@@ -1079,6 +1125,11 @@ def boruvka_glue_edges_blockpruned(
     fallback rounds across devices; the window jobs themselves are
     single-device by design (each is a small pow2-rows x fixed-window
     program — sharding them would cost more in dispatch than it saves).
+    ``scan_backend`` picks that dense fallback's engine (README "Scaling
+    out"): "ring" routes it through the ring-sharded
+    ``parallel.ring.RingBoruvkaScanner`` (circulating column panels instead
+    of a replicated column set), "host"/"auto"-off-TPU keep the replicated
+    ``BoruvkaScanner``. Output is bitwise identical either way.
     """
     from hdbscan_tpu.utils.unionfind import contract_min_edges
 
@@ -1253,32 +1304,38 @@ def boruvka_glue_edges_blockpruned(
 
             win_cols = geom.win_tiles * geom.col_tile
             n_chunks = 0
-            for _metas, idsc, starts, locs, n_real in _tiled_window_jobs(
-                jobs, lambda r: geom.inv_perm[r], row_tile, dummy=m
+            for _metas, staged, n_slots, n_real in _prestage_chunks(
+                _tiled_window_jobs(
+                    jobs, lambda r: geom.inv_perm[r], row_tile, dummy=m
+                ),
+                lambda ids, starts, locs: jax.device_put(
+                    (ids, locs, starts)
+                ),
             ):
+                idsc_d, locs_d, starts_d = staged
                 _flops.add_scan(
                     n_real * row_tile,
                     win_cols,
                     data.shape[1],
                     row_tile=row_tile,
                 )
-                if idsc.shape[0] > n_real:
+                if n_slots > n_real:
                     _flops.add_pad_scan(
-                        (idsc.shape[0] - n_real) * row_tile,
+                        (n_slots - n_real) * row_tile,
                         win_cols,
                         data.shape[1],
                     )
                 cand_w, cand_i = _min_out_window_merge_chunk(
                     cand_w,
                     cand_i,
-                    jnp.asarray(idsc),
-                    jnp.asarray(locs),
+                    idsc_d,
+                    locs_d,
                     geom.data_sorted,
                     core_sorted,
                     comp_sorted,
                     comp_local,
                     geom.valid_sorted,
-                    jnp.asarray(starts),
+                    starts_d,
                     _CAND_F,
                     metric,
                     geom.col_tile,
@@ -1355,11 +1412,22 @@ def boruvka_glue_edges_blockpruned(
                 dense_round = True
                 # Dense round: same result, better schedule at this density.
                 if _dense_scanner[0] is None:
-                    from hdbscan_tpu.ops.tiled import BoruvkaScanner
-
-                    _dense_scanner[0] = BoruvkaScanner(
-                        data, core, metric, pad_pow2=True, mesh=mesh
+                    from hdbscan_tpu.parallel.ring import (
+                        RingBoruvkaScanner,
+                        resolve_scan_backend,
                     )
+
+                    if resolve_scan_backend(scan_backend, mesh) == "ring":
+                        _dense_scanner[0] = RingBoruvkaScanner(
+                            data, core, metric, pad_pow2=True, mesh=mesh,
+                            trace=trace,
+                        )
+                    else:
+                        from hdbscan_tpu.ops.tiled import BoruvkaScanner
+
+                        _dense_scanner[0] = BoruvkaScanner(
+                            data, core, metric, pad_pow2=True, mesh=mesh
+                        )
                 bw, bj = _dense_scanner[0].min_outgoing(comp)
                 bestB_w = bw
                 bestB_j = bj

@@ -595,6 +595,8 @@ def boruvka_glue_edges(
     dtype=np.float32,
     max_rounds: int = 64,
     mesh=None,
+    scan_backend: str = "host",
+    trace=None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Exact inter-group MST "glue" edges — Borůvka rounds to connectivity.
 
@@ -613,9 +615,16 @@ def boruvka_glue_edges(
     ``core``: optional per-point core distances for mutual-reachability
     weights; None = plain distance (a lower bound of the MRD weight).
 
+    ``scan_backend``: "host" (this module's scanner — row shards vs a
+    replicated column set when ``mesh`` is given), "ring" (the ring-systolic
+    sharded scanner, ``parallel/ring.py`` — panels circulate via ppermute,
+    per-component winners reduce on-device), or "auto" (ring on multi-device
+    TPU meshes). Edges are bitwise identical across backends.
+
     Returns (u, v, w) in LOCAL indices of ``data``, deterministically
     tie-broken by (w, u, v).
     """
+    from hdbscan_tpu.parallel.ring import resolve_scan_backend
     from hdbscan_tpu.utils.unionfind import contract_min_edges as _contract
 
     n = len(data)
@@ -625,10 +634,18 @@ def boruvka_glue_edges(
     n_comp = int(dense.max()) + 1
     if n_comp == 1:
         return np.zeros(0, np.int64), np.zeros(0, np.int64), np.zeros(0, np.float64)
-    scanner = BoruvkaScanner(
-        data, core, metric, row_tile=row_tile, col_tile=col_tile, dtype=dtype,
-        mesh=mesh, pad_pow2=True,  # repeated per-level calls on shrinking n
-    )
+    if resolve_scan_backend(scan_backend, mesh) == "ring":
+        from hdbscan_tpu.parallel.ring import RingBoruvkaScanner
+
+        scanner = RingBoruvkaScanner(
+            data, core, metric, row_tile=row_tile, col_tile=col_tile,
+            dtype=dtype, mesh=mesh, pad_pow2=True, trace=trace,
+        )
+    else:
+        scanner = BoruvkaScanner(
+            data, core, metric, row_tile=row_tile, col_tile=col_tile,
+            dtype=dtype, mesh=mesh, pad_pow2=True,  # shrinking per-level calls
+        )
     # Seed components with the initial groups (first member = representative:
     # dense is 0..G-1, so reps[g] is group g's first point).
     order0 = np.argsort(dense, kind="stable")

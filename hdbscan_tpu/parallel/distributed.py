@@ -207,18 +207,32 @@ def hermetic_child_env(
     return env
 
 
-def free_local_port() -> int:
+def free_local_port(attempts: int = 5, backoff_s: float = 0.05) -> int:
     """An OS-assigned free TCP port for a local coordinator.
 
-    TOCTOU caveat: the port is released before the coordinator binds it —
-    callers pair this with :func:`communicate_all`'s kill-the-set timeout
-    handling so a lost race cannot leak ranks blocked on a dead port.
+    Retries with exponential backoff: under parallel test runs the kernel's
+    ephemeral range can be transiently exhausted (EADDRINUSE/EAGAIN on a
+    port-0 bind), and one losing bind should not fail a whole multi-rank
+    test. TOCTOU caveat stands regardless: the port is released before the
+    coordinator binds it — callers pair this with
+    :func:`communicate_all`'s kill-the-set timeout handling so a lost race
+    cannot leak ranks blocked on a dead port.
     """
     import socket
+    import time
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    last_err = None
+    for attempt in range(attempts):
+        try:
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+        except OSError as e:  # pragma: no cover - needs ephemeral exhaustion
+            last_err = e
+            time.sleep(backoff_s * (2**attempt))
+    raise OSError(
+        f"free_local_port: no ephemeral port after {attempts} attempts"
+    ) from last_err  # pragma: no cover
 
 
 def communicate_all(procs, timeout: int = 300):
@@ -226,7 +240,11 @@ def communicate_all(procs, timeout: int = 300):
 
     A hung rank (e.g. coordinator-port race) must not leak its peers blocked
     at a distributed barrier holding the port. Returns [(stdout, stderr)]
-    in order; re-raises TimeoutExpired after the cleanup.
+    in order. On timeout the whole set is killed and a ``TimeoutError``
+    names the dead ranks (the indices still running when the deadline hit)
+    — "rank 2 of 4 hung" debugs a coordinator race; a bare TimeoutExpired
+    does not. The original ``subprocess.TimeoutExpired`` rides as
+    ``__cause__``.
     """
     import subprocess
 
@@ -234,13 +252,19 @@ def communicate_all(procs, timeout: int = 300):
     try:
         for p in procs:
             outs.append(p.communicate(timeout=timeout))
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        dead = [i for i, p in enumerate(procs) if p.poll() is None]
         for p in procs:
             if p.poll() is None:
                 p.kill()
         for p in procs:
             p.communicate()
-        raise
+        raise TimeoutError(
+            f"communicate_all: rank(s) {dead} of {len(procs)} still running "
+            f"after {timeout}s — killed the whole set (coordinator-port race "
+            "or a rank lost mid-collective leaves peers blocked at a "
+            "barrier)"
+        ) from e
     return outs
 
 

@@ -25,6 +25,20 @@ def get_mesh(devices: list | None = None) -> Mesh:
     return Mesh(np.asarray(devices), (BATCH_AXIS,))
 
 
+def device_count(mesh: Mesh) -> int:
+    """Number of devices on the (1-D) mesh."""
+    return int(np.prod(mesh.devices.shape))
+
+
+def ring_permutation(n_dev: int) -> list[tuple[int, int]]:
+    """``lax.ppermute`` pairs for one unidirectional ring rotation step:
+    device i hands its held panel to i+1 (mod n_dev), so after s steps
+    device i holds the panel that originated at (i - s) mod n_dev. The
+    ring-systolic scans (``parallel/ring.py``) take exactly n_dev - 1 such
+    steps per sweep."""
+    return [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+
 def block_sharding(mesh: Mesh) -> NamedSharding:
     """Batch-axis sharding for (B, ...) block stacks."""
     return NamedSharding(mesh, P(BATCH_AXIS))

@@ -1,0 +1,727 @@
+"""Ring-systolic sharded scans: k-NN/core distances and Borůvka rounds (L0).
+
+The mesh path in ``ops/tiled.py`` scales out by REPLICATING the column set on
+every device — each chip scans its row shard against a full copy of the data,
+which caps the reachable n at one-device HBM and moves O(n·d) bytes per
+device up front. This module is the explicitly sharded alternative, the shape
+PANDA and the parallel-EMST literature (PAPERS.md) converge on: every device
+owns one contiguous ROW shard, and the COLUMN panels (the row shards
+themselves) circulate around a ring
+
+    dev0 ──▶ dev1 ──▶ dev2 ──▶ ... ──▶ dev(D-1)
+     ▲                                     │
+     └─────────────────────────────────────┘
+
+via ``lax.ppermute``. A full sweep is exactly ``n_dev - 1`` permute steps
+(each device sees every panel once); the permute for step ``s+1`` is issued
+BEFORE the compute on the held panel, so XLA's async collective-permute
+overlaps the neighbor exchange with the distance tiles — on TPU the panel is
+in flight on the ICI while the MXU works (guides: ring-collective pattern).
+Per-device HBM is O(n/D · d) instead of O(n · d).
+
+Bitwise parity with the host scans is a hard contract (tested on a forced
+8-device CPU mesh): the host k-NN scan's ascending tile visit + ``top_k``
+lower-index tie preference + stable merge is equivalent to selecting the k
+smallest by the LEXICOGRAPHIC key (distance, column id). Panels arrive in a
+device-dependent rotation order here, so the cross-panel merge is an EXPLICIT
+(distance, id) lexsort (:func:`_lex_merge_k`) — arrival-order independent,
+hence bitwise equal to the host path. The Borůvka carry uses the explicit
+(weight, column) tie-break for the same reason.
+
+``scan_backend={auto,host,ring}`` (``config.HDBSCANParams.scan_backend``)
+threads this engine through ``exact.fit`` and the mr-hdbscan glue/boundary
+paths exactly like ``knn_backend`` threads the Pallas kernels.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+from hdbscan_tpu.core.distances import pairwise_distance
+from hdbscan_tpu.ops.tiled import _next_pow2, _pad_rows, _round_up
+from hdbscan_tpu.parallel.mesh import (
+    BATCH_AXIS,
+    device_count,
+    get_mesh,
+    replicated,
+    ring_permutation,
+    row_sharding,
+)
+
+#: Valid ``scan_backend`` values (``config.HDBSCANParams.scan_backend``).
+SCAN_BACKENDS = ("auto", "host", "ring")
+
+
+def resolve_scan_backend(scan_backend: str, mesh) -> str:
+    """Map a ``scan_backend`` knob value to the concrete engine.
+
+    "host" and "ring" are literal. "auto" picks the ring engine only on a
+    multi-device TPU mesh — that is where panel circulation beats column
+    replication (ICI bandwidth, HBM capacity); a CPU mesh or a single chip
+    keeps the host path, so default test/CI behavior is unchanged.
+    """
+    if scan_backend not in SCAN_BACKENDS:
+        raise ValueError(
+            f"unknown scan_backend {scan_backend!r}: auto | host | ring"
+        )
+    if scan_backend != "auto":
+        return scan_backend
+    if mesh is None:
+        return "host"
+    if device_count(mesh) > 1 and mesh.devices.flat[0].platform == "tpu":
+        return "ring"
+    return "host"
+
+
+def _ring_geometry(
+    n: int, n_dev: int, row_tile: int, col_tile: int
+) -> tuple[int, int, int, int]:
+    """Clamp tiles and size the per-device row shard.
+
+    Returns ``(row_tile, col_tile, shard, n_pad)`` with ``n_pad = shard *
+    n_dev``. Both tiles are powers of two; the column tile additionally
+    clamps to (the pow2 round-up of) the per-device row count, because a
+    panel IS one row shard and the column loop tiles inside it. ``shard`` is
+    a multiple of both tiles, so every device runs identical tile shapes —
+    the precondition for bitwise distance parity with the host scan (same
+    tile shapes select the same kernel form in ``core/distances``).
+    """
+    row_tile = _next_pow2(max(8, min(row_tile, n)))
+    per_dev = -(-n // n_dev)
+    col_tile = _next_pow2(max(128, min(col_tile, n)))
+    col_tile = min(col_tile, _next_pow2(max(128, per_dev)))
+    col_tile = max(col_tile, row_tile)
+    shard = _round_up(per_dev, col_tile)
+    return row_tile, col_tile, shard, shard * n_dev
+
+
+def _lex_merge_k(best_d, best_i, tile_d, tile_i, k: int):
+    """Merge two (r, k) candidate lists into the k smallest by the explicit
+    LEXICOGRAPHIC key (distance, column id).
+
+    The host scan's stable distance-only merge equals this key because it
+    visits columns in ascending-id order; ring panels arrive in a rotation
+    order that differs per device, so the explicit secondary key is what
+    makes the result arrival-order independent (= bitwise host parity).
+    """
+    cat_d = jnp.concatenate([best_d, tile_d], axis=1)
+    cat_i = jnp.concatenate([best_i, tile_i], axis=1)
+    order = jnp.lexsort((cat_i, cat_d), axis=-1)[:, :k]
+    return (
+        jnp.take_along_axis(cat_d, order, axis=1),
+        jnp.take_along_axis(cat_i, order, axis=1),
+    )
+
+
+def _per_device_walls(out, t0: float) -> list[tuple[int, float]]:
+    """Per-device completion walls: block on each addressable output shard
+    in turn, timestamping as each lands. Single-controller approximation of
+    per-chip timelines — good enough to surface a straggler device or a
+    non-overlapped ppermute in the trace (README "Scaling out")."""
+    walls = []
+    shards = sorted(out.addressable_shards, key=lambda s: s.device.id)
+    for sh in shards:
+        jax.block_until_ready(sh.data)
+        walls.append((int(sh.device.id), time.monotonic() - t0))
+    return walls
+
+
+def _emit_ring_trace(
+    trace, stage: str, wall: float, walls, n_dev: int, rnd: int, **fields
+) -> None:
+    """One summary event (devices + ppermute_steps — the validator contract:
+    steps == devices - 1 per round) plus one per-device wall event."""
+    if trace is None:
+        return
+    trace(
+        stage,
+        wall_s=round(wall, 6),
+        devices=n_dev,
+        ppermute_steps=n_dev - 1,
+        round=rnd,
+        **fields,
+    )
+    for dev_id, w in walls:
+        trace(
+            "ring_device_wall",
+            wall_s=round(w, 6),
+            device=dev_id,
+            ring_stage=stage,
+            round=rnd,
+        )
+
+
+# --------------------------------------------------------------------------
+# Ring k-NN scan
+# --------------------------------------------------------------------------
+
+#: (mesh, k, metric, row_tile, col_tile, fused, interpret) -> compiled fn.
+_RING_KNN_CACHE: dict = {}
+
+
+def _ring_knn_fn(
+    mesh, k: int, metric: str, row_tile: int, col_tile: int,
+    fused: bool = False, interpret: bool = False,
+):
+    """Build (or fetch) the jitted shard_map ring k-NN program.
+
+    The returned fn maps ``(queries P(blocks), panels P(blocks), n P())`` to
+    ``(best_d P(blocks), best_i P(blocks))``: each device's query shard ends
+    up with its k nearest columns over the WHOLE (unpadded) column set, ids
+    global, (distance, id)-lex ascending, (+inf, -1) padded.
+    """
+    key = (mesh, k, metric, row_tile, col_tile, fused, interpret)
+    fn = _RING_KNN_CACHE.get(key)
+    if fn is not None:
+        return fn
+    n_dev = device_count(mesh)
+    perm = ring_permutation(n_dev)
+
+    def per_device(q, panel0, n_arr):
+        me = jax.lax.axis_index(BATCH_AXIS)
+        q_shard, p_shard = q.shape[0], panel0.shape[0]
+        n_row_tiles = q_shard // row_tile
+        n_col_tiles = p_shard // col_tile
+        inf = jnp.array(jnp.inf, q.dtype)
+        n_cols = n_arr.astype(jnp.int32)
+        kk = min(k, col_tile)
+        # Guard mirrors the host scan: cond-extracted selection only when a
+        # tile holds at least k candidates (host: guarded and k <= col_tile).
+        guarded = k <= col_tile
+
+        def scan_tile(xr, br, bir, panel, off, c):
+            xc = jax.lax.dynamic_slice_in_dim(panel, c * col_tile, col_tile)
+            col0 = off + c * col_tile
+            ids = col0 + jnp.arange(col_tile, dtype=jnp.int32)
+            d = pairwise_distance(xr, xc, metric)
+            d = jnp.where(ids[None, :] < n_cols, d, inf)
+
+            def merge(carry):
+                br, bir = carry
+                nv, ni = jax.lax.top_k(-d, kk)  # kk smallest, (d, id)-lex
+                td, ti = -nv, ni + col0
+                if kk < k:
+                    td = jnp.concatenate(
+                        [td, jnp.full((row_tile, k - kk), jnp.inf, d.dtype)],
+                        axis=1,
+                    )
+                    ti = jnp.concatenate(
+                        [ti, jnp.full((row_tile, k - kk), -1, jnp.int32)],
+                        axis=1,
+                    )
+                return _lex_merge_k(br, bir, td, ti, k)
+
+            if not guarded:
+                return merge((br, bir))
+            return jax.lax.cond(
+                jnp.any(d < br[:, k - 1][:, None]), merge, lambda t: t,
+                (br, bir),
+            )
+
+        if fused:  # pragma: no cover - TPU-only (interpret smoke in tests)
+            from hdbscan_tpu.ops.pallas_knn import knn_fused_pallas
+
+            def scan_panel(panel, src, best, bidx):
+                off = src * p_shard
+                xt = panel.T  # (LANES, p_shard) column operand
+                colmask = jnp.where(
+                    off + jnp.arange(p_shard, dtype=jnp.int32) < n_cols,
+                    jnp.float32(0), jnp.float32(jnp.inf),
+                )[None, :]
+                td, ti = knn_fused_pallas(
+                    q, xt, colmask, k, interpret=interpret
+                )
+                td, ti = td[:, :k], ti[:, :k]
+                ti = jnp.where(ti >= 0, ti + off, ti)
+                return _lex_merge_k(best, bidx, td, ti, k)
+
+        else:
+
+            def scan_panel(panel, src, best, bidx):
+                off = src * p_shard
+
+                def row_step(r, carry):
+                    best, bidx = carry
+                    xr = jax.lax.dynamic_slice_in_dim(q, r * row_tile, row_tile)
+                    br = jax.lax.dynamic_slice_in_dim(
+                        best, r * row_tile, row_tile
+                    )
+                    bir = jax.lax.dynamic_slice_in_dim(
+                        bidx, r * row_tile, row_tile
+                    )
+
+                    def col_step(c, carry2):
+                        return scan_tile(xr, *carry2, panel, off, c)
+
+                    br, bir = jax.lax.fori_loop(
+                        0, n_col_tiles, col_step, (br, bir)
+                    )
+                    best = jax.lax.dynamic_update_slice_in_dim(
+                        best, br, r * row_tile, axis=0
+                    )
+                    bidx = jax.lax.dynamic_update_slice_in_dim(
+                        bidx, bir, r * row_tile, axis=0
+                    )
+                    return best, bidx
+
+                return jax.lax.fori_loop(0, n_row_tiles, row_step, (best, bidx))
+
+        # Carry inits derive from the device-varying query shard so the
+        # shard_map varying-axis types match (same idiom as the mesh scan).
+        proto = jnp.broadcast_to(q[:, :1], (q_shard, k))
+        best0 = jnp.full_like(proto, jnp.inf)
+        bidx0 = jnp.full_like(proto, -1).astype(jnp.int32)
+
+        def step(s, carry):
+            panel, best, bidx = carry
+            # Issue the permute BEFORE computing on the held panel: XLA's
+            # async collective-permute overlaps the exchange with the tiles.
+            nxt = jax.lax.ppermute(panel, BATCH_AXIS, perm)
+            src = (me - s) % n_dev
+            best, bidx = scan_panel(panel, src, best, bidx)
+            return nxt, best, bidx
+
+        panel, best, bidx = jax.lax.fori_loop(
+            0, n_dev - 1, step, (panel0, best0, bidx0)
+        )
+        # Last panel: compute only — exactly n_dev - 1 ppermutes per sweep.
+        best, bidx = scan_panel(panel, (me - (n_dev - 1)) % n_dev, best, bidx)
+        return best, bidx
+
+    fn = jax.jit(
+        shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(P(BATCH_AXIS), P(BATCH_AXIS), P()),
+            out_specs=(P(BATCH_AXIS), P(BATCH_AXIS)),
+        )
+    )
+    _RING_KNN_CACHE[key] = fn
+    return fn
+
+
+def _ring_fused_eligible(
+    metric: str, k: int, dm: int, dtype, q_shard: int, p_shard: int
+) -> bool:
+    """Fused Pallas kernel reuse inside the ring step (PR-1 kernel): TPU
+    only — off-TPU the guarded-XLA tile scan is the fallback (the
+    interpreter replays every grid step through XLA-on-CPU)."""
+    from hdbscan_tpu.ops.pallas_knn import COL_TILE, ROW_TILE
+
+    return (
+        jax.devices()[0].platform == "tpu"
+        and metric == "euclidean"
+        and dtype is np.float32
+        and k <= 128
+        and dm <= 128
+        and q_shard % ROW_TILE == 0
+        and p_shard % COL_TILE == 0
+    )
+
+
+def ring_knn_core_distances(
+    data: np.ndarray,
+    min_pts: int,
+    metric: str = "euclidean",
+    k: int | None = None,
+    row_tile: int = 1024,
+    col_tile: int = 8192,
+    dtype=np.float32,
+    return_indices: bool = False,
+    fetch_knn: bool = True,
+    mesh=None,
+    trace=None,
+    knn_backend: str = "auto",
+):
+    """Ring-sharded exact core distances — the ``scan_backend="ring"`` twin
+    of :func:`ops.tiled.knn_core_distances`, bitwise identical output.
+
+    Each device holds one row shard; panels circulate (module docstring).
+    ``knn_backend`` in ("auto", "fused", "pallas") lets the per-step panel
+    scan ride the fused Pallas kernel when eligible on TPU; "xla" forces the
+    guarded tile scan everywhere. Return contract matches the host fn:
+    ``(core, knn)`` or ``(core, knn, idx)``; ``fetch_knn=False`` fetches only
+    the k-th column — ``(core, None)``.
+    """
+    n = len(data)
+    k = max(k or 0, max(min_pts - 1, 1))
+    mesh = mesh if mesh is not None else get_mesh()
+    n_dev = device_count(mesh)
+    row_tile, col_tile, shard, n_pad = _ring_geometry(n, n_dev, row_tile, col_tile)
+    data_np = np.asarray(data)
+    dm = data_np.shape[1]
+    fused = knn_backend in ("auto", "fused", "pallas") and _ring_fused_eligible(
+        metric, k, dm, dtype, shard, shard
+    )
+    data_p = _pad_rows(np.asarray(data_np, dtype), n_pad)
+    if fused:  # pragma: no cover - TPU-only
+        from hdbscan_tpu.ops.pallas_knn import LANES
+
+        lanes = np.zeros((n_pad, LANES), np.float32)
+        lanes[:, :dm] = data_p
+        data_p = lanes
+    rows = jax.device_put(data_p, row_sharding(mesh))
+    n_arr = jax.device_put(np.asarray(n, np.int32), replicated(mesh))
+    fn = _ring_knn_fn(mesh, k, metric, row_tile, col_tile, fused=fused)
+
+    from hdbscan_tpu.utils.flops import counter as _flops
+
+    _flops.add_scan(n_pad, n_pad, dm, row_tile=row_tile)
+    t0 = time.monotonic()
+    best_d, best_i = fn(rows, rows, n_arr)
+    walls = _per_device_walls(best_d, t0)
+    wall = time.monotonic() - t0
+
+    from hdbscan_tpu.parallel.mesh import fetch
+
+    kth_col = min(max(min_pts - 1, 1), n) - 1
+    fetch_knn = fetch_knn or return_indices
+    if not fetch_knn:
+        kth = np.asarray(fetch(best_d[:, kth_col]), np.float64)[:n]
+        _emit_ring_trace(
+            trace, "ring_knn_scan", wall, walls, n_dev, 0, rows=n, shard=shard
+        )
+        core = np.zeros(n, np.float64) if min_pts <= 1 else kth
+        return core, None
+    knn = np.asarray(fetch(best_d), np.float64)[:n]
+    idx = np.asarray(fetch(best_i), np.int64)[:n] if return_indices else None
+    _emit_ring_trace(
+        trace, "ring_knn_scan", wall, walls, n_dev, 0, rows=n, shard=shard
+    )
+    if min_pts <= 1:
+        core = np.zeros(n, np.float64)
+    else:
+        core = knn[:, min(min_pts - 1, n) - 1].copy()
+    if return_indices:
+        return core, knn, idx
+    return core, knn
+
+
+def ring_knn_core_distances_rows(
+    data: np.ndarray,
+    row_ids: np.ndarray,
+    min_pts: int,
+    metric: str = "euclidean",
+    row_tile: int = 1024,
+    col_tile: int = 8192,
+    dtype=np.float32,
+    mesh=None,
+    trace=None,
+) -> np.ndarray:
+    """Ring-sharded twin of :func:`ops.tiled.knn_core_distances_rows`: core
+    distances for SELECTED rows (the mr-hdbscan boundary rescan) — the m
+    query rows shard across devices, the full column set circulates as
+    panels. Returns (m,) float64 core distances aligned with ``row_ids``.
+    """
+    n = len(data)
+    m = len(row_ids)
+    if m == 0:
+        return np.zeros(0, np.float64)
+    k = max(min_pts - 1, 1)
+    mesh = mesh if mesh is not None else get_mesh()
+    n_dev = device_count(mesh)
+    row_tile, col_tile, shard, n_pad = _ring_geometry(n, n_dev, row_tile, col_tile)
+    # Queries shard independently of the column panels: pad m to a
+    # (devices x row_tile) slab.
+    q_shard = _round_up(max(-(-m // n_dev), row_tile), row_tile)
+    m_pad = q_shard * n_dev
+    data_np = np.asarray(data)
+    dm = data_np.shape[1]
+    cols = jax.device_put(
+        _pad_rows(np.asarray(data_np, dtype), n_pad), row_sharding(mesh)
+    )
+    q = jax.device_put(
+        _pad_rows(np.asarray(data_np[row_ids], dtype), m_pad), row_sharding(mesh)
+    )
+    n_arr = jax.device_put(np.asarray(n, np.int32), replicated(mesh))
+    fn = _ring_knn_fn(mesh, k, metric, row_tile, col_tile)
+
+    from hdbscan_tpu.utils.flops import counter as _flops
+
+    _flops.add_scan(m_pad, n_pad, dm, row_tile=row_tile)
+    t0 = time.monotonic()
+    best_d, _ = fn(q, cols, n_arr)
+    walls = _per_device_walls(best_d, t0)
+    wall = time.monotonic() - t0
+
+    from hdbscan_tpu.parallel.mesh import fetch
+
+    kth_col = min(max(min_pts - 1, 1), n) - 1
+    kth = np.asarray(fetch(best_d[:, kth_col]), np.float64)[:m]
+    _emit_ring_trace(
+        trace, "ring_rows_scan", wall, walls, n_dev, 0, rows=m, cols=n,
+        shard=shard,
+    )
+    if min_pts <= 1:
+        return np.zeros(m, np.float64)
+    return kth
+
+
+# --------------------------------------------------------------------------
+# Ring Borůvka scan
+# --------------------------------------------------------------------------
+
+#: (mesh, metric, row_tile, col_tile, n_comp_pad) -> compiled fn.
+_RING_BORUVKA_CACHE: dict = {}
+
+_INT_BIG = np.int32(2**31 - 1)
+
+
+def _ring_boruvka_fn(
+    mesh, metric: str, row_tile: int, col_tile: int, n_comp_pad: int
+):
+    """Build (or fetch) the jitted shard_map ring Borůvka round.
+
+    Per device: scan the local row shard against every circulating panel
+    (data + core circulate as one augmented array — one ppermute per step),
+    carrying the per-row min outgoing mutual-reachability edge with the
+    EXPLICIT (weight, column) tie-break. Then the glue reduction: a
+    ``segment_min``/``pmin`` cascade reduces per-COMPONENT winners by the
+    shared key (w, min(i,j), max(i,j)) — the exact key the host contraction
+    uses (``utils/unionfind.contract_min_edges``) — and a ``psum`` counts
+    candidates for the trace. Outputs are replicated (n_comp_pad,) arrays;
+    no O(n) result crosses the mesh.
+    """
+    key = (mesh, metric, row_tile, col_tile, n_comp_pad)
+    fn = _RING_BORUVKA_CACHE.get(key)
+    if fn is not None:
+        return fn
+    n_dev = device_count(mesh)
+    perm = ring_permutation(n_dev)
+
+    def per_device(rows_aug, panel0, comp_rep, n_arr):
+        me = jax.lax.axis_index(BATCH_AXIS)
+        shard = rows_aug.shape[0]
+        n_row_tiles = shard // row_tile
+        n_col_tiles = shard // col_tile
+        dtype = rows_aug.dtype
+        inf = jnp.array(jnp.inf, dtype)
+        n_pts = n_arr.astype(jnp.int32)
+        my_off = (me * shard).astype(jnp.int32)
+        kr_all = jax.lax.dynamic_slice_in_dim(comp_rep, my_off, shard)
+
+        def scan_panel(panel, src, bw, bj):
+            off = (src * shard).astype(jnp.int32)
+            kc_all = jax.lax.dynamic_slice_in_dim(comp_rep, off, shard)
+
+            def row_step(r, carry):
+                bw, bj = carry
+                xr = jax.lax.dynamic_slice_in_dim(
+                    rows_aug, r * row_tile, row_tile
+                )[:, :-1]
+                cr = jax.lax.dynamic_slice_in_dim(
+                    rows_aug, r * row_tile, row_tile
+                )[:, -1]
+                kr = jax.lax.dynamic_slice_in_dim(kr_all, r * row_tile, row_tile)
+                vr = (
+                    my_off + r * row_tile
+                    + jnp.arange(row_tile, dtype=jnp.int32)
+                ) < n_pts
+                bw_r = jax.lax.dynamic_slice_in_dim(bw, r * row_tile, row_tile)
+                bj_r = jax.lax.dynamic_slice_in_dim(bj, r * row_tile, row_tile)
+
+                def col_step(c, carry2):
+                    bw_r, bj_r = carry2
+                    xc = jax.lax.dynamic_slice_in_dim(
+                        panel, c * col_tile, col_tile
+                    )[:, :-1]
+                    cc = jax.lax.dynamic_slice_in_dim(
+                        panel, c * col_tile, col_tile
+                    )[:, -1]
+                    kc = jax.lax.dynamic_slice_in_dim(
+                        kc_all, c * col_tile, col_tile
+                    )
+                    col0 = off + c * col_tile
+                    vc = (
+                        col0 + jnp.arange(col_tile, dtype=jnp.int32)
+                    ) < n_pts
+                    d = pairwise_distance(xr, xc, metric)
+                    w = jnp.maximum(d, jnp.maximum(cr[:, None], cc[None, :]))
+                    out = (kr[:, None] != kc[None, :]) & vc[None, :] & vr[:, None]
+                    w = jnp.where(out, w, inf)
+                    tw = jnp.min(w, axis=1)
+                    tj = jnp.argmin(w, axis=1).astype(jnp.int32) + col0
+                    # Explicit (w, j) lex — panels arrive in rotated order,
+                    # so "first tile wins" (the host rule) must become
+                    # "lowest column id wins" to stay order-independent.
+                    upd = (tw < bw_r) | ((tw == bw_r) & (tj < bj_r))
+                    return (
+                        jnp.where(upd, tw, bw_r),
+                        jnp.where(upd, tj, bj_r),
+                    )
+
+                bw_r, bj_r = jax.lax.fori_loop(
+                    0, n_col_tiles, col_step, (bw_r, bj_r)
+                )
+                bw = jax.lax.dynamic_update_slice_in_dim(
+                    bw, bw_r, r * row_tile, axis=0
+                )
+                bj = jax.lax.dynamic_update_slice_in_dim(
+                    bj, bj_r, r * row_tile, axis=0
+                )
+                return bw, bj
+
+            return jax.lax.fori_loop(0, n_row_tiles, row_step, (bw, bj))
+
+        bw0 = jnp.full_like(rows_aug[:, -1], jnp.inf)
+        bj0 = jnp.full_like(kr_all, -1)
+
+        def step(s, carry):
+            panel, bw, bj = carry
+            nxt = jax.lax.ppermute(panel, BATCH_AXIS, perm)  # overlap: issue first
+            bw, bj = scan_panel(panel, (me - s) % n_dev, bw, bj)
+            return nxt, bw, bj
+
+        panel, bw, bj = jax.lax.fori_loop(0, n_dev - 1, step, (panel0, bw0, bj0))
+        bw, bj = scan_panel(panel, (me - (n_dev - 1)) % n_dev, bw, bj)
+
+        # Glue reduction: per-component winner by the host contraction's
+        # shared key (w, lo=min(i,j), hi=max(i,j)), as a segment_min + pmin
+        # cascade — w first, then lo among w-ties, then hi among (w, lo)-ties.
+        gid = my_off + jnp.arange(shard, dtype=jnp.int32)
+        finite = bj >= 0
+        big = jnp.int32(_INT_BIG)
+        lo = jnp.where(finite, jnp.minimum(gid, bj), big)
+        hi = jnp.where(finite, jnp.maximum(gid, bj), big)
+        wkey = jnp.where(finite, bw, inf)
+        seg = jnp.clip(kr_all, 0, n_comp_pad - 1)
+        w_c = jax.ops.segment_min(wkey, seg, num_segments=n_comp_pad)
+        w_all = jax.lax.pmin(w_c, BATCH_AXIS)
+        on_w = wkey == w_all[seg]
+        lo_c = jax.ops.segment_min(
+            jnp.where(on_w, lo, big), seg, num_segments=n_comp_pad
+        )
+        lo_all = jax.lax.pmin(lo_c, BATCH_AXIS)
+        on_lo = on_w & (lo == lo_all[seg])
+        hi_c = jax.ops.segment_min(
+            jnp.where(on_lo, hi, big), seg, num_segments=n_comp_pad
+        )
+        hi_all = jax.lax.pmin(hi_c, BATCH_AXIS)
+        n_cand = jax.lax.psum(jnp.sum(finite.astype(jnp.int32)), BATCH_AXIS)
+        return w_all, lo_all, hi_all, n_cand
+
+    fn = jax.jit(
+        shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(P(BATCH_AXIS), P(BATCH_AXIS), P(), P()),
+            out_specs=(P(), P(), P(), P()),
+        )
+    )
+    _RING_BORUVKA_CACHE[key] = fn
+    return fn
+
+
+class RingBoruvkaScanner:
+    """Ring-sharded drop-in for :class:`ops.tiled.BoruvkaScanner`.
+
+    Same ``min_outgoing(comp) -> (best_w, best_j)`` contract, same final
+    edges bitwise (see module docstring); but the point matrix shards over
+    the mesh (O(n/D·d) HBM per device) and only (n_comp,) reduced winners
+    cross back to host per round — the candidate arrays the host scanner
+    ships home stay on-device, reduced by the segment_min/pmin/psum glue.
+
+    The returned per-point arrays carry ONE candidate per component (the
+    component's winning edge, scattered onto its in-component endpoint);
+    ``contract_min_edges`` selects winners by exactly the key this reduction
+    minimizes, so the host contraction — and hence the emitted MST edges —
+    are identical to the host scanner's round for round.
+    """
+
+    def __init__(
+        self,
+        data: np.ndarray,
+        core: np.ndarray,
+        metric: str = "euclidean",
+        row_tile: int = 1024,
+        col_tile: int = 8192,
+        dtype=np.float32,
+        mesh=None,
+        pad_pow2: bool = False,
+        trace=None,
+    ):
+        n = len(data)
+        self.n = n
+        self.d = np.asarray(data).shape[1]
+        self.metric = metric
+        self.mesh = mesh if mesh is not None else get_mesh()
+        self.n_dev = device_count(self.mesh)
+        self.trace = trace
+        self.row_tile, self.col_tile, self.shard, n_pad = _ring_geometry(
+            n, self.n_dev, row_tile, col_tile
+        )
+        if pad_pow2:
+            # Shrinking per-level calls reuse compiled shapes (host scanner
+            # rationale); pow2 per-device shards keep tiles dividing evenly.
+            self.shard = _next_pow2(self.shard)
+            n_pad = self.shard * self.n_dev
+        self.n_pad = n_pad
+        aug = np.concatenate(
+            [np.asarray(data, dtype), np.asarray(core, dtype)[:, None]], axis=1
+        )
+        self._rows = jax.device_put(
+            _pad_rows(aug, n_pad), row_sharding(self.mesh)
+        )
+        self._n_arr = jax.device_put(
+            np.asarray(n, np.int32), replicated(self.mesh)
+        )
+        self._round = 0
+
+    def min_outgoing(self, comp: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """(best_w, best_j) per point — inf/-1 except each component's
+        winning outgoing edge, scattered onto its in-component endpoint."""
+        from hdbscan_tpu.utils.flops import counter as _flops
+
+        _flops.add_scan(self.n_pad, self.n_pad, self.d, row_tile=self.row_tile)
+        comp = np.asarray(comp)
+        uniq, dense = np.unique(comp, return_inverse=True)
+        n_comp = len(uniq)
+        n_comp_pad = _next_pow2(max(8, n_comp))
+        comp_rep = jax.device_put(
+            _pad_rows(dense.astype(np.int32), self.n_pad),
+            replicated(self.mesh),
+        )
+        fn = _ring_boruvka_fn(
+            self.mesh, self.metric, self.row_tile, self.col_tile, n_comp_pad
+        )
+        t0 = time.monotonic()
+        w_all, lo_all, hi_all, n_cand = fn(
+            self._rows, self._rows, comp_rep, self._n_arr
+        )
+        walls = _per_device_walls(w_all, t0)
+        wall = time.monotonic() - t0
+
+        from hdbscan_tpu.parallel.mesh import fetch
+
+        w, lo, hi, cand = fetch((w_all, lo_all, hi_all, n_cand))
+        w = np.asarray(w, np.float64)[:n_comp]
+        lo = np.asarray(lo, np.int64)[:n_comp]
+        hi = np.asarray(hi, np.int64)[:n_comp]
+        _emit_ring_trace(
+            self.trace, "ring_boruvka_scan", wall, walls, self.n_dev,
+            self._round, n_comp=n_comp, candidates=int(cand),
+        )
+        self._round += 1
+        bw = np.full(self.n, np.inf, np.float64)
+        bj = np.full(self.n, -1, np.int64)
+        fin = np.isfinite(w)
+        if fin.any():
+            lo_f, hi_f = lo[fin], hi[fin]
+            cids = np.flatnonzero(fin)
+            # The winner edge's in-component endpoint is the emitting vertex
+            # (host semantics: the vertex whose candidate won the component).
+            u = np.where(dense[lo_f] == cids, lo_f, hi_f)
+            v = lo_f + hi_f - u
+            bw[u] = w[fin]
+            bj[u] = v
+        return bw, bj

@@ -20,12 +20,28 @@ _DEFAULT_DIR = os.path.expanduser("~/.cache/hdbscan_tpu_xla")
 
 def enable_persistent_compilation_cache(path: str | None = None) -> str | None:
     """Enable jax's on-disk compile cache (idempotent). Returns the dir, or
-    None when disabled via HDBSCAN_TPU_NO_CACHE."""
+    None when disabled.
+
+    ``path`` follows the ``compile_cache`` config knob: ``"off"`` disables
+    the cache for this process (equivalent to HDBSCAN_TPU_NO_CACHE=1),
+    ``"auto"``/``None`` resolves JAX_COMPILATION_CACHE_DIR then the
+    per-user default, and anything else is taken as the cache directory
+    itself (created if missing)."""
     if os.environ.get("HDBSCAN_TPU_NO_CACHE"):
         return None
+    if path == "off":
+        return None
+    if path == "auto":
+        path = None
     import jax
 
     path = path or os.environ.get("JAX_COMPILATION_CACHE_DIR") or _DEFAULT_DIR
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
+    # jax only persists compiles slower than ~1 s by default, which silently
+    # skips every CPU-sized program (and the smaller TPU shapes) — the cache
+    # then looks enabled but never hits. Persist everything: entries are tiny
+    # and the whole point of the knob is one-time-per-machine compiles.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     return path

@@ -93,6 +93,34 @@ def compile_counter():
     return lambda: _compile_count[0]
 
 
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+
+_cache_hit_count = [0]
+_cache_hit_listener_installed = [False]
+
+
+def cache_hit_counter():
+    """A zero-arg callable returning the process-wide persistent-compile-
+    cache hit count (jax's ``/jax/compilation_cache/cache_hits`` monitoring
+    event). Pair it with :func:`compile_counter` as a :class:`Tracer`
+    counter (``{"cache_hits": ...}``) so run reports show how much of the
+    compile bill the on-disk cache absorbed: a warmed machine reports
+    ``cache_hits ~= jit_compiles`` of a cold run, while ``cache_hits == 0``
+    with a cache dir configured means the cache never matched (key drift —
+    jaxlib/flag change). Install-once semantics match
+    :func:`compile_counter` (jax exposes no unregister)."""
+    if not _cache_hit_listener_installed[0]:
+        import jax.monitoring
+
+        def _on_event(name, **kw):
+            if name == _CACHE_HIT_EVENT:
+                _cache_hit_count[0] += 1
+
+        jax.monitoring.register_event_listener(_on_event)
+        _cache_hit_listener_installed[0] = True
+    return lambda: _cache_hit_count[0]
+
+
 # --------------------------------------------------------------------------
 # Manifest: what did this run resolve to
 # --------------------------------------------------------------------------
@@ -149,6 +177,7 @@ def run_manifest(params=None, argv=None, extra: dict | None = None) -> dict:
         "backends": {
             "default_backend": jax.default_backend(),
             "knn_backend": getattr(params, "knn_backend", None),
+            "scan_backend": getattr(params, "scan_backend", None),
         },
         "topology": device_topology(),
         "env": env_overrides(),
@@ -206,7 +235,7 @@ def sample_device_memory() -> dict:
 
 #: Event fields summed into the per-phase aggregates (the analytic figures
 #: ``utils/flops.phase_stats`` attaches, plus the compile counter field).
-_SUMMED_FIELDS = ("gflops", "gbytes", "pad_gflops", "jit_compiles")
+_SUMMED_FIELDS = ("gflops", "gbytes", "pad_gflops", "jit_compiles", "cache_hits")
 
 
 def phase_aggregates(events) -> dict:
@@ -239,6 +268,8 @@ def phase_aggregates(events) -> dict:
             row["mfu"] = round(gf * 1e9 / row["wall_s"] / flops.PEAK_FLOPS, 6)
         if "jit_compiles" in row:
             row["jit_compiles"] = int(row["jit_compiles"])
+        if "cache_hits" in row:
+            row["cache_hits"] = int(row["cache_hits"])
     # Expensive phases first, matching Tracer.summary().
     return dict(sorted(agg.items(), key=lambda kv: -kv[1]["wall_s"]))
 

@@ -8,7 +8,12 @@ Usage::
 Checks every JSONL line against the trace schema contract
 (``utils/tracing.TRACE_SCHEMA``): parses as JSON, carries a matching
 ``schema`` tag, a string ``stage`` and a finite numeric ``wall_s``, and
-``seq`` strictly increases per process. Given a report
+``seq`` strictly increases per process. Ring-scan events
+(``parallel/ring.py``, README "Scaling out") add two invariants: any event
+carrying ``devices`` + ``ppermute_steps`` must satisfy
+``ppermute_steps == devices - 1`` (one full panel rotation per round), and
+per-device wall events (integer ``device`` field) must keep ``seq``
+strictly increasing per (process, device). Given a report
 (``utils/telemetry.REPORT_SCHEMA``), additionally cross-checks that the
 report's per-phase wall totals equal the trace's per-stage wall sums within
 1e-6 — the round-trip guarantee the tier-1 e2e test pins.
@@ -41,6 +46,7 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
     events: list[dict] = []
     errors: list[str] = []
     last_seq: dict = {}  # per-process strictly-increasing seq check
+    last_dev_seq: dict = {}  # per-(process, device) seq for ring wall events
     with open(path, encoding="utf-8") as f:
         for lineno, line in enumerate(f, 1):
             line = line.strip()
@@ -79,6 +85,28 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
                         f"{path}:{lineno}: seq {seq} not increasing (prev {prev})"
                     )
                 last_seq[proc] = seq
+            # Ring-scan invariants (parallel/ring.py). Summary events carry
+            # devices + ppermute_steps: one full panel rotation is exactly
+            # devices - 1 permutes (the final panel is scanned in place).
+            devices = ev.get("devices")
+            steps = ev.get("ppermute_steps")
+            if isinstance(devices, int) and steps is not None:
+                if not isinstance(steps, int) or steps != devices - 1:
+                    errors.append(
+                        f"{path}:{lineno}: ppermute_steps={steps!r} != "
+                        f"devices - 1 ({devices} devices)"
+                    )
+            # Per-device wall events: each device's timeline must be ordered.
+            device = ev.get("device")
+            if isinstance(device, int) and isinstance(seq, int):
+                key = (proc, device)
+                prev = last_dev_seq.get(key)
+                if prev is not None and seq <= prev:
+                    errors.append(
+                        f"{path}:{lineno}: device {device} seq {seq} not "
+                        f"increasing (prev {prev})"
+                    )
+                last_dev_seq[key] = seq
     return events, errors
 
 

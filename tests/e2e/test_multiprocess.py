@@ -37,6 +37,25 @@ def _child_env(n_local_devices: int) -> dict:
     return hermetic_child_env(n_local_devices, repo_root=REPO)
 
 
+_BACKEND_UNAVAILABLE = (
+    "Multiprocess computations aren't implemented on the CPU backend"
+)
+
+
+def _skip_if_collectives_unavailable(procs, outs):
+    """Multi-controller CPU collectives are a jaxlib build capability, not a
+    code path this repo controls: some jaxlib builds reject ANY multiprocess
+    computation on the CPU backend at the first device_put. When a rank died
+    with that exact error the environment — not the pipeline — failed, so
+    skip with the rank's own words instead of reporting a red herring."""
+    for i, (p, (_so, se)) in enumerate(zip(procs, outs)):
+        if p.returncode != 0 and _BACKEND_UNAVAILABLE in (se or ""):
+            pytest.skip(
+                "multi-controller collectives unavailable in this jaxlib "
+                f"build (rank {i} stderr: {_BACKEND_UNAVAILABLE!r})"
+            )
+
+
 def _run_cli(args: list[str], n_local_devices: int, timeout: int = 300):
     return subprocess.run(
         [sys.executable, "-m", "hdbscan_tpu", *args],
@@ -95,6 +114,7 @@ class TestMultiProcess:
             for pid in (0, 1)
         ]
         outs = _communicate_all(procs)
+        _skip_if_collectives_unavailable(procs, outs)
         for p, (so, se) in zip(procs, outs):
             assert p.returncode == 0, f"rank failed:\n{se[-2000:]}"
         # Only process 0 writes/prints (rank 1's stdout may carry Gloo
@@ -150,6 +170,7 @@ class TestMultiProcess:
             for pid in range(4)
         ]
         outs = _communicate_all(procs)
+        _skip_if_collectives_unavailable(procs, outs)
         for p, (so, se) in zip(procs, outs):
             assert p.returncode == 0, f"rank failed:\n{se[-2000:]}"
         assert "4 processes" in outs[0][1] and "8 devices" in outs[0][1]
@@ -214,6 +235,7 @@ print("RANK_OK", pid)
             for pid in (0, 1)
         ]
         res = _communicate_all(procs)
+        _skip_if_collectives_unavailable(procs, res)
         for p, (so, se) in zip(procs, res):
             assert p.returncode == 0, se[-2000:]
             assert "RANK_OK" in so

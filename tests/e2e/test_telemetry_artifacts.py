@@ -98,6 +98,52 @@ class TestTelemetryCLI:
         _, errors = check_trace.validate_report(report, trace_events=events)
         assert errors == [], errors
 
+    def test_ring_scan_roundtrip(self, tmp_path):
+        """Forced-8-device CPU ring run (``scan_backend=ring``): ring scan
+        events land in the trace, satisfy the validator's ring invariants
+        (``ppermute_steps == devices - 1`` per round, per-device ``seq``
+        monotonic), and the report round-trips with the backend recorded."""
+        import jax
+
+        if len(jax.devices()) < 2:
+            pytest.skip("ring scan needs a multi-device mesh")
+        dataset, n = _write_blobs(tmp_path, n_per=80)
+        trace = str(tmp_path / "trace.jsonl")
+        report = str(tmp_path / "report.json")
+        rc = main(
+            [
+                f"file={dataset}",
+                "minPts=4",
+                "minClSize=20",
+                "processing_units=60",
+                "k=0.3",
+                "seed=1",
+                "scan_backend=ring",
+                f"out_dir={tmp_path / 'out'}",
+                "--trace-out",
+                trace,
+                "--report",
+                report,
+            ]
+        )
+        assert rc == 0
+        events, errors = check_trace.validate_trace(trace)
+        assert errors == [], errors
+        stages = {e["stage"] for e in events}
+        assert "ring_device_wall" in stages
+        summaries = [e for e in events if "ppermute_steps" in e]
+        assert summaries, "ring run must emit ring summary events"
+        n_dev = len(jax.devices())
+        for ev in summaries:
+            assert ev["devices"] == n_dev
+            assert ev["ppermute_steps"] == n_dev - 1
+        # Every summary is mirrored by one wall event per device.
+        walls = [e for e in events if e["stage"] == "ring_device_wall"]
+        assert len(walls) == len(summaries) * n_dev
+        rep, errors = check_trace.validate_report(report, trace_events=events)
+        assert errors == [], errors
+        assert rep["manifest"]["backends"]["scan_backend"] == "ring"
+
     def test_no_flags_no_artifacts(self, tmp_path):
         """Both flags absent: the run creates the five canonical outputs and
         NOTHING else — no trace, no report, no stray telemetry files."""

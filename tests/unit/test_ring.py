@@ -1,0 +1,224 @@
+"""Ring-sharded scan engine parity (parallel/ring.py).
+
+The contract under test is BITWISE: the ring path exists to scale the scan
+over devices, not to change a single bit of output — `exact.fit` and the
+mr-hdbscan boundary rescan must produce byte-identical artifacts whichever
+``scan_backend`` ran. The forced-8-device CPU mesh (conftest) exercises the
+full ppermute rotation, uneven row shards, and the cross-panel lex merge
+with identical tile shapes on both paths (row_tile=64, col_tile=128 keeps
+the host and ring per-tile kernels — and therefore their float32 distance
+bits — the same).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from hdbscan_tpu.ops.tiled import (
+    BoruvkaScanner,
+    boruvka_glue_edges,
+    knn_core_distances,
+    knn_core_distances_rows,
+)
+from hdbscan_tpu.parallel.mesh import get_mesh
+from hdbscan_tpu.parallel.ring import (
+    RingBoruvkaScanner,
+    resolve_scan_backend,
+    ring_knn_core_distances,
+    ring_knn_core_distances_rows,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="ring scan needs a multi-device mesh"
+)
+
+TILES = dict(row_tile=64, col_tile=128)
+
+
+def _blobs(n, d=5, seed=0, quantize=None):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=6.0, size=(4, d))
+    pts = np.concatenate(
+        [rng.normal(c, 0.8, size=(n // 4, d)) for c in centers]
+        + [rng.normal(size=(n - 4 * (n // 4), d))]
+    )
+    if quantize is not None:
+        pts = np.round(pts, quantize)  # tie-heavy: exercises lex tie-breaks
+    return pts.astype(np.float64)
+
+
+class TestResolveScanBackend:
+    def test_literal_values_pass_through(self):
+        mesh = get_mesh()
+        assert resolve_scan_backend("host", mesh) == "host"
+        assert resolve_scan_backend("ring", mesh) == "ring"
+
+    def test_auto_is_host_on_cpu_mesh(self):
+        # auto only opts into the ring on a multi-device TPU mesh; the
+        # forced-CPU test mesh must keep existing paths on host.
+        assert resolve_scan_backend("auto", get_mesh()) == "host"
+
+    def test_unknown_backend_rejected(self):
+        with pytest.raises(ValueError):
+            resolve_scan_backend("warp", get_mesh())
+
+
+class TestRingKnnParity:
+    def test_bitwise_parity_with_indices(self):
+        data = _blobs(700)
+        mesh = get_mesh()
+        hc, hk, hi = knn_core_distances(
+            data, 11, "euclidean", backend="xla", return_indices=True, **TILES
+        )
+        rc, rk, ri = ring_knn_core_distances(
+            data, 11, "euclidean", return_indices=True, mesh=mesh, **TILES
+        )
+        assert np.array_equal(hc, rc)
+        assert np.array_equal(hk, rk)
+        assert np.array_equal(hi, ri)  # indices too: lex (d, id) order
+
+    def test_uneven_row_shards(self):
+        # 530 rows over 8 devices: shards pad unevenly; pad rows must never
+        # leak into real rows' neighbor lists.
+        data = _blobs(530, seed=3)
+        mesh = get_mesh()
+        hc, hk = knn_core_distances(data, 7, "euclidean", backend="xla", **TILES)
+        rc, rk = ring_knn_core_distances(data, 7, "euclidean", mesh=mesh, **TILES)
+        assert np.array_equal(hc, rc)
+        assert np.array_equal(hk, rk)
+
+    def test_k_larger_than_col_tile(self):
+        # k=150 > col_tile=128: per-tile top_k clamps to the tile width and
+        # the cross-tile lex merge must still assemble the exact global k.
+        data = _blobs(900, seed=5)
+        mesh = get_mesh()
+        hc, hk, hi = knn_core_distances(
+            data, 11, "euclidean", k=150, backend="xla", return_indices=True,
+            **TILES,
+        )
+        rc, rk, ri = ring_knn_core_distances(
+            data, 11, "euclidean", k=150, return_indices=True, mesh=mesh,
+            **TILES,
+        )
+        assert np.array_equal(hc, rc)
+        assert np.array_equal(hk, rk)
+        assert np.array_equal(hi, ri)
+
+    def test_fetch_kth_only(self):
+        data = _blobs(300, seed=8)
+        mesh = get_mesh()
+        hc, _ = knn_core_distances(
+            data, 9, "euclidean", backend="xla", fetch_knn=False, **TILES
+        )
+        rc, rknn = ring_knn_core_distances(
+            data, 9, "euclidean", fetch_knn=False, mesh=mesh, **TILES
+        )
+        assert rknn is None
+        assert np.array_equal(hc, rc)
+
+    def test_rows_scan_parity(self):
+        # The mr-hdbscan boundary rescan path: selected query rows against
+        # the full column set.
+        data = _blobs(640, seed=13)
+        rng = np.random.default_rng(1)
+        rows = np.sort(rng.choice(len(data), size=117, replace=False))
+        host = knn_core_distances_rows(data, rows, 9, "euclidean", **TILES)
+        ring = ring_knn_core_distances_rows(
+            data, rows, 9, "euclidean", mesh=get_mesh(), **TILES
+        )
+        assert np.array_equal(host, ring)
+
+
+class TestRingBoruvkaParity:
+    def test_min_outgoing_bitwise(self):
+        data = _blobs(520, seed=21, quantize=1)
+        core, _ = knn_core_distances(
+            data, 5, "euclidean", backend="xla", fetch_knn=False, **TILES
+        )
+        comp = np.arange(len(data)) % 13  # many components, shared mins
+        host = BoruvkaScanner(data, core, "euclidean", **TILES)
+        ring = RingBoruvkaScanner(
+            data, core, "euclidean", mesh=get_mesh(), **TILES
+        )
+        hw, hj = host.min_outgoing(comp)
+        rw, rj = ring.min_outgoing(comp)
+        # Weights match bitwise; winners match WHERE a component elects its
+        # edge (the host scanner reports the per-row minimum for every row,
+        # the ring reports each component's elected (w, lo, hi) winner
+        # scattered to its in-component endpoint — contract_min_edges
+        # consumes only the elected winners).
+        fin = rj >= 0
+        assert np.array_equal(hw[fin], rw[fin])
+        assert np.array_equal(hj[fin], rj[fin])
+        # Every component with any outgoing host edge elected a ring winner.
+        hosted = np.unique(comp[np.isfinite(hw)])
+        elected = np.unique(comp[fin])
+        assert np.array_equal(hosted, elected)
+
+    def test_glue_edges_bitwise(self):
+        data = _blobs(520, seed=21, quantize=1)
+        core, _ = knn_core_distances(
+            data, 5, "euclidean", backend="xla", fetch_knn=False, **TILES
+        )
+        groups = np.arange(len(data)) % 7
+        hu, hv, hw = boruvka_glue_edges(
+            data, groups, "euclidean", core=core, scan_backend="host", **TILES
+        )
+        ru, rv, rw = boruvka_glue_edges(
+            data, groups, "euclidean", core=core, scan_backend="ring",
+            mesh=get_mesh(), **TILES,
+        )
+        assert np.array_equal(hu, ru)
+        assert np.array_equal(hv, rv)
+        assert np.array_equal(hw, rw)
+
+
+class TestRingEndToEnd:
+    def test_exact_fit_parity(self):
+        from hdbscan_tpu.config import HDBSCANParams
+        from hdbscan_tpu.models import exact
+
+        data = _blobs(600, seed=33)
+        mesh = get_mesh()
+        base = HDBSCANParams(
+            min_points=6, min_cluster_size=30, scan_backend="host"
+        )
+        r_host = exact.fit(data, base, mesh=mesh, **TILES)
+        r_ring = exact.fit(
+            data, base.replace(scan_backend="ring"), mesh=mesh, **TILES
+        )
+        assert np.array_equal(r_host.labels, r_ring.labels)
+        assert np.array_equal(r_host.outlier_scores, r_ring.outlier_scores)
+
+    def test_mst_edges_parity(self):
+        from hdbscan_tpu.models import exact
+
+        data = _blobs(480, seed=41, quantize=1)
+        mesh = get_mesh()
+        host = exact.mst_edges(
+            data, 6, "euclidean", mesh=mesh, scan_backend="host", **TILES
+        )
+        ring = exact.mst_edges(
+            data, 6, "euclidean", mesh=mesh, scan_backend="ring", **TILES
+        )
+        for h, r in zip(host, ring):
+            assert np.array_equal(h, r)
+
+    def test_ring_trace_events(self):
+        from hdbscan_tpu.utils.tracing import Tracer
+
+        data = _blobs(300, seed=50)
+        mesh = get_mesh()
+        n_dev = int(np.prod(mesh.devices.shape))
+        tracer = Tracer()
+        ring_knn_core_distances(
+            data, 7, "euclidean", fetch_knn=False, mesh=mesh, trace=tracer,
+            **TILES,
+        )
+        scans = [e for e in tracer.events if e.name == "ring_knn_scan"]
+        assert len(scans) == 1
+        assert scans[0].fields["ppermute_steps"] == n_dev - 1
+        assert scans[0].fields["devices"] == n_dev
+        walls = [e for e in tracer.events if e.name == "ring_device_wall"]
+        assert sorted(e.fields["device"] for e in walls) == list(range(n_dev))

@@ -239,6 +239,45 @@ class TestCheckTraceScript:
         assert len(errors) >= 3
         assert check_trace.main([str(bad)]) == 1
 
+    def test_ring_invariants(self, tmp_path):
+        """Ring events (parallel/ring.py): one full panel rotation is
+        exactly devices - 1 permutes, and each device's wall events keep a
+        monotonic seq — a dropped permute or reordered timeline must fail
+        the validator."""
+        from scripts import check_trace
+
+        good = tmp_path / "ring_ok.jsonl"
+        lines = [
+            {"schema": TRACE_SCHEMA, "stage": "ring_knn_scan", "wall_s": 0.5,
+             "devices": 8, "ppermute_steps": 7, "seq": 1, "process": 0},
+            {"schema": TRACE_SCHEMA, "stage": "ring_device_wall",
+             "wall_s": 0.1, "device": 0, "seq": 2, "process": 0},
+            {"schema": TRACE_SCHEMA, "stage": "ring_device_wall",
+             "wall_s": 0.2, "device": 1, "seq": 3, "process": 0},
+            {"schema": TRACE_SCHEMA, "stage": "ring_device_wall",
+             "wall_s": 0.3, "device": 0, "seq": 4, "process": 0},
+        ]
+        good.write_text("".join(json.dumps(e) + "\n" for e in lines))
+        _, errors = check_trace.validate_trace(str(good))
+        assert errors == []
+
+        bad = tmp_path / "ring_bad.jsonl"
+        lines = [
+            # A dropped permute: 8 devices but only 6 steps.
+            {"schema": TRACE_SCHEMA, "stage": "ring_knn_scan", "wall_s": 0.5,
+             "devices": 8, "ppermute_steps": 6, "seq": 1, "process": 0},
+            # Device 0's timeline goes backwards.
+            {"schema": TRACE_SCHEMA, "stage": "ring_device_wall",
+             "wall_s": 0.1, "device": 0, "seq": 5, "process": 0},
+            {"schema": TRACE_SCHEMA, "stage": "ring_device_wall",
+             "wall_s": 0.1, "device": 0, "seq": 4, "process": 0},
+        ]
+        bad.write_text("".join(json.dumps(e) + "\n" for e in lines))
+        _, errors = check_trace.validate_trace(str(bad))
+        assert any("ppermute_steps" in e for e in errors)
+        assert any("device 0 seq" in e for e in errors)
+        assert check_trace.main([str(bad)]) == 1
+
     def test_wall_mismatch_detected(self, tmp_path):
         from scripts import check_trace
 
