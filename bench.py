@@ -7,8 +7,12 @@ Telemetry: ``--trace-out PATH`` / ``--report PATH`` (same contract as the
 CLI, README "Observability") persist every pipeline stage event across the
 warm+timed runs as JSONL and write a run-report JSON with the manifest,
 per-phase aggregates, device memory samples and per-phase compile counts.
-Flags absent = no telemetry I/O, fit calls get ``trace=None`` exactly as
-before.
+Flags absent = no telemetry file I/O: fit calls get a collect-only in-memory
+tracer (no sinks), which the bench itself needs to report ``tree_wall_s`` —
+the host finalize wall (merge forest + condense + extract, the ``tree_*``
+stages of README "Finalize pipeline") of each leg's final timed run, as
+top-level JSON fields so the BENCH trajectory tracks finalize wall
+separately from scan wall.
 
 Headline metric (BASELINE.md north star: "cluster Skin_NonSkin end-to-end on
 a single TPU slice faster than the 8-worker MapReduce CPU baseline with an
@@ -61,24 +65,26 @@ def main(argv: list[str] | None = None) -> None:
     if argv:
         raise SystemExit(f"bench.py: unknown arguments {argv!r}")
 
-    tracer = None
+    # The tracer is always on (collect-only without flags: no sinks = no
+    # file I/O) — the per-leg tree_wall_s fields read the tree_* stage
+    # events finalize emits.
+    from hdbscan_tpu.utils.tracing import JsonlSink, Tracer
+
     mem_start = None
+    counters = None
+    sinks = []
     if trace_out is not None or report_out is not None:
         from hdbscan_tpu.utils import telemetry
-        from hdbscan_tpu.utils.tracing import JsonlSink, Tracer
 
-        sinks = []
+        counters = {
+            "jit_compiles": telemetry.compile_counter(),
+            "cache_hits": telemetry.cache_hit_counter(),
+        }
         if trace_out is not None:
             sinks.append(JsonlSink(trace_out, static={"bench": True}))
-        tracer = Tracer(
-            sinks=sinks,
-            counters={
-                "jit_compiles": telemetry.compile_counter(),
-                "cache_hits": telemetry.cache_hit_counter(),
-            },
-        )
         if report_out is not None:
             mem_start = telemetry.sample_device_memory()
+    tracer = Tracer(sinks=sinks, counters=counters)
 
     # Persistent XLA cache (r5): compiles are a one-time per-machine cost,
     # as in any production JAX deployment; the in-process median-of-3
@@ -107,29 +113,37 @@ def main(argv: list[str] | None = None) -> None:
         """Median-of-``n_runs`` walls (VERDICT r3 item 5: the tunneled host
         shows up to ~4x run-to-run variance on transfer-bound phases, so a
         single-shot wall is host luck). Returns (median, spread, result,
-        stats) — stats are FLOP/byte figures of the LAST run alone, so the
-        published absolute work matches one run, not the sum of three."""
+        stats, tree_wall) — stats are FLOP/byte figures of the LAST run
+        alone, so the published absolute work matches one run, not the sum
+        of three; tree_wall likewise sums the last run's ``tree_*`` stage
+        walls (host finalize: merge forest + condense + extract)."""
         walls = []
         r = None
         fsnap = None
+        esnap = 0
         for i in range(n_runs):
             if i == n_runs - 1:
                 fsnap = flops_counter.snapshot()
+                esnap = len(tracer.events)
             t0 = time.monotonic()
             r = fit_fn()
             walls.append(time.monotonic() - t0)
         stats = phase_stats(fsnap, walls[-1])
+        tree_wall = sum(
+            ev.wall_s
+            for ev in tracer.events[esnap:]
+            if ev.name.startswith("tree_")
+        )
         walls.sort()
         med = walls[len(walls) // 2] if n_runs % 2 else sum(
             walls[n_runs // 2 - 1 : n_runs // 2 + 1]
         ) / 2
-        return med, (walls[0], walls[-1]), r, stats
+        return med, (walls[0], walls[-1]), r, stats, tree_wall
 
     def run_exact(params, tag):
-        if tracer is not None:
-            tracer("bench_leg", leg=f"exact/{tag}")
+        tracer("bench_leg", leg=f"exact/{tag}")
         exact.fit(data, params, mesh=mesh, trace=tracer)  # warm XLA compiles
-        wall, (lo, hi), r, stats = timed_runs(
+        wall, (lo, hi), r, stats, tree_wall = timed_runs(
             lambda: exact.fit(data, params, mesh=mesh, trace=tracer)
         )
         a = ari(r.labels)
@@ -137,19 +151,19 @@ def main(argv: list[str] | None = None) -> None:
             f"[bench] exact/{tag}: n={len(data)} wall={wall:.2f}s "
             f"[{lo:.2f}, {hi:.2f}] ARI={a:.4f} "
             f"clusters={len(set(r.labels[r.labels > 0].tolist()))} "
-            f"noise={int((r.labels == 0).sum())} "
+            f"noise={int((r.labels == 0).sum())} tree={tree_wall:.2f}s "
             f"(reference RB {RB_BASELINE_S}s, DB {DB_BASELINE_S}s)",
             file=sys.stderr,
         )
-        return wall, (lo, hi), a, stats
+        return wall, (lo, hi), a, stats, tree_wall
 
     # --- exact path, literal config (headline) -----------------------------
-    lit_wall, lit_spread, lit_ari, lit_stats = run_exact(
+    lit_wall, lit_spread, lit_ari, lit_stats, lit_tree = run_exact(
         HDBSCANParams(min_points=LIT_MIN_PTS, min_cluster_size=MIN_CL_SIZE),
         "literal",
     )
     # --- exact path, calibrated config (secondary) -------------------------
-    cal_wall, cal_spread, cal_ari, _ = run_exact(
+    cal_wall, cal_spread, cal_ari, _, cal_tree = run_exact(
         HDBSCANParams(
             min_points=CAL_MIN_PTS, min_cluster_size=MIN_CL_SIZE, dedup_points=True
         ),
@@ -166,7 +180,7 @@ def main(argv: list[str] | None = None) -> None:
     # chips and no 1-chip regression vs the host path.
     ring_fields = {}
     if mesh is not None:
-        ring_wall, ring_spread, ring_ari, _ = run_exact(
+        ring_wall, ring_spread, ring_ari, _, ring_tree = run_exact(
             HDBSCANParams(
                 min_points=LIT_MIN_PTS,
                 min_cluster_size=MIN_CL_SIZE,
@@ -183,6 +197,7 @@ def main(argv: list[str] | None = None) -> None:
             "ring_e2e_vs_baseline": round(RB_BASELINE_S / ring_wall, 3),
             "ring_e2e_vs_host": round(lit_wall / ring_wall, 3),
             "ring_e2e_ari": round(ring_ari, 4),
+            "ring_e2e_tree_wall_s": round(ring_tree, 3),
             "ring_e2e_devices": int(np.prod(mesh.devices.shape)),
             "ring_e2e_platform": jax.devices()[0].platform,
             "ring_e2e_cpu_smoke": jax.devices()[0].platform != "tpu",
@@ -203,10 +218,9 @@ def main(argv: list[str] | None = None) -> None:
         seed=0,
         dedup_points=True,
     )
-    if tracer is not None:
-        tracer("bench_leg", leg="mr-db")
+    tracer("bench_leg", leg="mr-db")
     mr_hdbscan.fit(data, mr_params, mesh=mesh, trace=tracer)  # warm full-shape compiles
-    mr_wall, mr_spread, r_mr, _ = timed_runs(
+    mr_wall, mr_spread, r_mr, _, mr_tree = timed_runs(
         lambda: mr_hdbscan.fit(data, mr_params, mesh=mesh, trace=tracer)
     )
     mr_ari = ari(r_mr.labels)
@@ -215,7 +229,7 @@ def main(argv: list[str] | None = None) -> None:
         f"ARI={mr_ari:.4f} levels={r_mr.n_levels} "
         f"edges={r_mr.n_edges} "
         f"clusters={len(set(r_mr.labels[r_mr.labels > 0].tolist()))} "
-        f"noise={int((r_mr.labels == 0).sum())}",
+        f"noise={int((r_mr.labels == 0).sum())} tree={mr_tree:.2f}s",
         file=sys.stderr,
     )
     for ls in r_mr.levels:
@@ -233,10 +247,9 @@ def main(argv: list[str] | None = None) -> None:
     # seed_sweep45_skin_r5.jsonl). Reported as its own leg so the mr-db
     # primary fields stay round-comparable.
     flat_params = mr_params.replace(refine_flat_iterations=8)
-    if tracer is not None:
-        tracer("bench_leg", leg="mr-db-flat")
+    tracer("bench_leg", leg="mr-db-flat")
     mr_hdbscan.fit(data, flat_params, mesh=mesh, trace=tracer)  # warm
-    fl_wall, fl_spread, r_fl, _ = timed_runs(
+    fl_wall, fl_spread, r_fl, _, fl_tree = timed_runs(
         lambda: mr_hdbscan.fit(data, flat_params, mesh=mesh, trace=tracer)
     )
     fl_ari = ari(r_fl.labels)
@@ -244,7 +257,7 @@ def main(argv: list[str] | None = None) -> None:
         f"[bench] mr-db-flat: wall={fl_wall:.2f}s "
         f"[{fl_spread[0]:.2f}, {fl_spread[1]:.2f}] ARI={fl_ari:.4f} "
         f"clusters={len(set(r_fl.labels[r_fl.labels > 0].tolist()))} "
-        f"noise={int((r_fl.labels == 0).sum())}",
+        f"noise={int((r_fl.labels == 0).sum())} tree={fl_tree:.2f}s",
         file=sys.stderr,
     )
 
@@ -262,8 +275,13 @@ def main(argv: list[str] | None = None) -> None:
                 "spread_s": [round(lit_spread[0], 3), round(lit_spread[1], 3)],
                 "ari": round(lit_ari, 4),
                 "min_pts": LIT_MIN_PTS,
+                # Host finalize wall (merge forest + condense + extract),
+                # summed from the leg's tree_* trace events (README
+                # "Finalize pipeline").
+                "tree_wall_s": round(lit_tree, 3),
                 **{f"literal_{k}": v for k, v in lit_stats.items()},
                 "calibrated_wall_s": round(cal_wall, 3),
+                "calibrated_tree_wall_s": round(cal_tree, 3),
                 "calibrated_spread_s": [
                     round(cal_spread[0], 3),
                     round(cal_spread[1], 3),
@@ -277,6 +295,7 @@ def main(argv: list[str] | None = None) -> None:
                 ],
                 "db_pipeline_vs_baseline": round(DB_BASELINE_S / mr_wall, 3),
                 "db_pipeline_ari": round(mr_ari, 4),
+                "db_pipeline_tree_wall_s": round(mr_tree, 3),
                 "db_flat_wall_s": round(fl_wall, 3),
                 "db_flat_spread_s": [
                     round(fl_spread[0], 3),
@@ -284,39 +303,39 @@ def main(argv: list[str] | None = None) -> None:
                 ],
                 "db_flat_vs_baseline": round(DB_BASELINE_S / fl_wall, 3),
                 "db_flat_ari": round(fl_ari, 4),
+                "db_flat_tree_wall_s": round(fl_tree, 3),
                 **ring_fields,
             }
         )
     )
 
-    if tracer is not None:
+    tracer.close()
+    if report_out is not None:
         from hdbscan_tpu.utils import telemetry
 
-        tracer.close()
-        if report_out is not None:
-            telemetry.write_report(
-                report_out,
-                telemetry.build_report(
-                    tracer,
-                    manifest=telemetry.run_manifest(
-                        None,
-                        argv=argv_full,
-                        extra={
-                            "entrypoint": "bench.py",
-                            "dataset": SKIN_PATH,
-                            "compile_cache": {
-                                "setting": compile_cache,
-                                "jit_compiles": telemetry.compile_counter()(),
-                                "cache_hits": telemetry.cache_hit_counter()(),
-                            },
+        telemetry.write_report(
+            report_out,
+            telemetry.build_report(
+                tracer,
+                manifest=telemetry.run_manifest(
+                    None,
+                    argv=argv_full,
+                    extra={
+                        "entrypoint": "bench.py",
+                        "dataset": SKIN_PATH,
+                        "compile_cache": {
+                            "setting": compile_cache,
+                            "jit_compiles": telemetry.compile_counter()(),
+                            "cache_hits": telemetry.cache_hit_counter()(),
                         },
-                    ),
-                    memory={
-                        "start": mem_start,
-                        "end": telemetry.sample_device_memory(),
                     },
                 ),
-            )
+                memory={
+                    "start": mem_start,
+                    "end": telemetry.sample_device_memory(),
+                },
+            ),
+        )
 
 
 if __name__ == "__main__":

@@ -29,6 +29,11 @@ dispatch/transfer-bound, kernels are not worth optimizing" (ROADMAP r4 item
   INTERPRETER (orders of magnitude slower than compiled XLA), so they are
   gated to small ``--n`` smoke rows there; interpreter rates validate the
   wiring, not TPU throughput.
+- ``finalize_reference`` / ``finalize_vectorized``: the host condensed-tree
+  engines (``core/tree.py`` vs ``core/tree_vec.py``, README "Finalize
+  pipeline") on the same Skin-shaped merge forest — condense + EOM
+  propagate + flat labels, bitwise-checked. Host-only leg (no device);
+  the ``vs_reference`` ratio is the tree_backend acceptance figure.
 - ``ring_scan`` / ``ring_e2e``: the ring-sharded scan engine
   (``parallel/ring.py``, README "Scaling out") vs the host path on the same
   rows — raw scan and ``exact.fit`` end-to-end. TPU targets: >= 0.8x linear
@@ -492,11 +497,102 @@ def bench_ring_scan(out_path, n=100_000, d=8, min_pts=16, iters=3, seed=0):
     ))
 
 
+def bench_finalize(out_path, n=245_057, iters=3, seed=0, min_cluster_size=3000):
+    """Host finalize engines head-to-head (README "Finalize pipeline").
+
+    ``core/tree.py`` (reference) vs ``core/tree_vec.py`` (vectorized) on the
+    SAME merge forest: condense + extract (EOM propagate + flat labels), the
+    host tail every pipeline pays after the device scans. The synthetic pool
+    is Skin-shaped — n ~ Skin_NonSkin rows, lattice-valued edge weights with
+    heavy duplicate chains (zero-weight ties), one spanning pool — the
+    regime where the reference's per-subtree Python walks are costliest.
+    Both engines must agree bitwise (asserted, not sampled); the acceptance
+    figure is ``vs_reference`` on the vectorized row (target >= 5x at 245k).
+    """
+    from hdbscan_tpu.core import tree as T
+    from hdbscan_tpu.core import tree_vec as V
+
+    rng = np.random.default_rng(seed)
+    # Skin-shaped spanning pool: a handful of clusters that each ERODE one
+    # point at a time over distinct increasing weights — the condensed-tree
+    # shape clustered data produces, and the regime where the reference's
+    # per-node Python walk is costliest — plus a zero-weight duplicate mass
+    # (Skin's integer lattice collapses ~80% of rows into tie groups) and
+    # cluster joins at large distinct weights.
+    n_clusters = 8
+    csizes = np.full(n_clusters, n // n_clusters)
+    csizes[: n % n_clusters] += 1
+    us, vs, ws = [], [], []
+    start = 0
+    for c in range(n_clusters):
+        m = int(csizes[c])
+        idx = np.arange(start, start + m)
+        us.append(idx[:-1])
+        vs.append(idx[1:])
+        wc = 1.0 + np.arange(m - 1) * 1e-5 + c * 1e-9
+        # Duplicate mass: a fraction of attachments happen at weight 0 and
+        # tie-contract into multi-way nodes at the chain bottoms.
+        wc[rng.random(m - 1) < 0.3] = 0.0
+        ws.append(wc)
+        start += m
+    heads = np.cumsum(np.concatenate([[0], csizes[:-1]]))
+    us.append(heads[:-1])
+    vs.append(heads[1:])
+    ws.append(100.0 + np.arange(n_clusters - 1, dtype=np.float64))
+    u = np.concatenate(us).astype(np.int64)
+    v = np.concatenate(vs).astype(np.int64)
+    w = np.concatenate(ws)
+    forest = T.build_merge_forest(n, u, v, w)
+    self_levels = rng.random(n) + 0.5
+
+    def run(eng):
+        tree = eng.condense_forest(
+            forest, min_cluster_size, self_levels=self_levels
+        )
+        with np.errstate(invalid="ignore"):
+            eng.propagate_tree(tree)
+        return tree, eng.flat_labels(tree)
+
+    walls = {}
+    out = {}
+    base = dict(
+        n=n, min_cluster_size=min_cluster_size, iters=iters, seed=seed,
+        edges=len(u),
+    )
+    for name, eng in (("reference", T), ("vectorized", V)):
+        run(eng)  # warmup (first-touch allocator noise)
+        ws = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            out[name] = run(eng)
+            ws.append(time.perf_counter() - t0)
+        walls[name] = float(np.median(ws))
+        row = dict(
+            leg=f"finalize_{name}", wall_s=round(walls[name], 4),
+            spread_s=[round(min(ws), 4), round(max(ws), 4)],
+            clusters=out[name][0].n_clusters, **base,
+        )
+        if name == "vectorized":
+            ref_tree, ref_labels = out["reference"]
+            vec_tree, vec_labels = out["vectorized"]
+            bitwise = ref_labels.tobytes() == vec_labels.tobytes() and all(
+                np.asarray(getattr(ref_tree, f)).tobytes()
+                == np.asarray(getattr(vec_tree, f)).tobytes()
+                for f in ("parent", "birth", "death", "stability",
+                          "num_members", "point_exit_level",
+                          "point_last_cluster")
+            )
+            assert bitwise, "finalize engines diverged — parity bug"
+            row["bitwise_match"] = bitwise
+            row["vs_reference"] = round(walls["reference"] / walls["vectorized"], 2)
+        _emit(out_path, row)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(__file__), "devicebench_r6.jsonl"))
-    ap.add_argument("--legs", default="dispatch,exact,rescan,ring")
+    ap.add_argument("--legs", default="dispatch,exact,rescan,ring,finalize")
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument("--compile-cache", default="auto",
                     help="persistent XLA cache: auto, off, or a directory "
@@ -509,6 +605,9 @@ def main():
                     help="exact-scan rows (use ~4096 for off-TPU fused "
                          "smoke rows — interpreter-mode gate at 16384)")
     ap.add_argument("--d", type=int, default=28)
+    ap.add_argument("--finalize-n", type=int, default=245_057,
+                    help="finalize-leg vertices (defaults to the "
+                         "Skin_NonSkin row count)")
     ap.add_argument("--rescan-n", type=int, default=1_000_000)
     ap.add_argument("--rescan-col-tile", type=int, default=8192)
     ap.add_argument("--rescan-tiles", default="64,1024",
@@ -529,6 +628,8 @@ def main():
         bench_ring_scan(
             args.out, n=args.ring_n, d=args.ring_d, iters=args.iters,
         )
+    if "finalize" in legs:
+        bench_finalize(args.out, n=args.finalize_n, iters=args.iters)
 
 
 if __name__ == "__main__":

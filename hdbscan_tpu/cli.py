@@ -11,6 +11,7 @@ Usage mirrors the reference's documented contract (``main/Main.java:534-614``)::
         [glue_factor=N] [glue_rows=N] [block_pruning={true,false}] \
         [knn_backend={auto,xla,pallas,fused}] \
         [scan_backend={auto,host,ring}] \
+        [tree_backend={auto,reference,vectorized}] \
         [consensus=N] [compat_cf={true,false}] \
         [clusterName={local,auto,<host:port>,<pid>,<np>}] \
         [--trace-out PATH] [--report PATH] [--compile-cache {auto,off,DIR}]
@@ -27,7 +28,11 @@ With both flags absent no telemetry file I/O happens.
 Borůvka sweeps (README "Scaling out"): ``host`` keeps the single-program
 tiled scans, ``ring`` shards rows over the mesh and circulates column
 panels via ``ppermute``, and ``auto`` selects ring only on a multi-device
-TPU mesh. ``--compile-cache`` controls jax's persistent XLA compile cache:
+TPU mesh. ``tree_backend`` picks the host finalize engine for the condensed
+tree (README "Finalize pipeline"): ``reference`` is the per-node Python
+walk, ``vectorized`` the array-level engine with bitwise-identical outputs,
+and ``auto`` uses vectorized with a reference fallback on unsupported
+inputs. ``--compile-cache`` controls jax's persistent XLA compile cache:
 ``auto`` (default) resolves JAX_COMPILATION_CACHE_DIR then the per-user
 default dir, ``off`` disables it, anything else is the cache directory.
 Reports record per-phase ``cache_hits`` next to ``jit_compiles`` so warmed

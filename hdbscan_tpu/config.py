@@ -203,6 +203,16 @@ class HDBSCANParams:
     #: meshes and host elsewhere. Outputs are bitwise identical across
     #: backends (ring parity tests, tests/unit/test_ring.py).
     scan_backend: str = "auto"
+    #: Host finalize engine for the condensed-tree tail (``core/tree.py`` vs
+    #: ``core/tree_vec.py``): "reference" keeps the per-node Python
+    #: condense/EOM/label walk (the parity oracle), "vectorized" runs the
+    #: array-level engine (pointer-jumped chain/exit propagation +
+    #: ``np.add.at`` segment-sum stabilities — bitwise-identical outputs),
+    #: "auto" (default) picks vectorized whenever the inputs support it
+    #: (integral point weights; ``tree_vec.supports_inputs``) and falls back
+    #: to reference otherwise. Applies to every finalize call site, including
+    #: the per-iteration rebuilds of the refine/refine_flat loops.
+    tree_backend: str = "auto"
     #: Persistent XLA compilation cache: "auto" (default) enables it at the
     #: default directory (``utils/cache.py`` — ``$JAX_COMPILATION_CACHE_DIR``
     #: or ``~/.cache/hdbscan_tpu_xla``), "off" disables it, any other value
@@ -243,6 +253,11 @@ class HDBSCANParams:
             raise ValueError(
                 "scan_backend must be 'auto', 'host' or 'ring', "
                 f"got {self.scan_backend!r}"
+            )
+        if self.tree_backend not in ("auto", "reference", "vectorized"):
+            raise ValueError(
+                "tree_backend must be 'auto', 'reference' or 'vectorized', "
+                f"got {self.tree_backend!r}"
             )
         if not self.compile_cache:
             raise ValueError(
@@ -331,6 +346,7 @@ FLAG_FIELDS = {
     "block_pruning": ("boundary_block_pruning", _bool),
     "knn_backend": ("knn_backend", str),
     "scan_backend": ("scan_backend", str),
+    "tree_backend": ("tree_backend", str),
     "compile_cache": ("compile_cache", str),
     "max_samples": ("max_samples", int),
     "compat_cf": ("compat_cf_int_math", _bool),

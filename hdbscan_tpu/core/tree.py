@@ -65,6 +65,12 @@ class MergeForest:
     dist: np.ndarray  # (t,) float64
     roots: list  # node ids of the final components
     sizes: np.ndarray  # (n + t,) weighted member count per node
+    #: Optional CSR twin of ``children`` from the native builder:
+    #: ``(kid_flat, kid_count)`` with ``kid_count[t] == 0`` for absorbed
+    #: nodes and kids in list order. ``core/tree_vec.py`` consumes it
+    #: directly; ``None`` (pure-Python build) falls back to flattening the
+    #: lists.
+    kids_csr: tuple | None = None
 
 
 #: Relative tolerance for grouping equal-weight edges into one hierarchy
@@ -193,17 +199,24 @@ def _build_merge_forest_native(lib, n, u, v, w, point_weights, tie_rtol):
         p(dist, f64), p(anchor, f64), p(absorbed, u8),
         p(child_head, i64), p(child_tail, i64), p(child_next, i64),
     )
-    children: list = []
-    for t in range(t_count):
-        if absorbed[t]:
-            children.append(None)
-            continue
-        kids = []
-        c = child_head[t]
-        while c >= 0:
-            kids.append(int(c))
-            c = child_next[c]
-        children.append(kids)
+    # Flatten the intrusive child lists in C (CSR in list order), then cut
+    # the Python lists from one tolist() pass — the per-kid Python walk this
+    # replaces dominated wrapper time at 100k+ points.
+    kid_flat = np.empty(n + m, np.int64)
+    kid_count = np.empty(max(t_count, 1), np.int64)
+    n_kids = lib.flatten_children_c(
+        t_count, p(absorbed, u8), p(child_head, i64), p(child_next, i64),
+        p(kid_flat, i64), p(kid_count, i64),
+    )
+    kid_flat = kid_flat[:n_kids]
+    kid_count = kid_count[:t_count]
+    flat_list = kid_flat.tolist()
+    offs = np.zeros(t_count + 1, np.int64)
+    np.cumsum(kid_count, out=offs[1:])
+    children: list = [
+        flat_list[offs[t]:offs[t + 1]] if not absorbed[t] else None
+        for t in range(t_count)
+    ]
     # roots: flatten the POINT union-find (the C side unions point roots
     # only; entries past n are uninitialized), then take each component
     # root's merge-tree top.
@@ -220,6 +233,7 @@ def _build_merge_forest_native(lib, n, u, v, w, point_weights, tie_rtol):
         dist=dist[:t_count].copy(),
         roots=roots,
         sizes=sizes[: n + t_count],
+        kids_csr=(kid_flat, kid_count),
     )
 
 
@@ -436,7 +450,15 @@ def propagate_tree(
     else:
         prop_cons = np.asarray(virtual_child_constraints, np.int64).copy()
     lowest_death = np.full(c + 1, np.inf)  # Double.MAX_VALUE analog
-    descendants: list = [[] for _ in range(c + 1)]
+    # Winning-descendant bookkeeping as per-cluster linked lists
+    # (head/tail/next) instead of list-of-lists: the reference's
+    # ``descendants[par].extend(descendants[label])`` copies every surviving
+    # label once per tree level — quadratic on deep cluster chains. Each
+    # label sits in at most one list and each list is spliced into its unique
+    # parent exactly once, so an O(1) splice is equivalent.
+    head = np.full(c + 1, -1, np.int64)
+    tail = np.full(c + 1, -1, np.int64)
+    nxt = np.full(c + 1, -1, np.int64)
 
     for label in range(c, 0, -1):
         par = tree.parent[label]
@@ -455,15 +477,27 @@ def propagate_tree(
         if self_wins:
             prop_cons[par] += own_cons
             prop_stab[par] += own_stab
-            descendants[par].append(label)
+            if head[par] < 0:
+                head[par] = label
+            else:
+                nxt[tail[par]] = label
+            tail[par] = label
         else:
             prop_cons[par] += prop_cons[label]
             prop_stab[par] += prop_stab[label]
-            descendants[par].extend(descendants[label])
+            if head[label] >= 0:  # splice the subtree's winner list upward
+                if head[par] < 0:
+                    head[par] = head[label]
+                else:
+                    nxt[tail[par]] = head[label]
+                tail[par] = tail[label]
 
     selected = np.zeros(c + 1, bool)
     if c >= 1:
-        selected[descendants[ROOT_LABEL]] = True
+        node = head[ROOT_LABEL]
+        while node >= 0:
+            selected[node] = True
+            node = nxt[node]
 
     tree.propagated_stability = prop_stab
     tree.lowest_child_death = lowest_death

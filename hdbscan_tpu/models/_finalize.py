@@ -7,6 +7,14 @@ mirroring the reference's canonical per-node pipeline tail
 (SURVEY.md §3.4; ``HDBSCANStar.propagateTree``/``findProminentClusters``/
 ``calculateOutlierScores``, ``hdbscanstar/HDBSCANStar.java:505,567,653``).
 Kept in one place so constraint/propagation fixes apply to every path.
+
+``params.tree_backend`` selects the condense/propagate/labels engine:
+``reference`` is the per-node Python walk in ``core/tree.py``, ``vectorized``
+the array-level engine in ``core/tree_vec.py`` (bitwise-identical outputs),
+and ``auto`` (default) picks vectorized whenever
+``tree_vec.supports_inputs`` accepts the inputs, falling back to reference
+otherwise (non-integral point weights). Every ``tree_*`` trace event carries
+the backend that actually ran (``native``/``python`` for the merge forest).
 """
 
 from __future__ import annotations
@@ -15,6 +23,19 @@ import numpy as np
 
 from hdbscan_tpu.config import HDBSCANParams
 from hdbscan_tpu.core import tree as tree_mod
+from hdbscan_tpu.core import tree_vec
+
+
+def resolve_tree_backend(
+    params: HDBSCANParams, point_weights: np.ndarray | None
+) -> str:
+    """The condense/extract engine finalize will actually use."""
+    backend = getattr(params, "tree_backend", "auto")
+    if backend in ("reference", "vectorized"):
+        return backend
+    return (
+        "vectorized" if tree_vec.supports_inputs(point_weights) else "reference"
+    )
 
 
 def finalize_clustering(
@@ -37,10 +58,15 @@ def finalize_clustering(
     ``constraint_index_map``: row id -> vertex id translation for constraint
     files when vertices are deduplicated points.
     ``trace``: optional per-stage event callable — isolates the host tree
-    layers (merge forest / condense / propagate+labels/GLOSH) so the
+    layers (merge forest / condense / propagate / labels / GLOSH) so the
     multi-M-row runs can tell scan wall from tree wall.
     """
     import time as _time
+
+    from hdbscan_tpu.native import merge_forest_lib
+
+    backend = resolve_tree_backend(params, point_weights)
+    eng = tree_vec if backend == "vectorized" else tree_mod
 
     t0 = _time.monotonic()
     forest = tree_mod.build_merge_forest(n, u, v, w, point_weights=point_weights)
@@ -49,10 +75,11 @@ def finalize_clustering(
             "tree_merge_forest",
             n=n,
             edges=len(u),
-            wall_s=round(_time.monotonic() - t0, 3),
+            backend="native" if merge_forest_lib() is not None else "python",
+            wall_s=round(_time.monotonic() - t0, 6),
         )
     t0 = _time.monotonic()
-    tree = tree_mod.condense_forest(
+    tree = eng.condense_forest(
         forest,
         params.min_cluster_size,
         point_weights=point_weights,
@@ -62,7 +89,8 @@ def finalize_clustering(
         trace(
             "tree_condense",
             clusters=len(tree.parent) - 1,
-            wall_s=round(_time.monotonic() - t0, 3),
+            backend=backend,
+            wall_s=round(_time.monotonic() - t0, 6),
         )
     virtual_child_constraints = None
     if params.constraints_file and num_constraints_satisfied is None:
@@ -86,11 +114,29 @@ def finalize_clustering(
             count_constraints_satisfied(tree, cons)
         )
     t0 = _time.monotonic()
-    infinite = tree_mod.propagate_tree(
+    infinite = eng.propagate_tree(
         tree, num_constraints_satisfied, virtual_child_constraints
     )
-    labels = tree_mod.flat_labels(tree)
+    if trace is not None:
+        trace(
+            "tree_propagate",
+            backend=backend,
+            wall_s=round(_time.monotonic() - t0, 6),
+        )
+    t0 = _time.monotonic()
+    labels = eng.flat_labels(tree)
+    if trace is not None:
+        trace(
+            "tree_labels",
+            backend=backend,
+            wall_s=round(_time.monotonic() - t0, 6),
+        )
+    t0 = _time.monotonic()
     scores = tree_mod.outlier_scores(tree, core)
     if trace is not None:
-        trace("tree_extract", wall_s=round(_time.monotonic() - t0, 3))
+        trace(
+            "tree_glosh",
+            backend=backend,
+            wall_s=round(_time.monotonic() - t0, 6),
+        )
     return tree, labels, scores, infinite

@@ -76,7 +76,14 @@ def merge_forest_lib():
             i64p, i64p, f64p, f64p, ctypes.c_double,
             i64p, i64p, f64p, f64p, f64p, u8p, i64p, i64p, i64p,
         ]
+        lib.flatten_children_c.restype = ctypes.c_int64
+        lib.flatten_children_c.argtypes = [
+            ctypes.c_int64, u8p, i64p, i64p, i64p, i64p,
+        ]
         _lib = lib
-    except OSError:
+    except (OSError, AttributeError):
+        # AttributeError: a stale cached .so missing a newer symbol — fall
+        # back to Python rather than crash (the mtime check rebuilds next
+        # time the source is newer).
         _lib = None
     return _lib

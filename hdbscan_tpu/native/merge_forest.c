@@ -106,3 +106,33 @@ int64_t build_merge_forest_c(
     }
     return next_node - n;
 }
+
+/* Flatten the intrusive child lists into CSR form: kid_flat holds every
+ * non-absorbed node's children concatenated in node order (list order
+ * preserved — the order the Python builder would produce), kid_count[t] the
+ * per-node count (0 for absorbed nodes). Returns the total kid count. The
+ * caller slices kid_flat by cumulative kid_count; the array layer
+ * (core/tree_vec.py) consumes it directly instead of re-flattening Python
+ * lists. */
+int64_t flatten_children_c(
+    int64_t t_count,
+    const uint8_t *absorbed,
+    const int64_t *child_head,
+    const int64_t *child_next,
+    int64_t *kid_flat,   /* (n + m) capacity */
+    int64_t *kid_count   /* (t_count) */
+) {
+    int64_t k = 0;
+    for (int64_t t = 0; t < t_count; t++) {
+        if (absorbed[t]) {
+            kid_count[t] = 0;
+            continue;
+        }
+        int64_t start = k;
+        for (int64_t c = child_head[t]; c >= 0; c = child_next[c]) {
+            kid_flat[k++] = c;
+        }
+        kid_count[t] = k - start;
+    }
+    return k;
+}

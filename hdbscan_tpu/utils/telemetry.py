@@ -178,6 +178,7 @@ def run_manifest(params=None, argv=None, extra: dict | None = None) -> dict:
             "default_backend": jax.default_backend(),
             "knn_backend": getattr(params, "knn_backend", None),
             "scan_backend": getattr(params, "scan_backend", None),
+            "tree_backend": getattr(params, "tree_backend", None),
         },
         "topology": device_topology(),
         "env": env_overrides(),

@@ -13,7 +13,12 @@ Checks every JSONL line against the trace schema contract
 carrying ``devices`` + ``ppermute_steps`` must satisfy
 ``ppermute_steps == devices - 1`` (one full panel rotation per round), and
 per-device wall events (integer ``device`` field) must keep ``seq``
-strictly increasing per (process, device). Given a report
+strictly increasing per (process, device). Host finalize events
+(``models/_finalize.py``, README "Finalize pipeline") add one more: any
+``tree_*`` stage must be one of the five known finalize stages
+(merge_forest/condense/propagate/labels/glosh) and must carry a string
+``backend`` tag naming the engine that ran (``native``/``python`` for the
+merge forest, ``vectorized``/``reference`` for the tree stages). Given a report
 (``utils/telemetry.REPORT_SCHEMA``), additionally cross-checks that the
 report's per-phase wall totals equal the trace's per-stage wall sums within
 1e-6 — the round-trip guarantee the tier-1 e2e test pins.
@@ -35,6 +40,19 @@ import sys
 TRACE_SCHEMA_PREFIX = "hdbscan-tpu-trace/"
 REPORT_SCHEMA_PREFIX = "hdbscan-tpu-report/"
 WALL_TOLERANCE = 1e-6
+
+#: The host finalize stages ``models/_finalize.py`` emits — any other
+#: ``tree_``-prefixed stage name is a contract violation (e.g. the pre-split
+#: lumped ``tree_extract`` event).
+TREE_STAGES = frozenset(
+    {
+        "tree_merge_forest",
+        "tree_condense",
+        "tree_propagate",
+        "tree_labels",
+        "tree_glosh",
+    }
+)
 
 
 def validate_trace(path: str) -> tuple[list[dict], list[str]]:
@@ -71,6 +89,19 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
                 )
             if not isinstance(ev.get("stage"), str) or not ev.get("stage"):
                 errors.append(f"{path}:{lineno}: missing/non-string 'stage'")
+            stage = ev.get("stage")
+            if isinstance(stage, str) and stage.startswith("tree_"):
+                # Finalize-stage invariants (models/_finalize.py).
+                if stage not in TREE_STAGES:
+                    errors.append(
+                        f"{path}:{lineno}: unknown finalize stage {stage!r} "
+                        f"(want one of {sorted(TREE_STAGES)})"
+                    )
+                backend = ev.get("backend")
+                if not isinstance(backend, str) or not backend:
+                    errors.append(
+                        f"{path}:{lineno}: {stage} lacks a string 'backend' tag"
+                    )
             wall = ev.get("wall_s")
             if not isinstance(wall, (int, float)) or isinstance(wall, bool) or (
                 isinstance(wall, float) and not math.isfinite(wall)

@@ -1,0 +1,236 @@
+"""Parity sweep for the vectorized finalize engine (``core/tree_vec.py``).
+
+The contract is *bitwise* equality with the reference backend on every
+``CondensedTree`` field — labels, stabilities, exit levels, GLOSH inputs —
+across the full input space the reference handles: weight ties and duplicate
+points (zero levels), weighted vertices, multi-root pools, fractional
+``min_cluster_size``, ``self_levels``, and constraint-driven propagation.
+The sweep also pins the three-way agreement with the pure-Python
+(``HDBSCAN_TPU_NO_NATIVE``) merge-forest builder, so the native C forest,
+the Python forest, and both condense engines all land on identical bytes.
+"""
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.core import tree as T
+from hdbscan_tpu.core import tree_vec as V
+
+TREE_FIELDS = (
+    "parent",
+    "birth",
+    "death",
+    "stability",
+    "has_children",
+    "num_members",
+    "point_exit_level",
+    "point_last_cluster",
+)
+PROP_FIELDS = ("propagated_stability", "lowest_child_death", "selected")
+
+
+def assert_trees_bitwise(ref: T.CondensedTree, vec: T.CondensedTree, ctx=""):
+    for name in TREE_FIELDS:
+        a, b = np.asarray(getattr(ref, name)), np.asarray(getattr(vec, name))
+        assert a.dtype == b.dtype and a.shape == b.shape, f"{ctx} {name} shape"
+        assert a.tobytes() == b.tobytes(), f"{ctx} {name} differs\n{a}\n{b}"
+
+
+def assert_propagated_bitwise(ref: T.CondensedTree, vec: T.CondensedTree, ctx=""):
+    for name in PROP_FIELDS:
+        a, b = np.asarray(getattr(ref, name)), np.asarray(getattr(vec, name))
+        assert a.tobytes() == b.tobytes(), f"{ctx} {name} differs\n{a}\n{b}"
+
+
+def random_case(rng):
+    """One randomized instance: edge pool + weights + mcs + self levels.
+
+    Ties come from the small weight vocabulary (duplicate points produce
+    zero-weight levels), multi-root pools from self-loop removal leaving
+    isolated vertices, fractional mcs from the float choices.
+    """
+    n = int(rng.integers(1, 60))
+    m = int(rng.integers(0, 2 * n + 1))
+    u = rng.integers(0, n, m)
+    v = rng.integers(0, n, m)
+    keep = u != v
+    u, v = u[keep], v[keep]
+    w = rng.choice(
+        [0.0, 0.5, 1.0, 1.0, 1.0, 2.0, 3.25, float(rng.random())], size=len(u)
+    )
+    pw = (
+        rng.integers(1, 6, n).astype(np.float64)
+        if rng.random() < 0.5
+        else None
+    )
+    mcs = float(rng.choice([1, 2, 3, 5, 1.5, 2.5, 0.02 * n + 1]))
+    sl = np.round(rng.random(n) * 2, 2) if rng.random() < 0.5 else None
+    return n, u, v, w, pw, mcs, sl
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_randomized_parity_sweep(seed):
+    rng = np.random.default_rng(seed)
+    for trial in range(60):
+        n, u, v, w, pw, mcs, sl = random_case(rng)
+        ctx = f"seed={seed} trial={trial} n={n} m={len(u)} mcs={mcs}"
+        forest = T.build_merge_forest(n, u, v, w, point_weights=pw)
+        ref = T.condense_forest(forest, mcs, point_weights=pw, self_levels=sl)
+        vec = V.condense_forest(forest, mcs, point_weights=pw, self_levels=sl)
+        assert_trees_bitwise(ref, vec, ctx)
+
+        # Constraint-driven propagation: random per-cluster gamma/vGamma
+        # credits (the real counter runs on the tree, which is already
+        # bitwise-shared at this point).
+        C = ref.n_clusters
+        ncs = (
+            rng.integers(0, 3, C + 1).astype(np.int64)
+            if rng.random() < 0.5
+            else None
+        )
+        vcc = (
+            rng.integers(0, 2, C + 1).astype(np.int64)
+            if rng.random() < 0.5
+            else None
+        )
+        with np.errstate(invalid="ignore"):
+            inf_ref = T.propagate_tree(
+                ref, None if ncs is None else ncs.copy(), vcc
+            )
+            inf_vec = V.propagate_tree(
+                vec, None if ncs is None else ncs.copy(), vcc
+            )
+        assert inf_ref == inf_vec, ctx
+        assert_propagated_bitwise(ref, vec, ctx)
+        assert T.flat_labels(ref).tobytes() == V.flat_labels(vec).tobytes(), ctx
+        if sl is not None:
+            a = T.outlier_scores(ref, sl)
+            b = T.outlier_scores(vec, sl)
+            assert a.tobytes() == b.tobytes(), ctx
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_three_way_with_python_merge_forest(seed, monkeypatch):
+    """vectorized == reference == native-disabled Python forest, bitwise."""
+    from hdbscan_tpu import native
+
+    rng = np.random.default_rng(100 + seed)
+    n, u, v, w, pw, mcs, sl = random_case(rng)
+    forest_native = T.build_merge_forest(n, u, v, w, point_weights=pw)
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_lib_tried", True)
+    forest_py = T.build_merge_forest(n, u, v, w, point_weights=pw)
+
+    trees = [
+        eng.condense_forest(f, mcs, point_weights=pw, self_levels=sl)
+        for f in (forest_native, forest_py)
+        for eng in (T, V)
+    ]
+    for eng, tree in zip((T, V, T, V), trees):
+        eng.propagate_tree(tree)
+    ref = trees[0]
+    labels0 = T.flat_labels(ref)
+    for eng, other in zip((V, T, V), trees[1:]):
+        assert_trees_bitwise(ref, other)
+        assert_propagated_bitwise(ref, other)
+        assert labels0.tobytes() == eng.flat_labels(other).tobytes()
+
+
+def test_real_constraint_counts_flow_through_both_backends(tmp_path):
+    """End-to-end constraint path: counts computed on the shared tree feed
+    both propagate engines and select identical clusters."""
+    from hdbscan_tpu.core.constraints import (
+        Constraint,
+        count_constraints_satisfied,
+    )
+
+    rng = np.random.default_rng(3)
+    n = 50
+    u = np.arange(n - 1)
+    v = np.arange(1, n)
+    w = rng.choice([1.0, 2.0, 4.0], n - 1)
+    forest = T.build_merge_forest(n, u, v, w)
+    ref = T.condense_forest(forest, 4)
+    vec = V.condense_forest(forest, 4)
+    assert_trees_bitwise(ref, vec)
+    cons = [
+        Constraint(int(a), int(b), kind)
+        for a, b in rng.integers(0, n, (12, 2))
+        for kind in ("ml", "cl")
+    ]
+    ncs_r, vcc_r = count_constraints_satisfied(ref, cons)
+    ncs_v, vcc_v = count_constraints_satisfied(vec, cons)
+    assert np.array_equal(ncs_r, ncs_v) and np.array_equal(vcc_r, vcc_v)
+    T.propagate_tree(ref, ncs_r, vcc_r)
+    V.propagate_tree(vec, ncs_v, vcc_v)
+    assert_propagated_bitwise(ref, vec)
+    assert T.flat_labels(ref).tobytes() == V.flat_labels(vec).tobytes()
+
+
+def test_supports_inputs_gates_non_integral_weights():
+    assert V.supports_inputs(None)
+    assert V.supports_inputs(np.array([1.0, 4.0, 2.0]))
+    assert not V.supports_inputs(np.array([1.0, 2.5]))
+    assert not V.supports_inputs(np.array([1.0, np.inf]))
+
+
+def test_auto_backend_resolution():
+    from hdbscan_tpu.config import HDBSCANParams
+    from hdbscan_tpu.models._finalize import resolve_tree_backend
+
+    p = HDBSCANParams(input_file="x")
+    assert p.tree_backend == "auto"
+    assert resolve_tree_backend(p, None) == "vectorized"
+    assert resolve_tree_backend(p, np.array([1.5])) == "reference"
+    assert (
+        resolve_tree_backend(p.replace(tree_backend="reference"), None)
+        == "reference"
+    )
+    assert (
+        resolve_tree_backend(
+            p.replace(tree_backend="vectorized"), np.array([1.5])
+        )
+        == "vectorized"
+    )
+    with pytest.raises(ValueError):
+        p.replace(tree_backend="bogus")
+
+
+def test_finalize_emits_split_tree_stages_with_backend_tags():
+    """finalize_clustering emits the five split ``tree_*`` events, each
+    tagged with the engine that ran (satellite of the trace contract pinned
+    by scripts/check_trace.py)."""
+    from hdbscan_tpu.config import HDBSCANParams
+    from hdbscan_tpu.models._finalize import finalize_clustering
+    from hdbscan_tpu.utils.tracing import Tracer
+    from scripts.check_trace import TREE_STAGES
+
+    rng = np.random.default_rng(5)
+    n = 40
+    u = np.arange(n - 1)
+    v = np.arange(1, n)
+    w = rng.choice([1.0, 2.0, 8.0], n - 1)
+    core = rng.random(n)
+    out = {}
+    for backend in ("reference", "vectorized", "auto"):
+        params = HDBSCANParams(
+            input_file="x", min_cluster_size=4, tree_backend=backend
+        )
+        tracer = Tracer()
+        tree, labels, scores, infinite = finalize_clustering(
+            n, u, v, w, core, params, trace=tracer
+        )
+        out[backend] = (labels.tobytes(), scores.tobytes())
+        tree_events = [
+            e for e in tracer.events if e.name.startswith("tree_")
+        ]
+        assert {e.name for e in tree_events} == TREE_STAGES
+        for ev in tree_events:
+            backend_tag = ev.fields.get("backend")
+            assert isinstance(backend_tag, str) and backend_tag
+            if ev.name == "tree_merge_forest":
+                assert backend_tag in ("native", "python")
+            else:
+                want = "vectorized" if backend != "reference" else "reference"
+                assert backend_tag == want
+    assert out["reference"] == out["vectorized"] == out["auto"]
