@@ -261,6 +261,57 @@ def main(argv: list[str] | None = None) -> None:
         file=sys.stderr,
     )
 
+    # --- serving predict leg (README "Serving") ----------------------------
+    # Model artifact from the mr-db fit, then batched approximate_predict at
+    # three request sizes. Reported per size: nearest-rank p50/p99 latency
+    # and rows/s; plus the zero-steady-state-recompile check (jit_compiles
+    # across all timed batches after AOT bucket warmup must be 0).
+    from hdbscan_tpu.serve.predict import Predictor
+    from hdbscan_tpu.utils.telemetry import compile_counter, latency_percentiles
+
+    tracer("bench_leg", leg="predict")
+    model = r_mr.to_cluster_model(data, mr_params)
+    predictor = Predictor(model, max_batch=256, tracer=tracer)
+    winfo = predictor.warmup()
+    predict_fields = {
+        "predict_backend": predictor.backend,
+        "predict_warmup_wall_s": round(winfo["wall_s"], 3),
+        "predict_warmup_compiles": winfo["jit_compiles"],
+    }
+    steady_counter = compile_counter()
+    steady_before = steady_counter()
+    rng_q = np.random.default_rng(0)
+    for bs in (1, 16, 256):
+        esnap = len(tracer.events)
+        iters = 50
+        for _ in range(iters):
+            # training rows + jitter: realistic near-manifold queries that
+            # exercise the attachment climb, not the duplicate shortcut
+            q = data[rng_q.integers(0, len(data), bs)] + rng_q.normal(
+                0, 0.01, (bs, data.shape[1])
+            )
+            predictor.predict(q)
+        walls = [
+            ev.wall_s
+            for ev in tracer.events[esnap:]
+            if ev.name == "predict_batch"
+        ]
+        pct = latency_percentiles(walls)
+        predict_fields[f"predict_b{bs}_p50_ms"] = round(pct["p50_s"] * 1e3, 3)
+        predict_fields[f"predict_b{bs}_p99_ms"] = round(pct["p99_s"] * 1e3, 3)
+        predict_fields[f"predict_b{bs}_rows_per_s"] = round(
+            bs * iters / max(sum(walls), 1e-9), 1
+        )
+        print(
+            f"[bench] predict b={bs}: p50={pct['p50_s'] * 1e3:.3f}ms "
+            f"p99={pct['p99_s'] * 1e3:.3f}ms "
+            f"rows/s={predict_fields[f'predict_b{bs}_rows_per_s']}",
+            file=sys.stderr,
+        )
+    predict_fields["predict_steady_state_compiles"] = (
+        steady_counter() - steady_before
+    )
+
     print(
         json.dumps(
             {
@@ -304,6 +355,7 @@ def main(argv: list[str] | None = None) -> None:
                 "db_flat_vs_baseline": round(DB_BASELINE_S / fl_wall, 3),
                 "db_flat_ari": round(fl_ari, 4),
                 "db_flat_tree_wall_s": round(fl_tree, 3),
+                **predict_fields,
                 **ring_fields,
             }
         )

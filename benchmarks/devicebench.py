@@ -588,11 +588,73 @@ def bench_finalize(out_path, n=245_057, iters=3, seed=0, min_cluster_size=3000):
         _emit(out_path, row)
 
 
+def bench_predict(out_path, n=100_000, d=8, iters=50, seed=0, max_batch=256):
+    """Serving predict-throughput leg (README "Serving").
+
+    Fits an n-row synthetic model once (exact path), then drives batched
+    ``serve/predict.Predictor`` dispatches at request sizes 1/16/``max_batch``
+    against the device-resident model. Per size: nearest-rank p50/p99
+    latency and rows/s over ``iters`` batches of jittered training queries
+    (near-manifold, so the attachment climb runs — not the duplicate
+    shortcut). Also emits the warmup row (bucket count, compile count) and
+    asserts the zero-steady-state-recompile contract: jit compiles across
+    every timed batch after warmup must be 0 (reported, not silently
+    assumed). TPU target: b=256 throughput >= 1M rows/s at n=100k, d=8;
+    CPU rows are marked cpu_smoke."""
+    from hdbscan_tpu.config import HDBSCANParams
+    from hdbscan_tpu.models import exact
+    from hdbscan_tpu.serve.predict import Predictor
+    from hdbscan_tpu.utils.telemetry import compile_counter, latency_percentiles
+
+    rng = np.random.default_rng(seed)
+    centers = rng.uniform(-10, 10, (8, d))
+    data = centers[rng.integers(0, 8, n)] + rng.normal(0, 0.5, (n, d))
+    params = HDBSCANParams(
+        min_points=8, min_cluster_size=max(n // 100, 16)
+    )
+    t0 = time.perf_counter()
+    result = exact.fit(data, params)
+    fit_wall = time.perf_counter() - t0
+    model = result.to_cluster_model(data, params)
+    predictor = Predictor(model, max_batch=max_batch)
+    winfo = predictor.warmup()
+    platform = jax.devices()[0].platform
+    _emit(out_path, dict(
+        leg="predict_warmup", n=n, d=d, backend=predictor.backend,
+        platform=platform, cpu_smoke=platform != "tpu",
+        fit_wall_s=round(fit_wall, 3), buckets=winfo["buckets"],
+        warmup_wall_s=winfo["wall_s"], jit_compiles=winfo["jit_compiles"],
+    ))
+    counter = compile_counter()
+    before = counter()
+    for bs in (1, 16, max_batch):
+        walls = []
+        for _ in range(iters):
+            q = data[rng.integers(0, n, bs)] + rng.normal(0, 0.05, (bs, d))
+            t0 = time.perf_counter()
+            predictor.predict(q)
+            walls.append(time.perf_counter() - t0)
+        pct = latency_percentiles(walls)
+        _emit(out_path, dict(
+            leg=f"predict_b{bs}", n=n, d=d, batch=bs, iters=iters,
+            backend=predictor.backend, platform=platform,
+            cpu_smoke=platform != "tpu",
+            p50_ms=round(pct["p50_s"] * 1e3, 3),
+            p99_ms=round(pct["p99_s"] * 1e3, 3),
+            rows_per_s=round(bs * iters / max(sum(walls), 1e-9), 1),
+        ))
+    _emit(out_path, dict(
+        leg="predict_steady_state", n=n, d=d,
+        jit_compiles=counter() - before,  # the zero-recompile contract
+        platform=platform, cpu_smoke=platform != "tpu",
+    ))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(__file__), "devicebench_r6.jsonl"))
-    ap.add_argument("--legs", default="dispatch,exact,rescan,ring,finalize")
+    ap.add_argument("--legs", default="dispatch,exact,rescan,ring,finalize,predict")
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument("--compile-cache", default="auto",
                     help="persistent XLA cache: auto, off, or a directory "
@@ -612,6 +674,10 @@ def main():
     ap.add_argument("--rescan-col-tile", type=int, default=8192)
     ap.add_argument("--rescan-tiles", default="64,1024",
                     help="comma-separated chunk sizes in 256-row tiles")
+    ap.add_argument("--predict-n", type=int, default=100_000,
+                    help="predict-leg training rows (use ~5000 for CPU "
+                         "smoke rows — the leg fits an exact model first)")
+    ap.add_argument("--predict-d", type=int, default=8)
     args = ap.parse_args()
     legs = args.legs.split(",")
     if "dispatch" in legs:
@@ -630,6 +696,11 @@ def main():
         )
     if "finalize" in legs:
         bench_finalize(args.out, n=args.finalize_n, iters=args.iters)
+    if "predict" in legs:
+        bench_predict(
+            args.out, n=args.predict_n, d=args.predict_d,
+            iters=max(args.iters, 20),
+        )
 
 
 if __name__ == "__main__":

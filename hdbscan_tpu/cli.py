@@ -43,6 +43,27 @@ hard-coded args, ``main/Main.java:71`` — treated as a bug, SURVEY.md §7), and
 the dataset is routed automatically: inputs that fit ``processing_units`` run
 the exact single-block path; larger inputs run the full recursive-sampling +
 data-bubble pipeline. Outputs are the five canonical files either way.
+
+Serving (README "Serving") — three subcommands; a bare ``key=value``
+invocation still means ``fit`` (the reference-compatible form above)::
+
+    python -m hdbscan_tpu fit file=<input> ... [--model-out MODEL.npz]
+    python -m hdbscan_tpu predict --model MODEL.npz --points <input> \
+        [--out PRED.csv] [predict_backend={auto,xla,fused}] [predict_batch=N] \
+        [--trace-out PATH] [--report PATH]
+    python -m hdbscan_tpu serve --model MODEL.npz [--host H] [--port P] \
+        [predict_backend=...] [predict_batch=N] [--trace-out PATH] \
+        [--report PATH]
+
+``fit --model-out`` persists the fitted clustering as one atomic
+schema-versioned ``.npz`` (``serve/artifact.ClusterModel``); ``predict``
+classifies new points against it (labels, membership probabilities, GLOSH
+outlier scores — ``serve/predict.approximate_predict``); ``serve`` starts a
+stdlib HTTP server (``POST /predict``, ``GET /healthz``) with micro-batched
+dispatch. Both serving commands AOT-warm every power-of-two batch bucket so
+steady state recompiles nothing, emit per-batch ``predict_batch`` trace
+events, and report p50/p95/p99 latency in the run report
+(``predict_latency``).
 """
 
 from __future__ import annotations
@@ -84,11 +105,24 @@ def main(argv: list[str] | None = None) -> int:
     if not argv or any(a in ("-h", "--help", "help") for a in argv):
         print(HELP)
         return 0
+    # Subcommand dispatch; a bare key=value invocation (the reference's
+    # documented contract) still means fit.
+    if argv[0] == "predict":
+        return _main_predict(argv[1:], list(argv))
+    if argv[0] == "serve":
+        return _main_serve(argv[1:], list(argv))
+    if argv[0] == "fit":
+        argv = argv[1:]
+    return _main_fit(argv)
+
+
+def _main_fit(argv: list[str]) -> int:
     argv_full = list(argv)  # manifest records argv as given, flags included
     try:
         trace_out = _pop_path_flag(argv, "--trace-out")
         report_out = _pop_path_flag(argv, "--report")
         compile_cache_flag = _pop_path_flag(argv, "--compile-cache")
+        model_out = _pop_path_flag(argv, "--model-out")
         params = HDBSCANParams.from_args(argv)
         if compile_cache_flag is not None:
             import dataclasses
@@ -220,6 +254,11 @@ def main(argv: list[str] | None = None) -> int:
             t0 = time.monotonic()
             paths = hdbscan.write_outputs(result, params)
             tracer("write_outputs", wall_s=round(time.monotonic() - t0, 6))
+            if model_out is not None:
+                t0 = time.monotonic()
+                result.to_cluster_model(data, params).save(model_out)
+                tracer("model_save", wall_s=round(time.monotonic() - t0, 6))
+                paths = dict(paths, model=model_out)
             n_clusters = len(set(result.labels[result.labels > 0].tolist()))
             n_noise = int(np.sum(result.labels == 0))
             print(
@@ -300,6 +339,174 @@ def main(argv: list[str] | None = None) -> int:
                 per_host=per_host,
             ),
         )
+    return 0
+
+
+def _serving_tracer(trace_out: str | None, report_out: str | None):
+    """Telemetry wiring for the single-process serving commands — same
+    sinks/counters contract as the fit driver (predict_batch events carry
+    per-phase jit_compiles deltas, so the zero-steady-state-recompile claim
+    is checkable from the trace alone)."""
+    import os
+
+    from hdbscan_tpu.utils.tracing import JsonlSink, Tracer
+
+    sinks = []
+    counters = None
+    if trace_out is not None or report_out is not None:
+        from hdbscan_tpu.utils import telemetry
+
+        counters = {
+            "jit_compiles": telemetry.compile_counter(),
+            "cache_hits": telemetry.cache_hit_counter(),
+        }
+        if trace_out is not None:
+            sinks.append(JsonlSink(trace_out, static={"process": 0}))
+    return Tracer(
+        stream=sys.stderr if os.environ.get("HDBSCAN_TPU_TRACE") else None,
+        sinks=sinks,
+        counters=counters,
+    )
+
+
+def _write_serving_report(report_out: str, tracer, params, argv_full) -> None:
+    from hdbscan_tpu.utils import telemetry
+
+    report = telemetry.build_report(
+        tracer, manifest=telemetry.run_manifest(params, argv=argv_full)
+    )
+    latency = telemetry.predict_latency_section(tracer)
+    if latency is not None:
+        report["predict_latency"] = latency
+    telemetry.write_report(report_out, report)
+
+
+def _main_predict(argv: list[str], argv_full: list[str]) -> int:
+    try:
+        model_path = _pop_path_flag(argv, "--model")
+        points_path = _pop_path_flag(argv, "--points")
+        out_path = _pop_path_flag(argv, "--out")
+        trace_out = _pop_path_flag(argv, "--trace-out")
+        report_out = _pop_path_flag(argv, "--report")
+        params = HDBSCANParams.from_args(argv)
+    except ValueError as e:
+        print(f"error: {e}\n{HELP}", file=sys.stderr)
+        return 2
+    if not model_path or not points_path:
+        print(
+            "error: predict requires --model MODEL.npz and --points <input>",
+            file=sys.stderr,
+        )
+        return 2
+
+    import numpy as np
+
+    from hdbscan_tpu.serve.artifact import ClusterModel
+    from hdbscan_tpu.serve.predict import Predictor
+    from hdbscan_tpu.utils.io import load_points
+
+    tracer = _serving_tracer(trace_out, report_out)
+    try:
+        t0 = time.monotonic()
+        try:
+            model = ClusterModel.load(model_path)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"error: cannot load model: {e}", file=sys.stderr)
+            return 2
+        tracer(
+            "model_load",
+            n_train=model.n_train,
+            mode=model.mode,
+            wall_s=round(time.monotonic() - t0, 6),
+        )
+        t0 = time.monotonic()
+        points = load_points(points_path)
+        if points.ndim == 1:
+            points = points[:, None]
+        tracer(
+            "load_points",
+            rows=len(points),
+            dims=int(points.shape[1]),
+            wall_s=round(time.monotonic() - t0, 6),
+        )
+        predictor = Predictor(
+            model,
+            backend=params.predict_backend,
+            max_batch=params.predict_max_batch,
+            tracer=tracer,
+        )
+        predictor.warmup()
+        try:
+            labels, prob, score = predictor.predict(points)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if out_path is not None:
+            with open(out_path, "w", encoding="utf-8") as f:
+                f.write("label,probability,outlier_score\n")
+                for row in zip(labels, prob, score):
+                    f.write(f"{row[0]},{row[1]:.6f},{row[2]:.6f}\n")
+        n_clusters = len(set(labels[labels > 0].tolist()))
+        n_noise = int(np.sum(labels == 0))
+        print(
+            f"hdbscan-tpu predict: {len(points)} points, {n_clusters} "
+            f"clusters, {n_noise} noise ({predictor.backend} backend)"
+        )
+        if out_path is not None:
+            print(f"  predictions: {out_path}")
+    finally:
+        tracer.close()
+    if report_out is not None:
+        _write_serving_report(report_out, tracer, params, argv_full)
+    return 0
+
+
+def _main_serve(argv: list[str], argv_full: list[str]) -> int:
+    try:
+        model_path = _pop_path_flag(argv, "--model")
+        host = _pop_path_flag(argv, "--host") or "127.0.0.1"
+        port = _pop_path_flag(argv, "--port")
+        trace_out = _pop_path_flag(argv, "--trace-out")
+        report_out = _pop_path_flag(argv, "--report")
+        params = HDBSCANParams.from_args(argv)
+        port = int(port) if port is not None else 8799
+    except ValueError as e:
+        print(f"error: {e}\n{HELP}", file=sys.stderr)
+        return 2
+    if not model_path:
+        print("error: serve requires --model MODEL.npz", file=sys.stderr)
+        return 2
+
+    from hdbscan_tpu.serve.artifact import ClusterModel
+    from hdbscan_tpu.serve.server import ClusterServer
+
+    tracer = _serving_tracer(trace_out, report_out)
+    try:
+        try:
+            model = ClusterModel.load(model_path)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"error: cannot load model: {e}", file=sys.stderr)
+            return 2
+        server = ClusterServer(
+            model,
+            backend=params.predict_backend,
+            max_batch=params.predict_max_batch,
+            host=host,
+            port=port,
+            tracer=tracer,
+        )
+        print(
+            f"hdbscan-tpu serve: http://{server.host}:{server.port} "
+            f"(model {model_path}, {model.n_train} train points, "
+            f"{server.predictor.backend} backend, buckets "
+            f"{server.predictor.buckets})",
+            file=sys.stderr,
+        )
+        server.serve_forever()
+    finally:
+        tracer.close()
+    if report_out is not None:
+        _write_serving_report(report_out, tracer, params, argv_full)
     return 0
 
 

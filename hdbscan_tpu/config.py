@@ -219,6 +219,17 @@ class HDBSCANParams:
     #: is used as the cache directory path. Cache hits vs fresh compiles are
     #: recorded in the run report (``utils/telemetry.cache_hit_counter``).
     compile_cache: str = "auto"
+    #: Serving k-NN engine for ``serve/predict`` (the ``predict``/``serve``
+    #: CLI commands): "xla" runs the guarded tiled scan, "fused" the Pallas
+    #: fused-selection kernel (falls back to xla when the shape/metric/
+    #: platform is ineligible — same safety contract as ``knn_backend``),
+    #: "auto" (default) picks fused on eligible TPU shapes.
+    predict_backend: str = "auto"
+    #: Largest serving bucket: query batches pad into power-of-two buckets
+    #: up to this many rows (floor 8) and larger requests chunk. Every
+    #: bucket is AOT-warmed at server start, so steady-state serving
+    #: recompiles nothing.
+    predict_max_batch: int = 256
     # Output file names derived from the input path (main/Main.java:516-526):
 
     def __post_init__(self):
@@ -268,6 +279,13 @@ class HDBSCANParams:
                 "knn_backend must be 'auto', 'xla', 'pallas' or 'fused', "
                 f"got {self.knn_backend!r}"
             )
+        if self.predict_backend not in ("auto", "xla", "fused"):
+            raise ValueError(
+                "predict_backend must be 'auto', 'xla' or 'fused', "
+                f"got {self.predict_backend!r}"
+            )
+        if self.predict_max_batch < 1:
+            raise ValueError("predict_max_batch must be >= 1")
         if self.boundary_quality > 0 and self.dedup_points:
             raise ValueError(
                 "boundary_quality and dedup_points are mutually exclusive "
@@ -348,6 +366,8 @@ FLAG_FIELDS = {
     "scan_backend": ("scan_backend", str),
     "tree_backend": ("tree_backend", str),
     "compile_cache": ("compile_cache", str),
+    "predict_backend": ("predict_backend", str),
+    "predict_batch": ("predict_max_batch", int),
     "max_samples": ("max_samples", int),
     "compat_cf": ("compat_cf_int_math", _bool),
 }

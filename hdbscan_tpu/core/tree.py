@@ -547,6 +547,35 @@ def outlier_scores(tree: CondensedTree, core_distances: np.ndarray) -> np.ndarra
     return score
 
 
+def cluster_eps_min(
+    tree: CondensedTree, labels: np.ndarray | None = None
+) -> np.ndarray:
+    """Per-cluster minimum point exit eps — the "max lambda" record of the
+    serving artifact (``serve/artifact.py``), in this repo's eps-level
+    representation: ``eps_min[c]`` is the lowest level at which any flat
+    member of selected cluster ``c`` exited (``lambda_max = 1/eps_min``).
+    Membership probability of a query attaching at level ``eps_q`` is
+    ``min(1, eps_min[c] / eps_q)`` — 1.0 at the cluster's densest point,
+    falling toward the fringe, exactly the reference semantics of
+    ``probabilities_`` rendered in eps space. Zero for label 0 and for
+    unselected labels (no flat members).
+
+    ``labels``: flat labels over the tree's point space (vertex space for
+    deduplicated fits); recomputed via :func:`flat_labels` when omitted.
+    """
+    if tree.selected is None:
+        raise ValueError("propagate_tree() must run before cluster_eps_min()")
+    if labels is None:
+        labels = flat_labels(tree)
+    c = tree.n_clusters
+    eps_min = np.full(c + 1, np.inf)
+    mask = labels > 0
+    np.minimum.at(eps_min, labels[mask], tree.point_exit_level[mask])
+    eps_min[~np.isfinite(eps_min)] = 0.0
+    eps_min[0] = 0.0
+    return eps_min
+
+
 def extract_clusters(
     n: int,
     u: np.ndarray,

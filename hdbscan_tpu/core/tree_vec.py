@@ -400,12 +400,19 @@ def propagate_tree(
     return tree.infinite_stability
 
 
-def flat_labels(tree: CondensedTree) -> np.ndarray:
-    """Array-level :func:`hdbscan_tpu.core.tree.flat_labels`."""
+def selected_ancestors(tree: CondensedTree) -> np.ndarray:
+    """Per-label nearest selected ancestor-or-self (0 = noise) via pointer
+    doubling — the jump table behind :func:`flat_labels`, exposed on its own
+    because serving (``serve/predict.py``) indexes it with *query* attachment
+    clusters rather than the training points' last clusters."""
     if tree.selected is None:
-        raise ValueError("propagate_tree() must run before flat_labels()")
+        raise ValueError("propagate_tree() must run before selected_ancestors()")
     c = tree.n_clusters
     idx = np.arange(c + 1, dtype=np.int64)
-    # Nearest selected ancestor-or-self (0 = noise) via pointer doubling.
     jump = np.where(tree.selected, idx, np.where(tree.parent > 0, tree.parent, 0))
-    return _fixpoint_jump(jump).astype(np.int64)[tree.point_last_cluster]
+    return _fixpoint_jump(jump).astype(np.int64)
+
+
+def flat_labels(tree: CondensedTree) -> np.ndarray:
+    """Array-level :func:`hdbscan_tpu.core.tree.flat_labels`."""
+    return selected_ancestors(tree)[tree.point_last_cluster]

@@ -40,6 +40,15 @@ class HDBSCANResult:
     #: row -> unique-vertex index map when the run deduplicated (else None).
     dedup_inverse: np.ndarray | None = None
 
+    def to_cluster_model(self, data: np.ndarray, params):
+        """Serving artifact for this fit (``serve/artifact.ClusterModel``);
+        ``data``/``params`` must be the ones the fit ran with — they feed
+        the artifact's fingerprint. Lazy import: fitting must not require
+        the serve subsystem."""
+        from hdbscan_tpu.serve.artifact import ClusterModel
+
+        return ClusterModel.from_fit_result(self, data, params)
+
 
 @partial(jax.jit, static_argnames=("min_pts", "metric"))
 def _device_block(x: jax.Array, min_pts: int, metric: str):

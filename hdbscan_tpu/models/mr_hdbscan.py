@@ -99,6 +99,15 @@ class MRHDBSCANResult:
     #: sidecar so the five-file set is self-describing (VERDICT r4 weak #1).
     consensus_info: dict | None = None
 
+    def to_cluster_model(self, data: np.ndarray, params):
+        """Serving artifact for this fit (``serve/artifact.ClusterModel``);
+        consensus results persist the representative draw's tree with the
+        consensus flat labels (same provenance split as ``write_outputs``).
+        Lazy import: fitting must not require the serve subsystem."""
+        from hdbscan_tpu.serve.artifact import ClusterModel
+
+        return ClusterModel.from_fit_result(self, data, params)
+
 
 #: Adaptive boundary criterion: a point's per-block core distance is damaged
 #: iff its k-NN ball reaches across a partition seam, i.e. seam distance <=

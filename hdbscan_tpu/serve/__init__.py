@@ -1,0 +1,26 @@
+"""Serving subsystem: persist a fitted model, classify new points, serve HTTP.
+
+Four layers (README "Serving"):
+
+- ``serve/artifact.py`` — schema-versioned :class:`ClusterModel` saved as one
+  atomic ``.npz`` (condensed-tree arrays, selected clusters, per-cluster
+  max-lambda, training points + core distances, params fingerprint);
+- ``serve/predict.py`` — jitted batched :func:`approximate_predict` (query
+  k-NN against the training set, mutual-reachability attachment level,
+  nearest-selected-ancestor labels), plus :func:`membership_vectors` and
+  GLOSH :func:`outlier_scores` for unseen points;
+- ``serve/batcher.py`` — :class:`MicroBatcher` coalescing concurrent
+  requests into padded power-of-two buckets (zero steady-state recompiles
+  after AOT warmup);
+- ``serve/server.py`` — stdlib HTTP ``/predict`` + ``/healthz`` with
+  ``predict_batch`` trace events and latency percentiles in the run report.
+"""
+
+from hdbscan_tpu.serve.artifact import MODEL_SCHEMA, ClusterModel  # noqa: F401
+from hdbscan_tpu.serve.batcher import MicroBatcher  # noqa: F401
+from hdbscan_tpu.serve.predict import (  # noqa: F401
+    Predictor,
+    approximate_predict,
+    membership_vectors,
+    outlier_scores,
+)

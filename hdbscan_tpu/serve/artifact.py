@@ -1,0 +1,258 @@
+"""Schema-versioned model artifact: one atomic ``.npz`` per fitted model.
+
+The fit pipelines end at five CSV output files and the model evaporates
+(``main/Main.java:534-614`` — the reference has no inference path at all).
+:class:`ClusterModel` is the persistent form: everything
+``serve/predict.approximate_predict`` needs to classify new points against
+the fitted hierarchy — training points + per-row core distances (the k-NN
+reference set), the condensed-tree arrays (parent/birth chains for the
+attachment climb), the selected-cluster set with its flat-label jump table,
+per-selected-cluster max-lambda (membership probabilities) and per-cluster
+GLOSH ``eps_max`` — plus a params fingerprint reusing ``utils/checkpoint``'s
+digest scheme so a model can never silently serve the wrong dataset or
+parameterization.
+
+Deduplicated fits are stored expanded to ROW space (labels/cores already are;
+the tree's per-point arrays translate through ``dedup_inverse``), so the
+artifact is self-contained: predict never needs the fit-time vertex maps.
+MR/data-bubble fits store the full training rows under the global/hybrid
+core vector — the pooled mutual-reachability weights are re-weighted to that
+same core vector during fit, so query attachment levels are commensurable
+with the tree's levels.
+
+Save is atomic (tempfile + ``os.replace``); load refuses a mismatched schema
+version, a corrupt payload (stored-data digest != stored fingerprint), and —
+when the caller supplies ``params``/``data`` — a mismatched fingerprint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from dataclasses import dataclass
+
+import numpy as np
+
+from hdbscan_tpu.utils.checkpoint import _data_digest
+
+#: Version tag carried by every model artifact. Bump the integer suffix on
+#: any backwards-incompatible array-layout change; ``load`` refuses other
+#: versions outright (a served prediction from misread arrays is silent
+#: corruption, unlike a checkpoint, which can just start fresh).
+MODEL_SCHEMA = "hdbscan-tpu-model/1"
+
+#: The parameter fields that must match for a model to serve a dataset —
+#: the serve-relevant subset of ``utils/checkpoint._fingerprint`` (fit-only
+#: knobs like ``k`` or ``refine_iterations`` are baked into the stored tree
+#: and need not match at load time).
+_FINGERPRINT_FIELDS = ("min_points", "min_cluster_size", "dist_function")
+
+
+def _fingerprint(params, n: int, data_digest: str | None) -> dict:
+    fp = {"n": int(n), "data": data_digest}
+    for f in _FINGERPRINT_FIELDS:
+        fp[f] = getattr(params, f)
+    return fp
+
+
+@dataclass
+class ClusterModel:
+    """A fitted clustering, ready to classify unseen points.
+
+    Per-row arrays (length n, ROW space even for deduplicated fits):
+    ``data``/``core``/``labels``/``last_cluster``. Per-cluster arrays
+    (length C+1, 1-indexed labels, 0 unused — ``core/tree.CondensedTree``
+    layout): ``parent``/``birth``/``selected``/``sel_anc``/``eps_min``/
+    ``eps_max``.
+    """
+
+    mode: str  # "exact" | "mr"
+    params: dict  # the _FINGERPRINT_FIELDS subset, as plain values
+    fingerprint: dict
+    data: np.ndarray  # (n, d) float64 training points
+    core: np.ndarray  # (n,) float64 core distances
+    labels: np.ndarray  # (n,) int64 fitted flat labels (0 = noise)
+    last_cluster: np.ndarray  # (n,) int64 deepest cluster per point
+    parent: np.ndarray  # (C+1,) int64 cluster parent (-1 root, 0 unused)
+    birth: np.ndarray  # (C+1,) float64 cluster birth eps (inf at root)
+    selected: np.ndarray  # (C+1,) bool EOM solution set
+    sel_anc: np.ndarray  # (C+1,) int64 nearest selected ancestor-or-self
+    eps_min: np.ndarray  # (C+1,) float64 per-selected-cluster min exit eps
+    eps_max: np.ndarray  # (C+1,) float64 lowest descendant death (GLOSH)
+    schema: str = MODEL_SCHEMA
+
+    @property
+    def n_train(self) -> int:
+        return len(self.data)
+
+    @property
+    def min_points(self) -> int:
+        return int(self.params["min_points"])
+
+    @property
+    def metric(self) -> str:
+        return str(self.params["dist_function"])
+
+    @property
+    def selected_ids(self) -> np.ndarray:
+        """The selected cluster labels, ascending — the column order of
+        :func:`serve.predict.membership_vectors`."""
+        return np.flatnonzero(self.selected).astype(np.int64)
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_fit_result(cls, result, data: np.ndarray, params) -> "ClusterModel":
+        """Build the artifact from a fit result (``models/hdbscan.
+        HDBSCANResult`` or ``models/mr_hdbscan.MRHDBSCANResult``) plus the
+        training data and params it was fitted with.
+
+        Consensus results are stored as their REPRESENTATIVE draw's tree
+        with the consensus flat labels — the same mixed provenance the
+        five-file output set documents (``write_outputs`` sidecar).
+        """
+        from hdbscan_tpu.models._finalize import serving_tables
+
+        data = np.asarray(data, np.float64)
+        if data.ndim == 1:
+            data = data[:, None]
+        n = len(data)
+        tree = result.tree
+        labels = np.asarray(result.labels, np.int64)
+        core = np.asarray(result.core_distances, np.float64)
+        if len(labels) != n or len(core) != n:
+            raise ValueError(
+                f"result arrays (n={len(labels)}) do not match data (n={n})"
+            )
+        inv = getattr(result, "dedup_inverse", None)
+        last = np.asarray(tree.point_last_cluster, np.int64)
+        if inv is not None:
+            last = last[inv]
+        tables = serving_tables(tree)
+        mode = "mr" if hasattr(result, "n_levels") else "exact"
+        return cls(
+            mode=mode,
+            params={f: getattr(params, f) for f in _FINGERPRINT_FIELDS},
+            fingerprint=_fingerprint(params, n, _data_digest(data)),
+            data=data,
+            core=core,
+            labels=labels,
+            last_cluster=last,
+            parent=np.asarray(tree.parent, np.int64),
+            birth=np.asarray(tree.birth, np.float64),
+            selected=np.asarray(tree.selected, bool),
+            sel_anc=np.asarray(tables["sel_anc"], np.int64),
+            eps_min=np.asarray(tables["eps_min"], np.float64),
+            eps_max=np.asarray(tables["eps_max"], np.float64),
+        )
+
+    # -- persistence -------------------------------------------------------
+
+    def save(self, path: str) -> str:
+        """Write the artifact atomically (tempfile + ``os.replace``, the
+        ``utils/checkpoint`` pattern: a crashed save never leaves a
+        half-written model where a server could load it)."""
+        out_dir = os.path.dirname(os.path.abspath(path))
+        os.makedirs(out_dir, exist_ok=True)
+        meta = {
+            "schema": self.schema,
+            "mode": self.mode,
+            "params": self.params,
+            "fingerprint": self.fingerprint,
+        }
+        fd, tmp = tempfile.mkstemp(dir=out_dir, suffix=".tmp")
+        os.close(fd)
+        try:
+            with open(tmp, "wb") as f:
+                np.savez_compressed(
+                    f,
+                    meta=np.frombuffer(json.dumps(meta).encode(), np.uint8),
+                    data=self.data,
+                    core=self.core,
+                    labels=self.labels,
+                    last_cluster=self.last_cluster,
+                    parent=self.parent,
+                    birth=self.birth,
+                    selected=self.selected,
+                    sel_anc=self.sel_anc,
+                    eps_min=self.eps_min,
+                    eps_max=self.eps_max,
+                )
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        return path
+
+    @classmethod
+    def load(cls, path: str, params=None, data=None) -> "ClusterModel":
+        """Load and verify an artifact.
+
+        Raises ``ValueError`` on (1) a schema version other than
+        ``MODEL_SCHEMA`` — arrays of another layout must not be misread;
+        (2) a corrupt payload — the stored training data's digest must equal
+        the stored fingerprint's; (3) a fingerprint mismatch against the
+        caller's ``params`` and/or ``data`` when supplied (a server asked to
+        serve config X with a model fitted under config Y must refuse, the
+        ``utils/checkpoint.load_latest`` stance).
+        """
+        with np.load(path) as z:
+            meta = json.loads(bytes(z["meta"]).decode())
+            schema = meta.get("schema")
+            if schema != MODEL_SCHEMA:
+                raise ValueError(
+                    f"model {path} has schema {schema!r}; this build reads "
+                    f"{MODEL_SCHEMA!r} only"
+                )
+            model = cls(
+                mode=meta["mode"],
+                params=meta["params"],
+                fingerprint=meta["fingerprint"],
+                data=z["data"],
+                core=z["core"],
+                labels=z["labels"],
+                last_cluster=z["last_cluster"],
+                parent=z["parent"],
+                birth=z["birth"],
+                selected=z["selected"],
+                sel_anc=z["sel_anc"],
+                eps_min=z["eps_min"],
+                eps_max=z["eps_max"],
+                schema=schema,
+            )
+        stored_digest = model.fingerprint.get("data")
+        if stored_digest is not None and _data_digest(model.data) != stored_digest:
+            raise ValueError(
+                f"model {path} is corrupt: stored training data digest does "
+                f"not match its fingerprint ({stored_digest})"
+            )
+        if params is not None or data is not None:
+            want = dict(model.fingerprint)
+            if params is not None:
+                for f in _FINGERPRINT_FIELDS:
+                    want[f] = getattr(params, f)
+            if data is not None:
+                arr = np.asarray(data, np.float64)
+                if arr.ndim == 1:
+                    arr = arr[:, None]
+                want["n"] = len(arr)
+                want["data"] = _data_digest(arr)
+            if want != model.fingerprint:
+                raise ValueError(
+                    f"model {path} was fitted for {model.fingerprint}, "
+                    f"caller expects {want}; refusing to serve"
+                )
+        return model
+
+    def summary(self) -> dict:
+        """Small JSON-safe description (the ``/healthz`` payload core)."""
+        return {
+            "schema": self.schema,
+            "mode": self.mode,
+            "n_train": int(self.n_train),
+            "dims": int(self.data.shape[1]),
+            "n_clusters": int(len(self.parent) - 1),
+            "n_selected": int(self.selected.sum()),
+            "params": dict(self.params),
+        }

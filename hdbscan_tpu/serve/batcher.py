@@ -1,0 +1,148 @@
+"""Request coalescing for the inference server: the micro-batching layer.
+
+Concurrent HTTP requests land here one at a time; the batcher drains them
+into a single padded bucket dispatch so the device runs one program per
+linger window instead of one per request. Shapes stay inside the
+:class:`~hdbscan_tpu.serve.predict.Predictor`'s warmed power-of-two bucket
+set, so coalescing never triggers a recompile — the zero-steady-state-
+recompile guarantee holds under any request mix.
+
+Stdlib only (``threading`` + ``queue`` + ``concurrent.futures.Future``), one
+worker thread owning the device — JAX dispatch is not thread-safe across
+donated buffers, and a single dispatcher keeps ``predict_batch`` trace
+events (``batch_seq``) strictly ordered.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+
+class MicroBatcher:
+    """Coalesce concurrent predict requests into bucket-sized batches.
+
+    Args:
+      predictor: a warmed :class:`~hdbscan_tpu.serve.predict.Predictor`.
+      linger_s: how long the worker waits for more requests after the first
+        one arrives before dispatching (the latency the smallest request
+        pays to let a batch form; 0 disables coalescing).
+      max_rows: dispatch ceiling per coalesced batch — defaults to the
+        predictor's largest bucket, so a coalesced batch is exactly one
+        device program.
+    """
+
+    def __init__(self, predictor, linger_s: float = 0.002,
+                 max_rows: int | None = None):
+        self.predictor = predictor
+        self.linger_s = float(linger_s)
+        self.max_rows = int(max_rows or predictor.max_bucket)
+        self._q: queue.Queue = queue.Queue()
+        self._closed = False
+        self._batches = 0
+        self._rows = 0
+        self._worker = threading.Thread(
+            target=self._run, name="predict-batcher", daemon=True
+        )
+        self._worker.start()
+
+    # -- client side -------------------------------------------------------
+
+    def submit(self, X) -> Future:
+        """Enqueue one request; the Future resolves to this request's
+        ``(labels, probabilities, outlier_scores)`` slice of the coalesced
+        dispatch."""
+        if self._closed:
+            raise RuntimeError("MicroBatcher is closed")
+        X = np.asarray(X, np.float64)
+        if X.ndim == 1:
+            X = X[None, :]
+        fut: Future = Future()
+        self._q.put((X, fut))
+        return fut
+
+    def predict(self, X):
+        """Blocking convenience wrapper around :meth:`submit`."""
+        return self.submit(X).result()
+
+    @property
+    def stats(self) -> dict:
+        """{'batches': dispatches so far, 'rows': rows served} — the
+        coalescing ratio is rows/batches."""
+        return {"batches": self._batches, "rows": self._rows}
+
+    # -- worker side -------------------------------------------------------
+
+    def _collect(self, first) -> tuple[list, bool]:
+        """Drain the queue into one batch: start from ``first``, keep
+        accepting until the linger window closes or the batch would exceed
+        ``max_rows``. Returns (batch, saw_close_sentinel)."""
+        batch = [first]
+        rows = len(first[0])
+        deadline = time.monotonic() + self.linger_s
+        while rows < self.max_rows:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                item = self._q.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if item is None:
+                return batch, True
+            batch.append(item)
+            rows += len(item[0])
+        return batch, False
+
+    def _dispatch(self, batch) -> None:
+        xs = [x for x, _ in batch]
+        futs = [f for _, f in batch]
+        try:
+            x_all = np.concatenate(xs)
+        except ValueError as e:  # mixed dims inside one window
+            for f in futs:
+                f.set_exception(ValueError(f"incompatible request shapes: {e}"))
+            return
+        try:
+            labels, prob, score = self.predictor.predict(x_all)
+        except Exception as e:  # noqa: BLE001 - fan the failure out
+            for f in futs:
+                f.set_exception(e)
+            return
+        self._batches += 1
+        self._rows += len(x_all)
+        a = 0
+        for x, f in zip(xs, futs):
+            b = a + len(x)
+            f.set_result((labels[a:b], prob[a:b], score[a:b]))
+            a = b
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                break
+            batch, stop = self._collect(item)
+            self._dispatch(batch)
+            if stop:
+                break
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self, timeout: float | None = 5.0) -> None:
+        """Stop accepting requests, flush what's queued, join the worker."""
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(None)
+        self._worker.join(timeout=timeout)
+
+    def __enter__(self) -> "MicroBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,482 @@
+"""Jitted batched ``approximate_predict`` against a fitted ClusterModel.
+
+Semantics (README "Serving"): classification of an unseen point q is
+approximate and nearest-exemplar, the hdbscan ``approximate_predict``
+formulation rendered in this repo's eps-level representation:
+
+1. **k-NN**: q's k nearest training points (k = minPts - 1) via the same
+   tiled exact scan the fit used (``ops/tiled._knn_core_scan``, or the fused
+   Pallas kernel under ``predict_backend=fused``).
+2. **Core distance**: ``core_q`` = the (minPts - 1)-th smallest training
+   distance — identical to the fit's self-included semantics for training
+   rows (their own row sits in the train set at distance 0).
+3. **Attachment level**: ``eps_q = min_i max(d_i, core_q, core_i)`` over the
+   k-NN list — the mutual-reachability level at which q would join the
+   hierarchy; the argmin neighbor is q's exemplar.
+4. **Cluster**: starting from the exemplar's deepest cluster, climb to the
+   deepest ancestor whose birth level covers ``eps_q`` (cluster births
+   strictly increase toward the root, so the climb is a monotone predicate —
+   binary lifting over a precomputed ancestor table, O(log C) per query,
+   fully jitted). A query that is an exact duplicate of a training row skips
+   the climb and attaches at that row's fitted cluster, which makes
+   ``approximate_predict`` on the training set reproduce the fit labels
+   bitwise (the artifact round-trip guarantee the tier-1 tests pin).
+5. **Label** = the attachment cluster's nearest selected ancestor
+   (``core/tree_vec.selected_ancestors`` jump table; 0 = noise).
+   **Probability** = ``min(1, eps_min[label] / eps_q)`` (per-cluster max
+   lambda). **Outlier score** = GLOSH with ``eps_q`` as the exit level,
+   clipped at 0.
+
+Batching: queries pad into power-of-two buckets (floor 8 — smaller requests
+share the 8-row compile), so steady-state serving triggers zero recompiles
+once :meth:`Predictor.warmup` has run every bucket (verified via
+``utils/telemetry.compile_counter`` in the tier-1 tests). The query buffer
+is donated to the device program, and multi-chunk batches double-buffer the
+host-to-device staging against compute (the ``ops/blockscan`` prestage
+pattern).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hdbscan_tpu.ops.tiled import (
+    _knn_core_scan,
+    _next_pow2,
+    _pad_rows,
+    _tile_sizes,
+)
+
+#: Smallest device bucket: requests of 1..8 rows share one compiled shape
+#: (the scan's minimum row tile is 8 sublanes anyway, so a 1-row program
+#: would compute 8 rows regardless).
+_MIN_BUCKET = 8
+
+#: Largest query row tile; buckets above it loop row tiles inside the scan.
+_MAX_ROW_TILE = 128
+
+
+def _resolve_backend(backend: str, model, dtype) -> tuple[str, bool]:
+    """('xla'|'fused', interpret) with ``knn_backend``-style fallback rules:
+    'fused' silently falls back to the XLA scan when the kernel cannot run
+    (non-euclidean, d > 128, k > 128, non-f32, or off-TPU at large n, where
+    only the slow interpreter exists)."""
+    if backend not in ("auto", "xla", "fused"):
+        raise ValueError(
+            f"predict backend must be 'auto', 'xla' or 'fused', got {backend!r}"
+        )
+    on_tpu = jax.devices()[0].platform == "tpu"
+    k = max(model.min_points - 1, 1)
+    fusable = (
+        model.metric == "euclidean"
+        and k <= 128
+        and model.data.shape[1] <= 128
+        and dtype is np.float32
+        and (on_tpu or model.n_train <= (1 << 14))
+    )
+    if backend == "fused" and fusable:
+        return "fused", not on_tpu
+    if backend == "auto" and fusable and on_tpu:
+        return "fused", False
+    return "xla", False
+
+
+def _climb(anc, birth, cluster, eps):
+    """Deepest ancestor-or-self of ``cluster`` whose birth >= ``eps``.
+
+    Births strictly increase toward the root (ties contract into one
+    multi-way merge node, so a child is always born strictly below its
+    parent) and the root's birth is +inf, so the predicate is monotone along
+    every ancestor chain and the chain always ends in a pass. Binary lifting
+    finds the last failing node greedily; its parent is the answer.
+    """
+    cur = cluster.astype(jnp.int32)
+    for level in range(anc.shape[0] - 1, -1, -1):
+        cand = anc[level][cur]
+        cur = jnp.where(birth[cand] < eps, cand, cur)
+    return jnp.where(birth[cur] >= eps, cur, anc[0][cur]).astype(jnp.int32)
+
+
+def _attach(
+    knn_d, knn_i, xq, train, core_t, labels_t, last_t, anc, birth,
+    sel_anc, eps_min, eps_max, sel_ids, kth_col: int, with_membership: bool,
+):
+    """Shared post-k-NN logic: attachment level, climb, labels, prob, GLOSH
+    (and optionally the per-selected-cluster membership matrix)."""
+    if kth_col < 0:  # minPts <= 1: every core distance is zero (fit parity)
+        core_q = jnp.zeros(knn_d.shape[0], knn_d.dtype)
+    else:
+        core_q = knn_d[:, kth_col]
+    mrd = jnp.maximum(jnp.maximum(knn_d, core_q[:, None]), core_t[knn_i])
+    j = jnp.argmin(mrd, axis=1)  # first minimum = lowest-distance exemplar
+    eps_q = jnp.take_along_axis(mrd, j[:, None], axis=1)[:, 0]
+    nbr = jnp.take_along_axis(knn_i, j[:, None], axis=1)[:, 0]
+    # Exact-duplicate shortcut: a query identical to a training row attaches
+    # at that row's fitted cluster with no climb — float-rounding in the
+    # rebuilt distances can otherwise nudge eps_q past a birth level shared
+    # with the point's exit and flip the label by one tree level.
+    nbr0 = knn_i[:, 0]
+    is_dup = jnp.all(xq == train[nbr0], axis=1) & (nbr0 >= 0)
+    cluster = jnp.where(
+        is_dup, last_t[nbr0], _climb(anc, birth, last_t[nbr], eps_q)
+    )
+    label = sel_anc[cluster]
+    em = eps_min[label]
+    prob = jnp.where(
+        label > 0, jnp.where(eps_q <= em, 1.0, em / eps_q), 0.0
+    )
+    emax = eps_max[cluster]
+    score = jnp.where(
+        eps_q > 0, jnp.clip(1.0 - emax / eps_q, 0.0, 1.0), 0.0
+    )
+    if not with_membership:
+        return label, prob, score
+    # Soft clustering: per selected cluster, the minimum mutual-reachability
+    # distance to a k-NN neighbor fitted to that cluster; inverse-normalized.
+    labn = labels_t[knn_i]  # (B, k) fitted flat labels of the neighbors
+    inf = jnp.array(jnp.inf, mrd.dtype)
+    md = jnp.min(
+        jnp.where(labn[:, :, None] == sel_ids[None, None, :], mrd[:, :, None], inf),
+        axis=1,
+    )  # (B, S)
+    inv = jnp.where(md > 0, 1.0 / jnp.maximum(md, 1e-30), 1e30)
+    tot = jnp.sum(jnp.where(jnp.isfinite(md), inv, 0.0), axis=1, keepdims=True)
+    mvec = jnp.where(
+        jnp.isfinite(md) & (tot > 0), inv / jnp.maximum(tot, 1e-30), 0.0
+    )
+    return label, prob, score, mvec
+
+
+def _predict_kernel_xla(
+    xq, train, valid, core_t, labels_t, last_t, anc, birth, sel_anc,
+    eps_min, eps_max, sel_ids,
+    k: int, kth_col: int, metric: str, row_tile: int, col_tile: int,
+    with_membership: bool,
+):
+    knn_d, knn_i = _knn_core_scan(
+        xq, train, valid, k, metric, row_tile, col_tile, with_indices=True
+    )
+    return _attach(
+        knn_d, knn_i, xq, train, core_t, labels_t, last_t, anc, birth,
+        sel_anc, eps_min, eps_max, sel_ids, kth_col, with_membership,
+    )
+
+
+def _predict_kernel_fused(
+    xq, train_rows, train_t, colmask, core_t, labels_t, last_t, anc, birth,
+    sel_anc, eps_min, eps_max, sel_ids,
+    k: int, kth_col: int, with_membership: bool, interpret: bool,
+):
+    from hdbscan_tpu.ops.pallas_knn import knn_fused_pallas
+
+    d_all, i_all = knn_fused_pallas(xq, train_t, colmask, k, interpret=interpret)
+    return _attach(
+        d_all[:, :k], i_all[:, :k], xq, train_rows, core_t, labels_t, last_t,
+        anc, birth, sel_anc, eps_min, eps_max, sel_ids, kth_col,
+        with_membership,
+    )
+
+
+@lru_cache(maxsize=None)
+def _jitted_kernel(which: str):
+    """Module-level jit wrappers (stable jit cache across Predictor
+    instances). Query buffers are donated only where the backend supports
+    donation — donating on CPU just warns and copies."""
+    donate = (0,) if jax.default_backend() != "cpu" else ()
+    if which == "xla":
+        return jax.jit(
+            _predict_kernel_xla,
+            static_argnames=(
+                "k", "kth_col", "metric", "row_tile", "col_tile",
+                "with_membership",
+            ),
+            donate_argnums=donate,
+        )
+    return jax.jit(
+        _predict_kernel_fused,
+        static_argnames=("k", "kth_col", "with_membership", "interpret"),
+        donate_argnums=donate,
+    )
+
+
+def _ancestor_table(parent: np.ndarray) -> np.ndarray:
+    """Binary-lifting ancestor table over the cluster labels: ``anc[l][c]``
+    is c's 2^l-th ancestor, saturating at the root (and at the unused label
+    0), as one (L, C+1) int32 array."""
+    c1 = len(parent)
+    anc0 = np.where(parent > 0, parent, np.arange(c1)).astype(np.int32)
+    levels = max(1, int(np.ceil(np.log2(max(c1, 2)))))
+    anc = [anc0]
+    for _ in range(levels - 1):
+        anc.append(anc[-1][anc[-1]])
+    return np.stack(anc)
+
+
+class Predictor:
+    """Device-resident serving state for one :class:`ClusterModel`.
+
+    The training points, core distances and every tree table are placed on
+    device once at construction (the cuSLINK stance: keep the hierarchy
+    resident on-accelerator between queries); each :meth:`predict` call
+    ships only the padded query bucket.
+
+    Args:
+      model: a loaded ``serve/artifact.ClusterModel``.
+      backend: 'auto' | 'xla' | 'fused' (``HDBSCANParams.predict_backend``).
+      max_batch: bucket ceiling; requests above it chunk. Rounded up to a
+        power of two, floor ``_MIN_BUCKET``.
+      dtype: device scan dtype (f32 default, matching the fit scans).
+      tracer: optional ``utils/tracing.Tracer`` — every dispatched bucket
+        emits a ``predict_batch`` event (bucket, rows, batch_seq, wall_s).
+    """
+
+    def __init__(
+        self, model, backend: str = "auto", max_batch: int = 256,
+        dtype=np.float32, tracer=None,
+    ):
+        self.model = model
+        self.tracer = tracer
+        self.dtype = dtype
+        self.backend, self._interpret = _resolve_backend(backend, model, dtype)
+        n = model.n_train
+        self.k = max(model.min_points - 1, 1)
+        self.kth_col = (
+            min(max(model.min_points - 1, 1), n) - 1 if model.min_points > 1 else -1
+        )
+        self.max_bucket = max(_MIN_BUCKET, _next_pow2(max(1, int(max_batch))))
+        # Serializes dispatch: donated query buffers and batch_seq ordering
+        # both assume one predict() in flight (the HTTP server can call in
+        # from handler threads as well as the batcher worker).
+        self._lock = threading.RLock()
+        self.buckets = [
+            1 << p
+            for p in range(_MIN_BUCKET.bit_length() - 1, self.max_bucket.bit_length())
+        ]
+        self._batch_seq = 0
+
+        c1 = len(model.parent)
+        anc = _ancestor_table(model.parent)
+        if self.backend == "fused":
+            from hdbscan_tpu.ops.pallas_knn import COL_TILE, LANES
+
+            self._row_mult = 256  # pallas ROW_TILE: fused buckets pad to it
+            n_pad = -(-max(n, COL_TILE) // COL_TILE) * COL_TILE
+            x = np.zeros((n_pad, LANES), np.float32)
+            x[:n, : model.data.shape[1]] = model.data
+            colmask = np.full((1, n_pad), np.inf, np.float32)
+            colmask[0, :n] = 0.0
+            self._train_rows = jax.device_put(x)
+            self._train_t = jax.device_put(np.ascontiguousarray(x.T))
+            self._colmask = jax.device_put(colmask)
+            self._lanes = LANES
+        else:
+            self._row_mult = 1
+            self.row_tile_cap = _MAX_ROW_TILE
+            _, self.col_tile, n_pad = _tile_sizes(n, _MAX_ROW_TILE, 8192)
+            self._train = jax.device_put(
+                jnp.asarray(_pad_rows(np.asarray(model.data, dtype), n_pad))
+            )
+            self._valid = jax.device_put(jnp.asarray(np.arange(n_pad) < n))
+        self._core_t = jax.device_put(
+            jnp.asarray(_pad_rows(np.asarray(model.core, dtype), n_pad))
+        )
+        self._labels_t = jax.device_put(
+            jnp.asarray(_pad_rows(np.asarray(model.labels, np.int32), n_pad))
+        )
+        self._last_t = jax.device_put(
+            jnp.asarray(_pad_rows(np.asarray(model.last_cluster, np.int32), n_pad))
+        )
+        self._anc = jax.device_put(jnp.asarray(anc))
+        self._birth = jax.device_put(jnp.asarray(np.asarray(model.birth, dtype)))
+        self._sel_anc = jax.device_put(
+            jnp.asarray(np.asarray(model.sel_anc, np.int32))
+        )
+        self._eps_min = jax.device_put(
+            jnp.asarray(np.asarray(model.eps_min, dtype))
+        )
+        self._eps_max = jax.device_put(
+            jnp.asarray(np.asarray(model.eps_max, dtype))
+        )
+        self._sel_ids = jax.device_put(
+            jnp.asarray(model.selected_ids.astype(np.int32))
+        )
+        assert c1 == len(model.sel_anc)
+
+    # -- bucket plumbing ---------------------------------------------------
+
+    def bucket_for(self, rows: int) -> int:
+        """Smallest configured power-of-two bucket holding ``rows`` (the
+        ceiling bucket for oversized requests, which chunk)."""
+        for b in self.buckets:
+            if rows <= b:
+                return b
+        return self.max_bucket
+
+    def _stage(self, chunk: np.ndarray, bucket: int):
+        """Pad one chunk to its device bucket and start the async H2D copy."""
+        dev_rows = max(bucket, self._row_mult)
+        if self.backend == "fused":
+            xq = np.zeros((dev_rows, self._lanes), np.float32)
+            xq[: len(chunk), : chunk.shape[1]] = chunk
+        else:
+            xq = np.zeros((dev_rows, chunk.shape[1]), self.dtype)
+            xq[: len(chunk)] = chunk
+        return jax.device_put(xq)
+
+    def _dispatch(self, staged, bucket: int, with_membership: bool):
+        if self.backend == "fused":
+            return _jitted_kernel("fused")(
+                staged, self._train_rows, self._train_t, self._colmask,
+                self._core_t, self._labels_t, self._last_t, self._anc,
+                self._birth, self._sel_anc, self._eps_min, self._eps_max,
+                self._sel_ids, k=self.k, kth_col=self.kth_col,
+                with_membership=with_membership, interpret=self._interpret,
+            )
+        dev_rows = max(bucket, self._row_mult)
+        row_tile = min(_next_pow2(max(dev_rows, 8)), self.row_tile_cap)
+        return _jitted_kernel("xla")(
+            staged, self._train, self._valid, self._core_t, self._labels_t,
+            self._last_t, self._anc, self._birth, self._sel_anc,
+            self._eps_min, self._eps_max, self._sel_ids, k=self.k,
+            kth_col=self.kth_col, metric=self.model.metric,
+            row_tile=row_tile, col_tile=self.col_tile,
+            with_membership=with_membership,
+        )
+
+    # -- public API --------------------------------------------------------
+
+    def predict(self, X, with_membership: bool = False):
+        """Batched prediction: returns ``(labels, probabilities,
+        outlier_scores)`` int64/float64 arrays aligned with ``X`` rows
+        (plus the (n, S) membership matrix when ``with_membership``).
+
+        Requests above ``max_bucket`` chunk; chunk i+1's host-to-device copy
+        is staged while chunk i computes (the ``ops/blockscan`` prestage
+        pattern), so the device never idles on transfer.
+        """
+        X = np.asarray(X, np.float64)
+        if X.ndim == 1:
+            X = X[None, :]
+        if X.shape[1] != self.model.data.shape[1]:
+            raise ValueError(
+                f"query dims {X.shape[1]} != model dims {self.model.data.shape[1]}"
+            )
+        with self._lock:
+            return self._predict_locked(X, with_membership)
+
+    def _predict_locked(self, X: np.ndarray, with_membership: bool):
+        n = len(X)
+        chunks = []
+        a = 0
+        while a < n:
+            b = min(n - a, self.max_bucket)
+            chunks.append((a, b, self.bucket_for(b)))
+            a += b
+        outs = []
+        staged = self._stage(X[chunks[0][0] : chunks[0][0] + chunks[0][1]],
+                             chunks[0][2])
+        for ci, (a, b, bucket) in enumerate(chunks):
+            t0 = time.perf_counter()
+            out = self._dispatch(staged, bucket, with_membership)
+            if ci + 1 < len(chunks):  # overlap next H2D with this compute
+                na, nb, nbucket = chunks[ci + 1]
+                staged = self._stage(X[na : na + nb], nbucket)
+            fetched = jax.device_get(out)
+            wall = time.perf_counter() - t0
+            if self.tracer is not None:
+                self.tracer(
+                    "predict_batch",
+                    bucket=int(bucket),
+                    rows=int(b),
+                    batch_seq=self._batch_seq,
+                    backend=self.backend,
+                    wall_s=round(wall, 6),
+                )
+            self._batch_seq += 1
+            outs.append(tuple(np.asarray(f)[:b] for f in fetched))
+        label = np.concatenate([o[0] for o in outs]).astype(np.int64)
+        prob = np.concatenate([o[1] for o in outs]).astype(np.float64)
+        score = np.concatenate([o[2] for o in outs]).astype(np.float64)
+        if with_membership:
+            mvec = np.concatenate([o[3] for o in outs]).astype(np.float64)
+            return label, prob, score, mvec
+        return label, prob, score
+
+    def warmup(self, with_membership: bool = False) -> dict:
+        """AOT-compile every bucket (zeros through each shape, blocking), so
+        steady-state serving never compiles. Returns ``{"buckets": [...],
+        "wall_s": float, "jit_compiles": int}`` — the compile count uses
+        ``utils/telemetry.compile_counter`` deltas (0 on a warm jit cache).
+        """
+        from hdbscan_tpu.utils.telemetry import compile_counter
+
+        counter = compile_counter()
+        before = counter()
+        t0 = time.perf_counter()
+        d = self.model.data.shape[1]
+        with self._lock:
+            for bucket in self.buckets:
+                staged = self._stage(np.zeros((1, d)), bucket)
+                jax.block_until_ready(self._dispatch(staged, bucket, False))
+                if with_membership:
+                    staged = self._stage(np.zeros((1, d)), bucket)
+                    jax.block_until_ready(self._dispatch(staged, bucket, True))
+        wall = time.perf_counter() - t0
+        info = {
+            "buckets": list(self.buckets),
+            "wall_s": round(wall, 6),
+            "jit_compiles": counter() - before,
+        }
+        if self.tracer is not None:
+            self.tracer("predict_warmup", **{**info, "wall_s": info["wall_s"]})
+        return info
+
+
+def _predictor_for(model, backend, max_batch, tracer) -> Predictor:
+    """Per-model predictor cache so the functional API reuses device state
+    (and jit caches) across calls instead of re-staging per call."""
+    cache = model.__dict__.setdefault("_predictor_cache", {})
+    key = (backend, max_batch)
+    if key not in cache:
+        cache[key] = Predictor(
+            model, backend=backend, max_batch=max_batch, tracer=tracer
+        )
+    pred = cache[key]
+    if tracer is not None:
+        pred.tracer = tracer
+    return pred
+
+
+def approximate_predict(
+    model, X, backend: str = "auto", max_batch: int = 256, tracer=None
+):
+    """hdbscan-style ``(labels, probabilities)`` for unseen points ``X``
+    against a fitted :class:`~hdbscan_tpu.serve.artifact.ClusterModel`."""
+    labels, prob, _ = _predictor_for(model, backend, max_batch, tracer).predict(X)
+    return labels, prob
+
+
+def outlier_scores(
+    model, X, backend: str = "auto", max_batch: int = 256, tracer=None
+):
+    """GLOSH outlier scores for unseen points (score of the level at which
+    each query attaches to the fitted hierarchy; clipped at 0)."""
+    return _predictor_for(model, backend, max_batch, tracer).predict(X)[2]
+
+
+def membership_vectors(
+    model, X, backend: str = "auto", max_batch: int = 256, tracer=None
+):
+    """Soft clustering: an (n, S) matrix over ``model.selected_ids`` —
+    inverse-mutual-reachability weights to each selected cluster's nearest
+    fitted exemplar in the query's k-NN list, normalized per row (zero rows
+    for queries whose neighborhood touches no selected cluster)."""
+    return _predictor_for(model, backend, max_batch, tracer).predict(
+        X, with_membership=True
+    )[3]

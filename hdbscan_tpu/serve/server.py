@@ -1,0 +1,189 @@
+"""Stdlib HTTP inference server over a fitted ClusterModel.
+
+Endpoints:
+
+- ``POST /predict`` — body ``{"points": [[...], ...]}`` (optionally
+  ``"membership": true``); responds ``{"labels", "probabilities",
+  "outlier_scores"}`` (plus ``"membership"`` + ``"selected_ids"`` when
+  requested). Plain predicts route through the
+  :class:`~hdbscan_tpu.serve.batcher.MicroBatcher`, so concurrent clients
+  coalesce into shared bucket dispatches.
+- ``GET /healthz`` — model summary, backend, warmed buckets, batcher
+  coalescing stats, uptime.
+
+``http.server.ThreadingHTTPServer`` only — no new dependencies; the device
+is still single-dispatcher because every handler thread funnels into the
+batcher's worker (or the predictor's internal lock for membership calls).
+Latency observability comes from the ``predict_batch`` trace events the
+predictor emits; the CLI ``serve`` command turns those into p50/p95/p99 in
+the run report (``utils/telemetry.predict_latency_section``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from hdbscan_tpu.serve.batcher import MicroBatcher
+from hdbscan_tpu.serve.predict import Predictor
+
+#: Refuse request bodies above this size (64 MiB ~ a 1M x 8-dim f64 batch);
+#: a streaming client should chunk instead of shipping one giant body.
+MAX_BODY_BYTES = 64 << 20
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "hdbscan-tpu-serve/1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # route access logs away from stderr
+        if getattr(self.server, "verbose", False):
+            super().log_message(fmt, *args)
+
+    def _json(self, code: int, obj: dict) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        if self.path.split("?")[0] != "/healthz":
+            self._json(404, {"error": f"unknown path {self.path!r}"})
+            return
+        self._json(200, self.server.cluster_server.health())
+
+    def do_POST(self):  # noqa: N802 - http.server API
+        if self.path.split("?")[0] != "/predict":
+            self._json(404, {"error": f"unknown path {self.path!r}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            if length > MAX_BODY_BYTES:
+                self._json(413, {"error": f"body exceeds {MAX_BODY_BYTES} bytes"})
+                return
+            payload = json.loads(self.rfile.read(length).decode())
+            points = np.asarray(payload["points"], np.float64)
+            membership = bool(payload.get("membership", False))
+        except (KeyError, ValueError, TypeError, json.JSONDecodeError) as e:
+            self._json(400, {"error": f"bad request: {e}"})
+            return
+        try:
+            out = self.server.cluster_server.predict(points, membership)
+        except ValueError as e:  # shape/dim mismatches are client errors
+            self._json(400, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 - surface, don't crash the server
+            self._json(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._json(200, out)
+
+
+class ClusterServer:
+    """Predictor + batcher + HTTP front, as one closeable unit.
+
+    Construction warms every bucket (AOT), so the first real request already
+    hits a compiled program; ``port=0`` binds an ephemeral port (tests).
+    """
+
+    def __init__(
+        self,
+        model,
+        backend: str = "auto",
+        max_batch: int = 256,
+        linger_s: float = 0.002,
+        host: str = "127.0.0.1",
+        port: int = 8799,
+        tracer=None,
+        warmup: bool = True,
+        verbose: bool = False,
+    ):
+        self.model = model
+        self.predictor = Predictor(
+            model, backend=backend, max_batch=max_batch, tracer=tracer
+        )
+        self.warmup_info = self.predictor.warmup() if warmup else None
+        self.batcher = MicroBatcher(self.predictor, linger_s=linger_s)
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.cluster_server = self
+        self._httpd.verbose = verbose
+        self.host, self.port = self._httpd.server_address[:2]
+        self._t0 = time.monotonic()
+        self._thread: threading.Thread | None = None
+        self._closed = False
+
+    # -- request paths -----------------------------------------------------
+
+    def predict(self, points: np.ndarray, membership: bool = False) -> dict:
+        if membership:
+            # Membership needs the 4-output kernel variant; it bypasses the
+            # batcher and relies on the predictor's internal dispatch lock.
+            labels, prob, score, mvec = self.predictor.predict(
+                points, with_membership=True
+            )
+            return {
+                "labels": labels.tolist(),
+                "probabilities": [round(p, 6) for p in prob.tolist()],
+                "outlier_scores": [round(s, 6) for s in score.tolist()],
+                "membership": np.round(mvec, 6).tolist(),
+                "selected_ids": self.model.selected_ids.tolist(),
+            }
+        labels, prob, score = self.batcher.predict(points)
+        return {
+            "labels": labels.tolist(),
+            "probabilities": [round(p, 6) for p in prob.tolist()],
+            "outlier_scores": [round(s, 6) for s in score.tolist()],
+        }
+
+    def health(self) -> dict:
+        return {
+            "status": "ok",
+            "model": self.model.summary(),
+            "backend": self.predictor.backend,
+            "buckets": list(self.predictor.buckets),
+            "warmup": self.warmup_info,
+            "batcher": self.batcher.stats,
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ClusterServer":
+        """Serve on a daemon thread (tests / embedding); returns self."""
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="predict-http",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        """Serve on the calling thread until interrupted (CLI path)."""
+        try:
+            self._httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self.batcher.close()
+
+    def __enter__(self) -> "ClusterServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -302,6 +302,49 @@ def build_report(
     return report
 
 
+def latency_percentiles(walls: list[float] | tuple[float, ...]) -> dict:
+    """Nearest-rank p50/p95/p99 (plus count/mean/max) over per-batch walls.
+
+    Nearest-rank (index ``ceil(q*n) - 1`` into the sorted walls) rather than
+    interpolation so ``scripts/check_trace.py`` can recompute the exact same
+    numbers stdlib-only and cross-check the report against the trace at
+    1e-6.
+    """
+    ws = sorted(float(w) for w in walls)
+    n = len(ws)
+    if n == 0:
+        return {"count": 0}
+    import math
+
+    def rank(q: float) -> float:
+        return ws[max(0, math.ceil(q * n) - 1)]
+
+    return {
+        "count": n,
+        "mean_s": round(sum(ws) / n, 6),
+        "p50_s": round(rank(0.50), 6),
+        "p95_s": round(rank(0.95), 6),
+        "p99_s": round(rank(0.99), 6),
+        "max_s": round(ws[-1], 6),
+    }
+
+
+def predict_latency_section(tracer: Tracer) -> dict | None:
+    """The run report's ``predict_latency`` section: percentiles over every
+    ``predict_batch`` event plus total rows served and rows/s; None when the
+    run served no predictions (the section is omitted, not empty)."""
+    events = [e for e in tracer.events if e.name == "predict_batch"]
+    if not events:
+        return None
+    section = latency_percentiles([e.wall_s for e in events])
+    rows = sum(int(e.fields.get("rows", 0)) for e in events)
+    wall = sum(e.wall_s for e in events)
+    section["rows"] = rows
+    if wall > 0:
+        section["rows_per_s"] = round(rows / wall, 1)
+    return section
+
+
 def write_report(path: str, report: dict) -> None:
     with open(path, "w", encoding="utf-8") as f:
         json.dump(json_sanitize(report), f, indent=2, sort_keys=False)

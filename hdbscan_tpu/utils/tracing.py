@@ -153,6 +153,11 @@ class Tracer:
         """Summed wall seconds of all events with this stage name."""
         return sum(e.wall_s for e in self.events if e.name == name)
 
+    def walls(self, name: str) -> list[float]:
+        """Per-event wall seconds of every event with this stage name, in
+        emission order (latency-percentile inputs — ``predict_batch``)."""
+        return [e.wall_s for e in self.events if e.name == name]
+
     def summary(self) -> str:
         """One line per distinct stage — count and summed wall — sorted by
         summed wall descending, so the expensive phases lead and new stages

@@ -18,10 +18,16 @@ strictly increasing per (process, device). Host finalize events
 ``tree_*`` stage must be one of the five known finalize stages
 (merge_forest/condense/propagate/labels/glosh) and must carry a string
 ``backend`` tag naming the engine that ran (``native``/``python`` for the
-merge forest, ``vectorized``/``reference`` for the tree stages). Given a report
-(``utils/telemetry.REPORT_SCHEMA``), additionally cross-checks that the
-report's per-phase wall totals equal the trace's per-stage wall sums within
-1e-6 — the round-trip guarantee the tier-1 e2e test pins.
+merge forest, ``vectorized``/``reference`` for the tree stages). Serving
+events (``serve/predict.py``, README "Serving") add three: every
+``predict_batch`` event must carry a power-of-two ``bucket``, ``rows`` in
+``[1, bucket]``, and a per-process strictly increasing ``batch_seq``. Given
+a report (``utils/telemetry.REPORT_SCHEMA``), additionally cross-checks
+that the report's per-phase wall totals equal the trace's per-stage wall
+sums within 1e-6, and — when the report carries a ``predict_latency``
+section — that its nearest-rank p50/p95/p99 recompute exactly from the
+trace's ``predict_batch`` walls (same 1e-6 tolerance) — the round-trip
+guarantees the tier-1 e2e tests pin.
 
 Exit code 0 = valid; 1 = any violation (all violations printed). Pure
 stdlib on purpose: the validator must run where the run artifacts land,
@@ -65,6 +71,7 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
     errors: list[str] = []
     last_seq: dict = {}  # per-process strictly-increasing seq check
     last_dev_seq: dict = {}  # per-(process, device) seq for ring wall events
+    last_batch_seq: dict = {}  # per-process batch_seq for predict_batch
     with open(path, encoding="utf-8") as f:
         for lineno, line in enumerate(f, 1):
             line = line.strip()
@@ -127,6 +134,39 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
                         f"{path}:{lineno}: ppermute_steps={steps!r} != "
                         f"devices - 1 ({devices} devices)"
                     )
+            # Serving invariants (serve/predict.py): batches dispatch into
+            # power-of-two buckets (the zero-recompile bucket set), never
+            # carry more real rows than the bucket holds, and the dispatch
+            # order is totally ordered per process.
+            if stage == "predict_batch":
+                bucket = ev.get("bucket")
+                rows = ev.get("rows")
+                if not isinstance(bucket, int) or bucket < 1 or (
+                    bucket & (bucket - 1)
+                ):
+                    errors.append(
+                        f"{path}:{lineno}: predict_batch bucket={bucket!r} "
+                        f"is not a power of two"
+                    )
+                elif not isinstance(rows, int) or not (1 <= rows <= bucket):
+                    errors.append(
+                        f"{path}:{lineno}: predict_batch rows={rows!r} not in "
+                        f"[1, bucket={bucket}]"
+                    )
+                bseq = ev.get("batch_seq")
+                if not isinstance(bseq, int):
+                    errors.append(
+                        f"{path}:{lineno}: predict_batch lacks integer "
+                        f"'batch_seq'"
+                    )
+                else:
+                    prev = last_batch_seq.get(proc)
+                    if prev is not None and bseq <= prev:
+                        errors.append(
+                            f"{path}:{lineno}: batch_seq {bseq} not "
+                            f"increasing (prev {prev})"
+                        )
+                    last_batch_seq[proc] = bseq
             # Per-device wall events: each device's timeline must be ordered.
             device = ev.get("device")
             if isinstance(device, int) and isinstance(seq, int):
@@ -191,7 +231,52 @@ def validate_report(
         for stage in phases:
             if stage not in sums:
                 errors.append(f"{path}: report phase {stage!r} absent from trace")
+        latency = report.get("predict_latency")
+        if latency is not None:
+            errors += _check_predict_latency(path, latency, trace_events)
     return report, errors
+
+
+def _check_predict_latency(
+    path: str, latency: dict, trace_events: list[dict]
+) -> list[str]:
+    """Recompute the report's predict_latency percentiles from the trace's
+    ``predict_batch`` walls — nearest-rank (index ceil(q*n)-1 into the
+    sorted walls), the same formula as ``utils/telemetry.
+    latency_percentiles``, duplicated stdlib-only on purpose."""
+    errors: list[str] = []
+    if not isinstance(latency, dict):
+        return [f"{path}: 'predict_latency' is not an object"]
+    walls = sorted(
+        float(ev.get("wall_s") or 0.0)
+        for ev in trace_events
+        if ev.get("stage") == "predict_batch"
+    )
+    n = len(walls)
+    if latency.get("count") != n:
+        errors.append(
+            f"{path}: predict_latency count {latency.get('count')!r} != "
+            f"{n} predict_batch trace events"
+        )
+    if n == 0:
+        return errors
+    want = {
+        "p50_s": walls[max(0, math.ceil(0.50 * n) - 1)],
+        "p95_s": walls[max(0, math.ceil(0.95 * n) - 1)],
+        "p99_s": walls[max(0, math.ceil(0.99 * n) - 1)],
+        "max_s": walls[-1],
+        "mean_s": sum(walls) / n,
+    }
+    for key, val in want.items():
+        got = latency.get(key)
+        if not isinstance(got, (int, float)) or not math.isclose(
+            float(got), val, rel_tol=0.0, abs_tol=WALL_TOLERANCE
+        ):
+            errors.append(
+                f"{path}: predict_latency {key} {got!r} != trace-derived "
+                f"{round(val, 6)} (tol {WALL_TOLERANCE})"
+            )
+    return errors
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -1,0 +1,138 @@
+"""End-to-end serving round-trips: fit --model-out -> predict via the CLI
+(with trace/report validation through scripts/check_trace.py), and the HTTP
+server against live requests."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.cli import main
+from scripts import check_trace
+
+
+def _write_blobs(tmp_path, n_per=60, centers=((0, 0, 0), (6, 6, 6), (0, 8, 0))):
+    rng = np.random.default_rng(5)
+    pts = np.concatenate(
+        [rng.normal(np.asarray(c, float), 0.4, (n_per, 3)) for c in centers]
+    )
+    path = tmp_path / "blobs.txt"
+    np.savetxt(path, pts, fmt="%.6f", delimiter=",")
+    return str(path), pts
+
+
+def test_fit_predict_cli_roundtrip(tmp_path):
+    """fit --model-out -> predict reproduces the partition, and the predict
+    trace/report pass the validator (predict_batch invariants + latency
+    percentile cross-check)."""
+    data_path, _ = _write_blobs(tmp_path)
+    model_path = str(tmp_path / "model.npz")
+    pred_path = str(tmp_path / "pred.csv")
+    trace = str(tmp_path / "trace.jsonl")
+    report = str(tmp_path / "report.json")
+
+    rc = main(
+        ["fit", f"file={data_path}", "minPts=8", "minClSize=8",
+         f"out_dir={tmp_path}", "--model-out", model_path]
+    )
+    assert rc == 0
+
+    rc = main(
+        ["predict", "--model", model_path, "--points", data_path,
+         "--out", pred_path, "predict_batch=32",
+         "--trace-out", trace, "--report", report]
+    )
+    assert rc == 0
+
+    events, errors = check_trace.validate_trace(trace)
+    assert not errors, errors
+    rep, errors = check_trace.validate_report(report, trace_events=events)
+    assert not errors, errors
+
+    stages = {e["stage"] for e in events}
+    assert {"model_load", "load_points", "predict_warmup",
+            "predict_batch"} <= stages
+    batches = [e for e in events if e["stage"] == "predict_batch"]
+    assert all(e["bucket"] in (8, 16, 32) for e in batches)
+    assert "predict_latency" in rep
+    assert rep["predict_latency"]["count"] == len(batches)
+    assert rep["predict_latency"]["rows"] == 180
+
+    fit_labels = np.loadtxt(
+        tmp_path / "blobs_partition.csv", delimiter=","
+    ).ravel().astype(int)
+    pred = np.loadtxt(pred_path, delimiter=",", skiprows=1)
+    mask = fit_labels > 0
+    np.testing.assert_array_equal(pred[:, 0].astype(int)[mask], fit_labels[mask])
+    assert np.all(pred[:, 1] >= 0) and np.all(pred[:, 1] <= 1)
+
+
+def test_predict_cli_refuses_bad_model(tmp_path):
+    data_path, _ = _write_blobs(tmp_path, n_per=20)
+    missing = str(tmp_path / "nope.npz")
+    assert main(["predict", "--model", missing, "--points", data_path]) == 2
+    assert main(["predict", "--model", missing]) == 2  # --points required
+
+
+def test_http_server_roundtrip(tmp_path):
+    from hdbscan_tpu import HDBSCANParams
+    from hdbscan_tpu.models import hdbscan
+    from hdbscan_tpu.serve.server import ClusterServer
+
+    _, pts = _write_blobs(tmp_path)
+    params = HDBSCANParams(min_points=8, min_cluster_size=8)
+    result = hdbscan.fit(pts, params)
+    model = result.to_cluster_model(pts, params)
+
+    with ClusterServer(model, max_batch=32, port=0).start() as srv:
+        url = f"http://127.0.0.1:{srv.port}"
+        health = json.loads(urllib.request.urlopen(url + "/healthz").read())
+        assert health["status"] == "ok"
+        assert health["model"]["n_train"] == len(pts)
+        assert health["warmup"]["buckets"] == [8, 16, 32]
+
+        body = json.dumps({"points": pts[:10].tolist()}).encode()
+        resp = json.loads(
+            urllib.request.urlopen(
+                urllib.request.Request(url + "/predict", data=body)
+            ).read()
+        )
+        np.testing.assert_array_equal(
+            resp["labels"], np.asarray(result.labels)[:10]
+        )
+        assert len(resp["probabilities"]) == 10
+        assert len(resp["outlier_scores"]) == 10
+
+        body = json.dumps(
+            {"points": [pts[0].tolist()], "membership": True}
+        ).encode()
+        resp = json.loads(
+            urllib.request.urlopen(
+                urllib.request.Request(url + "/predict", data=body)
+            ).read()
+        )
+        assert resp["selected_ids"] == model.selected_ids.tolist()
+        assert len(resp["membership"][0]) == len(model.selected_ids)
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    url + "/predict", data=b'{"points": [[1, 2]]}'
+                )
+            )
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(url + "/unknown")
+        assert e.value.code == 404
+    assert srv.batcher.stats["rows"] == 10
+
+
+def test_legacy_bare_invocation_still_fits(tmp_path):
+    """The reference-compatible key=value form (no subcommand) keeps working."""
+    data_path, _ = _write_blobs(tmp_path, n_per=20)
+    rc = main([f"file={data_path}", "minPts=4", "minClSize=4",
+               f"out_dir={tmp_path}"])
+    assert rc == 0
+    assert (tmp_path / "blobs_partition.csv").exists()

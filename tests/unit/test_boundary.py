@@ -335,3 +335,38 @@ def test_boundary_block_pruning_matches_full_sweep(rng):
 
     ari = adjusted_rand_index(r_pruned.labels, r_full.labels)
     assert ari > 0.999, f"pruned-vs-full boundary ARI {ari}"
+
+
+@pytest.mark.xfail(
+    strict=False,
+    reason="Known quality gap (round-5 verdict): on hard overlapping blobs "
+    "the boundary-mode hybrid cores scored ARI 0.3749 vs 0.5947 for the "
+    "plain per-block pipeline — the seam re-weighting can erase contrast "
+    "when clusters genuinely touch. Tracked as a regression guard: the "
+    "xfail flips to pass (and should then be tightened to a hard assert) "
+    "once the boundary selection handles overlapping tails. See README "
+    "'Scaling out'.",
+)
+def test_boundary_mode_ari_no_worse_than_plain(rng):
+    """Ground-truth ARI of boundary-mode vs the plain recursive-sampling
+    pipeline on overlapping blobs — the configuration the round-5 verdict
+    measured the gap on (dense tails across block seams)."""
+    from tests.conftest import make_blobs
+
+    from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+
+    data, truth = make_blobs(rng, n=4000, d=3, centers=6, spread=0.9)
+    truth = truth + 1  # ARI helper treats 0 as noise
+    params = HDBSCANParams(
+        min_points=6, min_cluster_size=80, processing_units=512, seed=3
+    )
+    r_plain = mr_hdbscan.fit(data, params, max_levels=16)
+    r_bound = mr_hdbscan.fit(
+        data, params.replace(boundary_quality=0.1), max_levels=16
+    )
+    ari_plain = adjusted_rand_index(r_plain.labels, truth)
+    ari_bound = adjusted_rand_index(r_bound.labels, truth)
+    assert ari_bound >= ari_plain - 0.02, (
+        f"boundary-mode ARI {ari_bound:.4f} trails plain {ari_plain:.4f} "
+        "beyond tolerance on overlapping blobs"
+    )

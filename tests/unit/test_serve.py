@@ -1,0 +1,295 @@
+"""Unit tests for the serving subsystem (hdbscan_tpu/serve/): artifact
+round-trips, approximate_predict semantics, the zero-recompile bucket
+contract, and the micro-batcher."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu import HDBSCANParams
+from hdbscan_tpu.models import exact, hdbscan, mr_hdbscan
+from hdbscan_tpu.serve import (
+    MODEL_SCHEMA,
+    ClusterModel,
+    MicroBatcher,
+    Predictor,
+    approximate_predict,
+    membership_vectors,
+    outlier_scores,
+)
+from tests.conftest import make_blobs
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    """One exact fit shared across the module: (data, params, result, model)."""
+    rng = np.random.default_rng(7)
+    data, _ = make_blobs(rng, n=300, d=3, centers=3, spread=0.2)
+    params = HDBSCANParams(min_points=8, min_cluster_size=8)
+    result = hdbscan.fit(data, params)
+    return data, params, result, ClusterModel.from_fit_result(result, data, params)
+
+
+# -- artifact ---------------------------------------------------------------
+
+
+def test_artifact_save_load_roundtrip(tmp_path, fitted):
+    data, params, result, model = fitted
+    path = model.save(str(tmp_path / "model.npz"))
+    loaded = ClusterModel.load(path, params=params, data=data)
+    assert loaded.schema == MODEL_SCHEMA
+    assert loaded.mode == "exact"
+    np.testing.assert_array_equal(loaded.labels, np.asarray(result.labels))
+    np.testing.assert_array_equal(loaded.data, model.data)
+    np.testing.assert_array_equal(loaded.sel_anc, model.sel_anc)
+    s = loaded.summary()
+    assert s["n_train"] == len(data) and s["n_selected"] == len(loaded.selected_ids)
+
+
+def test_load_refuses_schema_mismatch(tmp_path, fitted):
+    *_, model = fitted
+    import dataclasses
+
+    other = dataclasses.replace(model, schema="hdbscan-tpu-model/999")
+    path = other.save(str(tmp_path / "future.npz"))
+    with pytest.raises(ValueError, match="schema"):
+        ClusterModel.load(path)
+
+
+def test_load_refuses_fingerprint_mismatch(tmp_path, fitted):
+    data, params, _, model = fitted
+    path = model.save(str(tmp_path / "model.npz"))
+    with pytest.raises(ValueError, match="refusing to serve"):
+        ClusterModel.load(path, params=params.replace(min_points=9))
+    with pytest.raises(ValueError, match="refusing to serve"):
+        ClusterModel.load(path, data=data + 1.0)
+    # matching caller expectations load fine
+    ClusterModel.load(path, params=params, data=data)
+
+
+def test_load_refuses_corrupt_payload(tmp_path, fitted):
+    data, params, _, model = fitted
+    path = model.save(str(tmp_path / "model.npz"))
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files}
+    arrays["data"] = arrays["data"] + 1e-3  # payload no longer matches digest
+    with open(path, "wb") as f:
+        np.savez_compressed(f, **arrays)
+    with pytest.raises(ValueError, match="corrupt"):
+        ClusterModel.load(path)
+
+
+# -- approximate_predict ----------------------------------------------------
+
+
+def test_training_points_reproduce_fit_labels(fitted):
+    data, params, result, model = fitted
+    labels, prob = approximate_predict(model, data)
+    np.testing.assert_array_equal(labels, np.asarray(result.labels))
+    fit_labels = np.asarray(result.labels)
+    assert np.all(prob[fit_labels > 0] > 0)
+    assert np.all(prob[fit_labels == 0] == 0)
+
+
+def test_training_roundtrip_mr_fit():
+    rng = np.random.default_rng(11)
+    data, _ = make_blobs(rng, n=2000, d=3, centers=4, spread=0.2)
+    params = HDBSCANParams(
+        min_points=6, min_cluster_size=40, processing_units=512
+    )
+    result = mr_hdbscan.fit(data, params)
+    model = ClusterModel.from_fit_result(result, data, params)
+    assert model.mode == "mr"
+    labels, _ = approximate_predict(model, data)
+    fit_labels = np.asarray(result.labels)
+    mask = fit_labels > 0
+    np.testing.assert_array_equal(labels[mask], fit_labels[mask])
+
+
+def test_training_roundtrip_5k_synthetic_exact_and_mr():
+    # The acceptance-criteria scale: 5k rows, both fit families.
+    rng = np.random.default_rng(13)
+    data, _ = make_blobs(rng, n=5000, d=3, centers=5, spread=0.25)
+    params = HDBSCANParams(
+        min_points=8, min_cluster_size=100, processing_units=2048
+    )
+    for fit_fn in (exact.fit, mr_hdbscan.fit):
+        result = fit_fn(data, params)
+        model = ClusterModel.from_fit_result(result, data, params)
+        labels, _ = approximate_predict(model, data)
+        fit_labels = np.asarray(result.labels)
+        mask = fit_labels > 0
+        np.testing.assert_array_equal(
+            labels[mask], fit_labels[mask],
+            err_msg=f"train-label round-trip broke under {fit_fn.__module__}",
+        )
+
+
+def test_training_roundtrip_dedup_fit():
+    # Deduplicated fits store per-row labels but a vertex-space tree; the
+    # artifact must translate through dedup_inverse.
+    rng = np.random.default_rng(17)
+    base, _ = make_blobs(rng, n=200, d=3, centers=3, spread=0.2)
+    data = np.concatenate([base, base[:50]])  # exact duplicates
+    params = HDBSCANParams(min_points=5, min_cluster_size=10, dedup_points=True)
+    result = exact.fit(data, params)
+    assert result.dedup_inverse is not None
+    model = ClusterModel.from_fit_result(result, data, params)
+    labels, _ = approximate_predict(model, data)
+    np.testing.assert_array_equal(labels, np.asarray(result.labels))
+
+
+def test_iris_roundtrip(iris):
+    params = HDBSCANParams(min_points=8, min_cluster_size=8)
+    result = hdbscan.fit(iris, params)
+    model = ClusterModel.from_fit_result(result, iris, params)
+    labels, _ = approximate_predict(model, iris)
+    fit_labels = np.asarray(result.labels)
+    mask = fit_labels > 0
+    np.testing.assert_array_equal(labels[mask], fit_labels[mask])
+
+
+def test_novel_points(fitted):
+    data, params, result, model = fitted
+    centers = np.stack(
+        [data[np.asarray(result.labels) == s].mean(axis=0)
+         for s in model.selected_ids]
+    )
+    labels, prob = approximate_predict(model, centers)
+    assert np.all(labels > 0) and np.all(prob > 0.5)
+    far = np.full((1, 3), 1e3)
+    fl, fp = approximate_predict(model, far)
+    assert fl[0] == 0 and fp[0] == 0.0
+    assert outlier_scores(model, far)[0] > 0.9
+
+
+def test_membership_vectors_columns(fitted):
+    data, params, result, model = fitted
+    mv = membership_vectors(model, data)
+    assert mv.shape == (len(data), len(model.selected_ids))
+    sums = mv.sum(axis=1)
+    assert np.all((sums < 1 + 1e-6))
+    # confident interior points: argmax column agrees with the fitted label
+    labels = np.asarray(result.labels)
+    strong = mv.max(axis=1) > 0.9
+    assert strong.any()
+    picked = model.selected_ids[np.argmax(mv[strong], axis=1)]
+    np.testing.assert_array_equal(picked, labels[strong])
+
+
+def test_min_pts_one_roundtrip():
+    rng = np.random.default_rng(23)
+    data, _ = make_blobs(rng, n=150, d=2, centers=2, spread=0.1)
+    params = HDBSCANParams(min_points=1, min_cluster_size=5)
+    result = hdbscan.fit(data, params)
+    model = ClusterModel.from_fit_result(result, data, params)
+    labels, _ = approximate_predict(model, data)
+    fit_labels = np.asarray(result.labels)
+    mask = fit_labels > 0
+    np.testing.assert_array_equal(labels[mask], fit_labels[mask])
+
+
+def test_predict_rejects_wrong_dims(fitted):
+    *_, model = fitted
+    with pytest.raises(ValueError, match="dims"):
+        approximate_predict(model, np.zeros((4, 7)))
+
+
+# -- buckets / recompiles ---------------------------------------------------
+
+
+def test_zero_recompiles_after_warmup(fitted):
+    # The tentpole's serving guarantee: after AOT bucket warmup, 100 batches
+    # of mixed sizes (including chunked oversize requests) compile nothing.
+    from hdbscan_tpu.utils.telemetry import compile_counter
+
+    data, *_, model = fitted[0], fitted[3]
+    pred = Predictor(model, max_batch=64)
+    assert pred.buckets == [8, 16, 32, 64]
+    pred.warmup()
+    counter = compile_counter()
+    before = counter()
+    rng = np.random.default_rng(29)
+    for _ in range(100):
+        rows = int(rng.integers(1, 130))  # spans sub-bucket AND chunked
+        pred.predict(rng.normal(0, 3, (rows, 3)))
+    assert counter() - before == 0, "steady-state serving recompiled"
+
+
+def test_bucket_shapes(fitted):
+    *_, model = fitted
+    pred = Predictor(model, max_batch=100)  # rounds up to 128
+    assert pred.buckets == [8, 16, 32, 64, 128]
+    assert pred.bucket_for(1) == 8
+    assert pred.bucket_for(9) == 16
+    assert pred.bucket_for(500) == 128
+
+
+def test_predict_batch_trace_events(fitted):
+    from hdbscan_tpu.utils.tracing import Tracer
+
+    data, *_, model = fitted[0], fitted[3]
+    tracer = Tracer()
+    pred = Predictor(model, max_batch=16, tracer=tracer)
+    pred.warmup()
+    pred.predict(data[:40])  # chunks into 16+16+8
+    evs = [e for e in tracer.events if e.name == "predict_batch"]
+    assert [e.fields["bucket"] for e in evs] == [16, 16, 8]
+    assert [e.fields["rows"] for e in evs] == [16, 16, 8]
+    assert [e.fields["batch_seq"] for e in evs] == [0, 1, 2]
+
+
+# -- micro-batcher ----------------------------------------------------------
+
+
+def test_batcher_matches_direct_predict(fitted):
+    data, *_, model = fitted[0], fitted[3]
+    pred = Predictor(model, max_batch=64)
+    pred.warmup()
+    want_labels, want_prob, _ = pred.predict(data[:30])
+    with MicroBatcher(pred, linger_s=0.01) as mb:
+        futs = [mb.submit(data[i : i + 10]) for i in range(0, 30, 10)]
+        got = [f.result(timeout=30) for f in futs]
+    labels = np.concatenate([g[0] for g in got])
+    prob = np.concatenate([g[1] for g in got])
+    np.testing.assert_array_equal(labels, want_labels)
+    np.testing.assert_allclose(prob, want_prob)
+    assert mb.stats["rows"] == 30
+    assert mb.stats["batches"] <= 3  # coalesced (usually 1)
+
+
+def test_batcher_concurrent_submitters(fitted):
+    data, *_, model = fitted[0], fitted[3]
+    pred = Predictor(model, max_batch=64)
+    pred.warmup()
+    direct = pred.predict(data)[0]
+    results = {}
+
+    def worker(i):
+        results[i] = mb.predict(data[i * 25 : (i + 1) * 25])[0]
+
+    with MicroBatcher(pred, linger_s=0.005) as mb:
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+    for i in range(8):
+        np.testing.assert_array_equal(results[i], direct[i * 25 : (i + 1) * 25])
+
+
+def test_batcher_rejects_after_close(fitted):
+    *_, model = fitted
+    pred = Predictor(model, max_batch=8)
+    mb = MicroBatcher(pred)
+    mb.close()
+    mb.close()  # idempotent
+    with pytest.raises(RuntimeError):
+        mb.submit(np.zeros((1, 3)))
+
+
+def test_to_cluster_model_methods(fitted):
+    data, params, result, _ = fitted
+    model = result.to_cluster_model(data, params)
+    assert isinstance(model, ClusterModel) and model.mode == "exact"
