@@ -39,6 +39,12 @@ dispatch/transfer-bound, kernels are not worth optimizing" (ROADMAP r4 item
   rows — raw scan and ``exact.fit`` end-to-end. TPU targets: >= 0.8x linear
   scaling efficiency on 8 chips, no 1-chip regression vs host; CPU rows
   are wiring smoke checks marked ``cpu_smoke`` (see ``bench_ring_scan``).
+- ``rpforest_build`` / ``rpforest_e2e``: the approximate-neighbor engine
+  (``ops/rpforest.py``, README "Approximate neighbors") — forest build
+  wall, then ``rpforest_core_distances`` end-to-end against the exact
+  O(n^2 d) scan on the same rows, with recomputed recall@k and a paired
+  full-fit ARI-vs-exact. Acceptance: ``vs_exact >= 3`` at n=200k,
+  leaf_size=1024.
 
 FLOP convention matches ``utils/flops`` (2*rows*cols*d logical; the
 f32-HIGHEST cross matmul runs ~6 bf16 passes, so a perfectly MXU-bound
@@ -588,6 +594,117 @@ def bench_finalize(out_path, n=245_057, iters=3, seed=0, min_cluster_size=3000):
         _emit(out_path, row)
 
 
+def bench_rpforest(out_path, n=200_000, d=8, min_pts=16, k=16, trees=4,
+                   leaf_size=1024, rescan_rounds=1, iters=1, seed=0,
+                   ari_n=5000, recall_sample=256):
+    """Approximate-neighbor engine legs (README "Approximate neighbors").
+
+    - ``rpforest_build``: ``ops/rpforest.build_forest`` wall alone — T
+      trees of batched hyperplane rank-splits down to ``leaf_size`` leaves.
+    - ``rpforest_e2e``: ``rpforest_core_distances`` (build + per-leaf scan
+      + multi-tree merge + ``rescan_rounds`` neighbor-of-neighbor rounds)
+      against the exact ``knn_core_distances`` scan on the SAME rows. The
+      acceptance figure is ``vs_exact`` (target >= 3x at n=200k,
+      leaf_size=1024 — the exact scan is O(n^2 d), the forest
+      O(n * trees * leaf_size * d)), alongside query ``rows_per_s``,
+      ``recall_at_k`` measured here against a brute-force subsample, and
+      ``ari_vs_exact`` from a paired ``exact.fit`` at ``ari_n`` rows
+      (full-pipeline agreement, not just neighbor overlap).
+
+    The pool is a 32-center Gaussian mixture — clustered like real fits,
+    not a single isotropic blob that would flatter hyperplane splits.
+    """
+    from hdbscan_tpu.config import HDBSCANParams
+    from hdbscan_tpu.models import exact
+    from hdbscan_tpu.ops.rpforest import build_forest, rpforest_core_distances
+    from hdbscan_tpu.ops.tiled import knn_core_distances
+    from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+
+    rng = np.random.default_rng(seed)
+    centers = rng.uniform(-10, 10, (32, d))
+    data = (centers[rng.integers(0, 32, n)]
+            + rng.normal(0, 0.6, (n, d))).astype(np.float32)
+    platform = jax.devices()[0].platform
+    base = dict(
+        n=n, d=d, min_pts=min_pts, k=k, trees=trees, leaf_size=leaf_size,
+        rescan_rounds=rescan_rounds, seed=seed, platform=platform,
+        cpu_smoke=platform != "tpu", device=str(jax.devices()[0]),
+    )
+
+    def timed(fn):
+        walls = []
+        for _ in range(max(1, iters)):
+            t0 = time.perf_counter()
+            out = fn()
+            walls.append(time.perf_counter() - t0)
+        return out, float(np.median(walls)), [
+            round(min(walls), 4), round(max(walls), 4),
+        ]
+
+    forest, build_wall, build_spread = timed(
+        lambda: build_forest(data, trees=trees, leaf_size=leaf_size,
+                             seed=seed)
+    )
+    _emit(out_path, dict(
+        leg="rpforest_build", wall_s=round(build_wall, 4),
+        spread_s=build_spread, depth=forest.depth,
+        leaves=forest.num_leaves, max_leaf=forest.max_leaf, **base,
+    ))
+
+    _, exact_wall, exact_spread = timed(
+        lambda: knn_core_distances(
+            data, min_pts, "euclidean", backend="xla", fetch_knn=False
+        )
+    )
+    (_, knn, idx), rpf_wall, rpf_spread = timed(
+        lambda: rpforest_core_distances(
+            data, min_pts, "euclidean", k=k, trees=trees,
+            leaf_size=leaf_size, rescan_rounds=rescan_rounds, seed=seed,
+            return_indices=True, recall_sample=0,
+        )
+    )
+
+    # Recall vs a brute-force subsample (recomputed here, not trusted from
+    # the engine's own counters).
+    sample = np.linspace(0, n - 1, min(recall_sample, n)).astype(np.int64)
+    kk = idx.shape[1]
+    data64 = data.astype(np.float64)
+    ids = np.arange(n)
+    hits = []
+    for s in sample:
+        row = ((data64 - data64[s]) ** 2).sum(-1)
+        exact_ids = np.lexsort((ids, row))[:kk]  # (dist, id) tie-break
+        hits.append(len(np.intersect1d(exact_ids, idx[s])) / kk)
+    hits = float(np.mean(hits))
+
+    ari_rng = np.random.default_rng(seed + 1)
+    ari_data = (centers[ari_rng.integers(0, 32, ari_n)]
+                + ari_rng.normal(0, 0.6, (ari_n, d))).astype(np.float32)
+    params = HDBSCANParams(
+        min_points=min_pts, min_cluster_size=max(ari_n // 100, 16)
+    )
+    labels_exact = exact.fit(ari_data, params).labels
+    labels_rpf = exact.fit(ari_data, params.replace(
+        knn_index="rpforest", rpf_trees=trees,
+        rpf_leaf_size=min(leaf_size, max(ari_n // 8, 4 * k)),
+        rpf_rescan_rounds=rescan_rounds,
+    )).labels
+    query_wall = max(rpf_wall - build_wall, 1e-9)
+    _emit(out_path, dict(
+        leg="rpforest_e2e", wall_s=round(rpf_wall, 4), spread_s=rpf_spread,
+        build_wall_s=round(build_wall, 4),
+        exact_wall_s=round(exact_wall, 4), exact_spread_s=exact_spread,
+        vs_exact=round(exact_wall / rpf_wall, 3),
+        query_rows_per_s=round(n / query_wall, 1),
+        recall_at_k=round(float(hits), 4),
+        recall_rows=int(len(sample)),
+        ari_vs_exact=round(float(
+            adjusted_rand_index(labels_rpf, labels_exact)
+        ), 4),
+        ari_n=ari_n, **base,
+    ))
+
+
 def bench_predict(out_path, n=100_000, d=8, iters=50, seed=0, max_batch=256):
     """Serving predict-throughput leg (README "Serving").
 
@@ -654,7 +771,10 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(__file__), "devicebench_r6.jsonl"))
-    ap.add_argument("--legs", default="dispatch,exact,rescan,ring,finalize,predict")
+    ap.add_argument(
+        "--legs",
+        default="dispatch,exact,rescan,ring,finalize,rpforest,predict",
+    )
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument("--compile-cache", default="auto",
                     help="persistent XLA cache: auto, off, or a directory "
@@ -674,6 +794,14 @@ def main():
     ap.add_argument("--rescan-col-tile", type=int, default=8192)
     ap.add_argument("--rescan-tiles", default="64,1024",
                     help="comma-separated chunk sizes in 256-row tiles")
+    ap.add_argument("--rpf-n", type=int, default=200_000,
+                    help="rpforest-leg rows (the >=3x acceptance shape; "
+                         "use ~20000 for quick CPU smoke rows)")
+    ap.add_argument("--rpf-d", type=int, default=8)
+    ap.add_argument("--rpf-trees", type=int, default=4)
+    ap.add_argument("--rpf-leaf-size", type=int, default=1024)
+    ap.add_argument("--rpf-ari-n", type=int, default=5000,
+                    help="rows for the paired full-fit ARI-vs-exact check")
     ap.add_argument("--predict-n", type=int, default=100_000,
                     help="predict-leg training rows (use ~5000 for CPU "
                          "smoke rows — the leg fits an exact model first)")
@@ -696,6 +824,11 @@ def main():
         )
     if "finalize" in legs:
         bench_finalize(args.out, n=args.finalize_n, iters=args.iters)
+    if "rpforest" in legs:
+        bench_rpforest(
+            args.out, n=args.rpf_n, d=args.rpf_d, trees=args.rpf_trees,
+            leaf_size=args.rpf_leaf_size, ari_n=args.rpf_ari_n,
+        )
     if "predict" in legs:
         bench_predict(
             args.out, n=args.predict_n, d=args.predict_d,

@@ -10,6 +10,8 @@ Usage mirrors the reference's documented contract (``main/Main.java:534-614``)::
         [boundary=F] [boundary_alpha=F] [boundary_max_frac=F] [glue_alpha=F] \
         [glue_factor=N] [glue_rows=N] [block_pruning={true,false}] \
         [knn_backend={auto,xla,pallas,fused}] \
+        [knn_index={auto,exact,rpforest}] [knn_index_threshold=N] \
+        [rpf_trees=N] [rpf_leaf_size=N] [rpf_rescan=N] \
         [scan_backend={auto,host,ring}] \
         [tree_backend={auto,reference,vectorized}] \
         [consensus=N] [compat_cf={true,false}] \
@@ -24,6 +26,12 @@ device topology, env overrides), per-phase wall/GFLOP/MFU/compile aggregates,
 sampled device memory, and per-host phase walls when several processes ran.
 With both flags absent no telemetry file I/O happens.
 
+``knn_index`` picks the neighbor-graph TIER (README "Approximate
+neighbors"): ``exact`` (default) keeps the O(n²) scans bitwise-unchanged,
+``rpforest`` runs the sub-quadratic random-projection-forest engine
+(``ops/rpforest.py`` — ``rpf_trees`` trees of ≤ ``rpf_leaf_size``-point
+leaves with ``rpf_rescan`` neighbor-of-neighbor repair rounds), and
+``auto`` flips to rpforest at ``knn_index_threshold`` points.
 ``scan_backend`` picks the device scan engine for the k-NN/core and
 Borůvka sweeps (README "Scaling out"): ``host`` keeps the single-program
 tiled scans, ``ring`` shards rows over the mesh and circulates column
@@ -49,7 +57,8 @@ invocation still means ``fit`` (the reference-compatible form above)::
 
     python -m hdbscan_tpu fit file=<input> ... [--model-out MODEL.npz]
     python -m hdbscan_tpu predict --model MODEL.npz --points <input> \
-        [--out PRED.csv] [predict_backend={auto,xla,fused}] [predict_batch=N] \
+        [--out PRED.csv] [predict_backend={auto,xla,fused,rpforest}] \
+        [predict_batch=N] \
         [--trace-out PATH] [--report PATH]
     python -m hdbscan_tpu serve --model MODEL.npz [--host H] [--port P] \
         [predict_backend=...] [predict_batch=N] [--trace-out PATH] \

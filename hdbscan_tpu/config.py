@@ -193,6 +193,29 @@ class HDBSCANParams:
     #: falls back to the guarded XLA scan when the shape/metric/platform is
     #: ineligible, so the knob is safe under every parameterization.
     knn_backend: str = "auto"
+    #: Neighbor-graph TIER for the core-distance scans — orthogonal to
+    #: ``knn_backend`` (which picks the kernel evaluating distance tiles):
+    #: "exact" (default) runs the O(n² d) scans bitwise-unchanged,
+    #: "rpforest" runs the sub-quadratic random-projection-forest engine
+    #: (``ops/rpforest.py`` — T trees, per-leaf dense k-NN, multi-tree lex
+    #: merge, neighbor-of-neighbor rescan; README "Approximate neighbors"),
+    #: "auto" picks rpforest at ``n >= knn_index_threshold`` points.
+    knn_index: str = "exact"
+    #: The ``knn_index="auto"`` flip point (points). Below it fits stay
+    #: bitwise-exact; at/above it the rp-forest engine runs (measured >= 3x
+    #: core-distance win already at 200k on CPU, BENCH_r06.json).
+    knn_index_threshold: int = 262144
+    #: Random-projection trees per forest. More trees raise recall at
+    #: linear build/query cost; 4 trees + 1 rescan round measured >= 0.95
+    #: mean recall@16 across the tier-1 sweep datasets.
+    rpf_trees: int = 4
+    #: Leaf capacity of each tree (points). Per-leaf scan work is
+    #: O(n · leaf_size · d) per tree; internally clamped to >= 2k + 2 so
+    #: every leaf can supply a full candidate list.
+    rpf_leaf_size: int = 1024
+    #: Neighbor-of-neighbor rescan rounds after the multi-tree merge
+    #: (cross-leaf recall repair, PANDA-style). 0 disables.
+    rpf_rescan_rounds: int = 1
     #: Scale-out engine for the exact-path scans (core distances, Borůvka
     #: rounds, the mr-hdbscan glue + boundary rescan): "host" keeps the
     #: column-replicated scans (each device holds a full data copy; the
@@ -223,7 +246,10 @@ class HDBSCANParams:
     #: CLI commands): "xla" runs the guarded tiled scan, "fused" the Pallas
     #: fused-selection kernel (falls back to xla when the shape/metric/
     #: platform is ineligible — same safety contract as ``knn_backend``),
-    #: "auto" (default) picks fused on eligible TPU shapes.
+    #: "rpforest" queries the model artifact's random-projection-forest
+    #: index (requires a model saved from a ``knn_index=rpforest`` fit —
+    #: approximate neighbors, O(trees · leaf_size) per query instead of
+    #: O(n)), "auto" (default) picks fused on eligible TPU shapes.
     predict_backend: str = "auto"
     #: Largest serving bucket: query batches pad into power-of-two buckets
     #: up to this many rows (floor 8) and larger requests chunk. Every
@@ -279,11 +305,24 @@ class HDBSCANParams:
                 "knn_backend must be 'auto', 'xla', 'pallas' or 'fused', "
                 f"got {self.knn_backend!r}"
             )
-        if self.predict_backend not in ("auto", "xla", "fused"):
+        if self.predict_backend not in ("auto", "xla", "fused", "rpforest"):
             raise ValueError(
-                "predict_backend must be 'auto', 'xla' or 'fused', "
-                f"got {self.predict_backend!r}"
+                "predict_backend must be 'auto', 'xla', 'fused' or "
+                f"'rpforest', got {self.predict_backend!r}"
             )
+        if self.knn_index not in ("auto", "exact", "rpforest"):
+            raise ValueError(
+                "knn_index must be 'auto', 'exact' or 'rpforest', "
+                f"got {self.knn_index!r}"
+            )
+        if self.knn_index_threshold < 1:
+            raise ValueError("knn_index_threshold must be >= 1")
+        if self.rpf_trees < 1:
+            raise ValueError("rpf_trees must be >= 1")
+        if self.rpf_leaf_size < 4:
+            raise ValueError("rpf_leaf_size must be >= 4")
+        if self.rpf_rescan_rounds < 0:
+            raise ValueError("rpf_rescan_rounds must be >= 0")
         if self.predict_max_batch < 1:
             raise ValueError("predict_max_batch must be >= 1")
         if self.boundary_quality > 0 and self.dedup_points:
@@ -363,6 +402,11 @@ FLAG_FIELDS = {
     "consensus": ("consensus_draws", int),
     "block_pruning": ("boundary_block_pruning", _bool),
     "knn_backend": ("knn_backend", str),
+    "knn_index": ("knn_index", str),
+    "knn_index_threshold": ("knn_index_threshold", int),
+    "rpf_trees": ("rpf_trees", int),
+    "rpf_leaf_size": ("rpf_leaf_size", int),
+    "rpf_rescan": ("rpf_rescan_rounds", int),
     "scan_backend": ("scan_backend", str),
     "tree_backend": ("tree_backend", str),
     "compile_cache": ("compile_cache", str),

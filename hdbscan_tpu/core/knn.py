@@ -24,6 +24,31 @@ import jax.numpy as jnp
 from hdbscan_tpu.core.distances import self_distance_matrix
 
 
+def resolve_index_for(params, n: int) -> tuple[str, dict]:
+    """Resolve the configured neighbor-graph tier for an n-point job.
+
+    Returns ``(index, index_opts)`` ready for the ``ops.tiled`` /
+    ``ops.blockscan`` core-distance entry points: ``index`` is "exact" or
+    "rpforest" (``config.knn_index`` with "auto" resolved at the
+    ``knn_index_threshold`` flip point), and ``index_opts`` carries the
+    forest knobs (trees / leaf_size / rescan_rounds / seed) — empty for
+    the exact tier so the exact call sites stay byte-identical.
+    """
+    from hdbscan_tpu.ops.rpforest import resolve_knn_index
+
+    index = resolve_knn_index(
+        params.knn_index, n, params.knn_index_threshold
+    )
+    if index == "exact":
+        return index, {}
+    return index, {
+        "trees": params.rpf_trees,
+        "leaf_size": params.rpf_leaf_size,
+        "rescan_rounds": params.rpf_rescan_rounds,
+        "seed": params.seed,
+    }
+
+
 def core_distances_from_matrix(
     dist: jax.Array, min_pts: int, valid: jax.Array | None = None
 ) -> jax.Array:

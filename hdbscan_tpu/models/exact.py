@@ -48,6 +48,8 @@ def mst_edges(
     trace=None,
     knn_backend: str = "auto",
     scan_backend: str = "auto",
+    index: str = "exact",
+    index_opts: dict | None = None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Blocked Borůvka: (u, v, w) exact mutual-reachability MST + core distances.
 
@@ -63,6 +65,12 @@ def mst_edges(
     (replicated columns) or "ring" (ring-systolic row/panel sharding,
     ``parallel/ring.py``), "auto" picking ring on multi-device TPU meshes.
     Results are bitwise identical across scan backends.
+
+    ``index`` (resolved ``config.knn_index``, see
+    ``core/knn.resolve_index_for``) swaps the CORE-DISTANCE scan for the
+    sub-quadratic rp-forest engine; the Borůvka rounds stay exact, so the
+    tree is the exact MRD MST *under the approximate core vector* (the
+    KNN-DBSCAN quality argument; the e2e ARI gate pins >= 0.99x exact).
     """
     import time
 
@@ -79,12 +87,13 @@ def mst_edges(
         core, _ = ring_knn_core_distances(
             data, min_pts, metric, row_tile=row_tile, col_tile=col_tile,
             dtype=dtype, fetch_knn=False, mesh=mesh, trace=trace,
-            knn_backend=knn_backend,
+            knn_backend=knn_backend, index=index, index_opts=index_opts,
         )
     else:
         core, _ = knn_core_distances(
             data, min_pts, metric, row_tile=row_tile, col_tile=col_tile,
             dtype=dtype, fetch_knn=False, backend=knn_backend,
+            index=index, index_opts=index_opts, trace=trace,
         )
     if trace is not None:
         wall = time.monotonic() - t0
@@ -367,6 +376,9 @@ def fit(
             num_constraints_satisfied=num_constraints_satisfied,
             trace=trace,
         )
+    from hdbscan_tpu.core.knn import resolve_index_for
+
+    index, index_opts = resolve_index_for(params, n)
     u, v, w, core = mst_edges(
         data,
         params.min_points,
@@ -378,6 +390,7 @@ def fit(
         trace=trace,
         knn_backend=params.knn_backend,
         scan_backend=getattr(params, "scan_backend", "auto"),
+        index=index, index_opts=index_opts,
     )
     from hdbscan_tpu.models._finalize import finalize_clustering
 

@@ -613,8 +613,10 @@ def _fit_rows(
                 data, weights, params.min_points, metric
             )
         else:
+            from hdbscan_tpu.core.knn import resolve_index_for
             from hdbscan_tpu.parallel.ring import resolve_scan_backend
 
+            index, index_opts = resolve_index_for(params, n)
             if resolve_scan_backend(params.scan_backend, mesh) == "ring":
                 from hdbscan_tpu.parallel.ring import ring_knn_core_distances
 
@@ -626,6 +628,8 @@ def _fit_rows(
                     mesh=mesh,
                     trace=trace,
                     knn_backend=params.knn_backend,
+                    index=index,
+                    index_opts=index_opts,
                 )
             else:
                 core, _ = knn_core_distances(
@@ -634,6 +638,9 @@ def _fit_rows(
                     metric,
                     fetch_knn=False,
                     backend=params.knn_backend,
+                    index=index,
+                    index_opts=index_opts,
+                    trace=trace,
                 )
     n_dev = 1
     if mesh is not None:
@@ -1034,6 +1041,9 @@ def _fit_rows(
             # ever leave the device (``neighbor_rows``): the rescan's
             # merged results stay device-resident and the host fetch is
             # (m,) cores + the small glue lists, not (m, k) streams.
+            from hdbscan_tpu.core.knn import resolve_index_for
+
+            index, index_opts = resolve_index_for(params, n)
             bset_pos = np.full(n, -1, np.int64)
             bset_pos[bset] = np.arange(len(bset))
             sel_pos = bset_pos[bset_glue_sel]
@@ -1045,6 +1055,8 @@ def _fit_rows(
                 params.min_points,
                 neighbor_rows=sel_pos,
                 backend=params.knn_backend,
+                index=index,
+                index_opts=index_opts,
                 trace=trace,
             )
             # The full-dataset device copy is only needed for this rescan —
@@ -1059,8 +1071,10 @@ def _fit_rows(
             )
             bset_knn = (knn_d_g, knn_j_g)
         else:
+            from hdbscan_tpu.core.knn import resolve_index_for
             from hdbscan_tpu.parallel.ring import resolve_scan_backend
 
+            index, index_opts = resolve_index_for(params, n)
             if resolve_scan_backend(params.scan_backend, mesh) == "ring":
                 from hdbscan_tpu.parallel.ring import (
                     ring_knn_core_distances_rows,
@@ -1068,12 +1082,13 @@ def _fit_rows(
 
                 core_b = ring_knn_core_distances_rows(
                     data, bset, params.min_points, metric, mesh=mesh,
-                    trace=trace,
+                    trace=trace, index=index, index_opts=index_opts,
                 )
             else:
                 core_b = knn_core_distances_rows(
                     data, bset, params.min_points, metric,
                     backend=params.knn_backend,
+                    index=index, index_opts=index_opts, trace=trace,
                 )
         core[bset] = np.minimum(core[bset], core_b)
         if trace is not None:

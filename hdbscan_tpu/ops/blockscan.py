@@ -847,6 +847,8 @@ def knn_rows_blockpruned(
     probe_blocks: int = _KNN_PROBE_BLOCKS,
     backend: str = "xla",
     trace=None,
+    index: str = "exact",
+    index_opts: dict | None = None,
 ):
     """Exact core distances of selected rows via block-candidate windows.
 
@@ -887,6 +889,12 @@ def knn_rows_blockpruned(
     ``trace``: optional event callable (``utils.tracing.Tracer``); emits one
     ``knn_probe_scan`` / ``knn_window_scan`` event per dispatch phase with
     the chunk/tile dispatch shape and that phase's achieved-FLOP figures.
+
+    ``index="rpforest"`` (the resolved ``config.knn_index`` tier) replaces
+    the exact window rescan with one sub-quadratic forest pass over the
+    whole dataset (``ops/rpforest.py``) and slices the requested rows +
+    neighbor lists out of it — the window geometry machinery is bypassed
+    (the forest's own leaf partition plays the candidate-window role).
     """
     m = len(row_ids)
     k = max(min_pts - 1, 1)
@@ -897,6 +905,20 @@ def knn_rows_blockpruned(
         if neighbor_rows is not None:
             return empty, np.zeros((0, k)), np.zeros((0, k), np.int64)
         return empty
+    if index == "rpforest":
+        from hdbscan_tpu.ops.rpforest import rpforest_core_distances
+
+        core_all, knn_all, idx_all = rpforest_core_distances(
+            geom.data_host, min_pts, geom.metric, return_indices=True,
+            trace=trace, **(index_opts or {}),
+        )
+        core = core_all[row_ids]
+        if neighbor_rows is not None:
+            sel = np.asarray(row_ids)[np.asarray(neighbor_rows)]
+            return core, knn_all[sel][:, :k], idx_all[sel][:, :k]
+        return core
+    if index != "exact":
+        raise ValueError(f"unknown index {index!r}: exact | rpforest")
     rows = geom.data_host[row_ids]
 
     # Jobs address rows by sorted-space index (device-side gather),

@@ -1,0 +1,712 @@
+"""Random-projection-forest approximate k-NN engine (ROADMAP item 1).
+
+Every exact fit path (tiled, blockscan, ring) pays an O(n² d) distance
+scan, which caps practical n around a few hundred thousand points. This
+module is the sub-quadratic tier: T random-projection trees partition the
+dataset into leaves of ≤ ``leaf_size`` points, each leaf pays a dense
+k-NN scan against itself (O(n · leaf_size · d) total per tree), the
+per-tree candidate lists merge under the established (distance, id) lex
+tie-break, and a bounded neighbor-of-neighbor rescan
+(``rescan_rounds``) repairs recall at leaf boundaries — the
+tree-partition + cross-partition-rescan recipe of PANDA (arxiv
+1607.08220), with KNN-DBSCAN (arxiv 2009.04552) supplying the quality
+argument that approximate k-NN graphs preserve density-clustering
+structure (the ARI acceptance gate in tests/e2e pins it here).
+
+Selection is a config tier ORTHOGONAL to the kernel flag: ``knn_index``
+chooses WHAT graph is computed ("exact" = the O(n²) scans, "rpforest" =
+this engine, "auto" = rpforest at ``n >= knn_index_threshold``), while
+``knn_backend`` keeps choosing HOW distance tiles are evaluated.
+
+Tree construction is device-side and fully batched: level l splits all
+2^l nodes at once — one per-node hyperplane projection (a gather of the
+node's normal + a row-wise dot), one ``lax``-level lexsort by (node,
+projection), and a RANK split at the static segment midpoint, so the
+tree is balanced by construction and every level is the same O(n d)
+dense work regardless of the data. Split thresholds (the projection
+midpoint at each rank boundary) are recorded so serving-time queries
+route through the same trees (``route_queries``; ``serve/predict``).
+
+Exactness/parity contract: ``knn_index="exact"`` never enters this
+module — the existing scans are bitwise untouched. The rpforest outputs
+mirror ``ops.tiled.knn_core_distances`` shapes/dtypes exactly (float64
+core + ascending (n, k) neighbor lists, optional int64 ids, self at
+distance 0) so every downstream consumer is agnostic to the tier.
+
+Trace events (``utils/tracing``): ``knn_index_build`` (one per forest),
+``knn_index_query`` (leaf scans + multi-tree merge, with a sampled
+``recall_at_k`` counter vs a brute-force scan of ``recall_rows`` rows),
+``knn_index_rescan`` (one per round, with the count of rows whose k-th
+distance improved). ``scripts/check_trace.py`` validates their schema.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hdbscan_tpu.core.distances import METRICS, pairwise_distance
+
+#: The ``knn_index`` config vocabulary (``HDBSCANParams.knn_index``).
+KNN_INDEXES = ("auto", "exact", "rpforest")
+
+#: ``knn_index="auto"`` flips to rpforest at this many points — the scale
+#: where the O(n²) exact scan stops being the cheaper option on every
+#: backend we measure (BENCH_r06: rpforest wins >= 3x already at 200k on
+#: CPU; the threshold keeps small fits bitwise-exact by default).
+AUTO_INDEX_THRESHOLD = 1 << 18  # 262144
+
+#: Row budget (candidate-matrix elements) for one rescan dispatch — keeps
+#: the (rows, k+k², d) gathered-coordinate panel bounded on device.
+_RESCAN_ELEM_BUDGET = 1 << 24
+
+#: Leaf batches per leaf-scan dispatch are sized so the (B, Lmax, Lmax)
+#: distance block stays under this many elements.
+_LEAF_ELEM_BUDGET = 1 << 25
+
+
+def resolve_knn_index(
+    knn_index: str, n: int, threshold: int = AUTO_INDEX_THRESHOLD
+) -> str:
+    """Resolve the ``knn_index`` config value to the engine that runs.
+
+    "exact" and "rpforest" force; "auto" picks rpforest at
+    ``n >= threshold`` and the exact scans below it.
+    """
+    if knn_index not in KNN_INDEXES:
+        raise ValueError(
+            f"knn_index must be one of {KNN_INDEXES}, got {knn_index!r}"
+        )
+    if knn_index == "auto":
+        return "rpforest" if n >= threshold else "exact"
+    return knn_index
+
+
+# ---------------------------------------------------------------------------
+# Static tree geometry. Rank splits make every segment boundary a compile-
+# time constant: only the permutation (which point occupies which slot) is
+# data-dependent, so one jitted build serves all T trees.
+
+
+def forest_depth(n: int, leaf_size: int) -> int:
+    """Smallest depth whose largest leaf (= ceil(n / 2^depth)) fits."""
+    depth = 0
+    while -(-n >> depth) > leaf_size and (1 << depth) < n:
+        depth += 1
+    return depth
+
+
+def _level_segments(n: int, depth: int) -> list[list[tuple[int, int]]]:
+    """Per-level (start, end) position segments; level l has 2^l nodes.
+
+    Each segment of m points splits at rank ceil(m/2): left child gets the
+    lower-projection half. Sizes differ by at most 1 across a level.
+    """
+    levels = [[(0, n)]]
+    for _ in range(depth):
+        nxt = []
+        for s, e in levels[-1]:
+            h = s + ((e - s) + 1) // 2
+            nxt += [(s, h), (h, e)]
+        levels.append(nxt)
+    return levels
+
+
+def _heap_base(level: int) -> int:
+    return (1 << level) - 1
+
+
+@dataclass(frozen=True)
+class RPForest:
+    """One built forest: routing planes + per-tree leaf membership.
+
+    ``normals``/``thresholds`` are heap-indexed — the node j at level l
+    lives at ``2^l - 1 + j`` — so serving-time routing is ``depth``
+    gather+dot+compare steps (``route_queries``). ``members`` holds each
+    tree's leaves padded to the max leaf width by repeating the last
+    member (identical point ⇒ identical scan row, so the duplicate is
+    masked only on the column axis).
+    """
+
+    n: int
+    d: int
+    trees: int
+    depth: int
+    leaf_size: int  # configured cap (post-clamp)
+    normals: np.ndarray  # (T, 2^depth - 1, d) float32
+    thresholds: np.ndarray  # (T, 2^depth - 1) float32
+    members: np.ndarray  # (T, L, Lmax) int32, L = 2^depth
+    leaf_mask: np.ndarray  # (L, Lmax) bool — same static mask every tree
+
+    @property
+    def num_leaves(self) -> int:
+        return 1 << self.depth
+
+    @property
+    def max_leaf(self) -> int:
+        return self.members.shape[2]
+
+
+@partial(jax.jit, static_argnames=("geom",))
+def _build_one_tree(data, normals, geom):
+    """One tree's balanced rank-split build (see module docstring).
+
+    ``geom`` is a hashable static bundle: per level, the by-POSITION node
+    ids and the threshold gather positions. Returns the final point
+    permutation (leaves contiguous) and heap-ordered split thresholds.
+    """
+    n = data.shape[0]
+    perm = jnp.arange(n, dtype=jnp.int32)
+    thr_parts = []
+    for level, (pos_node, lo_idx, hi_idx, splittable) in enumerate(geom):
+        heap_idx = _heap_base(level) + np.asarray(pos_node)
+        plane = normals[jnp.asarray(heap_idx)]  # (n, d): each point's node plane
+        proj = jnp.einsum("nd,nd->n", data[perm], plane)
+        order = jnp.lexsort((proj, jnp.asarray(pos_node)))
+        perm = perm[order]
+        proj_sorted = proj[order]
+        lo = proj_sorted[jnp.asarray(lo_idx)]
+        hi = proj_sorted[jnp.asarray(hi_idx)]
+        # Unsplittable (size < 2) nodes route everything left (+inf).
+        thr_parts.append(
+            jnp.where(
+                jnp.asarray(splittable), 0.5 * (lo + hi), jnp.inf
+            ).astype(data.dtype)
+        )
+    thresholds = (
+        jnp.concatenate(thr_parts)
+        if thr_parts
+        else jnp.zeros((0,), data.dtype)
+    )
+    return perm, thresholds
+
+
+def _build_geom(n: int, depth: int):
+    """Hashable static geometry consumed by ``_build_one_tree``."""
+    levels = _level_segments(n, depth)
+    geom = []
+    for level in range(depth):
+        segs = levels[level]
+        pos_node = np.zeros(n, np.int32)
+        lo_idx = np.zeros(len(segs), np.int64)
+        hi_idx = np.zeros(len(segs), np.int64)
+        splittable = np.zeros(len(segs), bool)
+        for j, (s, e) in enumerate(segs):
+            pos_node[s:e] = j
+            h = s + ((e - s) + 1) // 2
+            splittable[j] = (e - s) >= 2
+            lo_idx[j] = max(h - 1, s) if e > s else 0
+            hi_idx[j] = min(h, e - 1) if e > s else 0
+        geom.append(
+            (
+                _Static(pos_node),
+                _Static(lo_idx),
+                _Static(hi_idx),
+                _Static(splittable),
+            )
+        )
+    return tuple(geom)
+
+
+class _Static:
+    """Hashable wrapper so numpy constants ride jit static args."""
+
+    __slots__ = ("a",)
+
+    def __init__(self, a):
+        self.a = a
+
+    def __array__(self, dtype=None):
+        return self.a if dtype is None else self.a.astype(dtype)
+
+    def __hash__(self):
+        return hash((self.a.shape, self.a.dtype.str, self.a.tobytes()))
+
+    def __eq__(self, other):
+        return isinstance(other, _Static) and np.array_equal(self.a, other.a)
+
+
+def build_forest(
+    data,
+    trees: int = 4,
+    leaf_size: int = 1024,
+    seed: int = 0,
+    dtype=np.float32,
+    trace=None,
+) -> RPForest:
+    """Build T random-projection trees over ``data`` (device-side).
+
+    Hyperplane normals are unit Gaussian directions drawn per NODE from a
+    ``numpy`` generator seeded by ``seed`` (deterministic across runs and
+    backends). Emits one ``knn_index_build`` trace event.
+    """
+    t0 = time.monotonic()
+    data = np.asarray(data)
+    n, d = data.shape
+    if trees < 1:
+        raise ValueError(f"trees must be >= 1, got {trees}")
+    if leaf_size < 2:
+        raise ValueError(f"leaf_size must be >= 2, got {leaf_size}")
+    depth = forest_depth(n, leaf_size)
+    rng = np.random.default_rng(np.random.SeedSequence([seed, n, depth]))
+    num_nodes = _heap_base(depth)  # internal nodes across all levels
+    normals = rng.standard_normal((trees, max(num_nodes, 1), d))
+    normals /= np.maximum(
+        np.linalg.norm(normals, axis=-1, keepdims=True), 1e-12
+    )
+    normals = normals.astype(dtype)
+    data_dev = jnp.asarray(data.astype(dtype))
+    geom = _build_geom(n, depth)
+
+    leaves = _level_segments(n, depth)[depth]
+    lmax = max(e - s for s, e in leaves)
+    pos_idx = np.zeros((len(leaves), lmax), np.int64)
+    leaf_mask = np.zeros((len(leaves), lmax), bool)
+    for j, (s, e) in enumerate(leaves):
+        width = e - s
+        pos_idx[j, :width] = np.arange(s, e)
+        pos_idx[j, width:] = e - 1  # pad by repeating the last position
+        leaf_mask[j, :width] = True
+
+    members = np.zeros((trees, len(leaves), lmax), np.int32)
+    thresholds = np.zeros((trees, max(num_nodes, 1)), dtype)
+    for t in range(trees):
+        perm, thr = _build_one_tree(data_dev, jnp.asarray(normals[t]), geom)
+        perm = np.asarray(perm)
+        members[t] = perm[pos_idx]
+        if num_nodes:
+            thresholds[t, :num_nodes] = np.asarray(thr)
+    forest = RPForest(
+        n=n,
+        d=d,
+        trees=trees,
+        depth=depth,
+        leaf_size=leaf_size,
+        normals=normals,
+        thresholds=thresholds,
+        members=members,
+        leaf_mask=leaf_mask,
+    )
+    if trace is not None:
+        trace(
+            "knn_index_build",
+            wall_s=time.monotonic() - t0,
+            trees=trees,
+            depth=depth,
+            leaf_size=leaf_size,
+            max_leaf=lmax,
+            n=n,
+            d=d,
+        )
+    return forest
+
+
+# ---------------------------------------------------------------------------
+# Leaf scans + candidate merges.
+
+
+@partial(jax.jit, static_argnames=("kk", "metric", "sentinel"))
+def _leaf_scan(data, members, mask, kk, metric, sentinel):
+    """Dense k-NN of every leaf against itself, batched over leaves.
+
+    Returns per-slot (B, Lmax, kk) ascending candidate distances + GLOBAL
+    ids, ordered by the (distance, id) lex tie-break among the selected
+    set. Padded columns are masked to +inf / ``sentinel``.
+    """
+    pts = data[members]  # (B, Lmax, d)
+    dm = jax.vmap(lambda p: pairwise_distance(p, p, metric))(pts)
+    inf = jnp.asarray(jnp.inf, dm.dtype)
+    dm = jnp.where(mask[:, None, :], dm, inf)
+    neg, pos = jax.lax.top_k(-dm, kk)
+    nd = -neg
+    ni = jnp.take_along_axis(
+        jnp.broadcast_to(members[:, None, :], dm.shape), pos, axis=-1
+    )
+    ni = jnp.where(jnp.isinf(nd), sentinel, ni)
+    order = jnp.lexsort((ni, nd), axis=-1)
+    return (
+        jnp.take_along_axis(nd, order, axis=-1),
+        jnp.take_along_axis(ni, order, axis=-1),
+    )
+
+
+def _dedup_lex_merge(all_d, all_i, k: int, sentinel: int):
+    """k-best of per-row candidate lists under (distance, id) lex order,
+    with duplicate ids collapsed to their smallest-distance copy first —
+    without the dedup, the same neighbor reached through several trees
+    occupies several of the k slots and silently caps recall."""
+    order = jnp.lexsort((all_d, all_i), axis=-1)  # by id, then distance
+    si = jnp.take_along_axis(all_i, order, axis=-1)
+    sd = jnp.take_along_axis(all_d, order, axis=-1)
+    dup = jnp.concatenate(
+        [jnp.zeros_like(si[:, :1], bool), si[:, 1:] == si[:, :-1]], axis=-1
+    )
+    sd = jnp.where(dup, jnp.inf, sd)
+    si = jnp.where(dup, sentinel, si)
+    order = jnp.lexsort((si, sd), axis=-1)  # the established lex tie-break
+    return (
+        jnp.take_along_axis(sd, order, axis=-1)[:, :k],
+        jnp.take_along_axis(si, order, axis=-1)[:, :k],
+    )
+
+
+_dedup_lex_merge_jit = jax.jit(_dedup_lex_merge, static_argnames=("k", "sentinel"))
+
+
+def _mesh_parts(mesh):
+    """(n_dev, leaf_batch_sharding, rows_sharding, replicated) or Nones."""
+    if mesh is None:
+        return 1, None, None, None
+    from hdbscan_tpu.parallel.mesh import (
+        block_sharding, device_count, replicated, row_sharding,
+    )
+
+    n_dev = device_count(mesh)
+    if n_dev <= 1:
+        return 1, None, None, None
+    return n_dev, block_sharding(mesh), row_sharding(mesh), replicated(mesh)
+
+
+def forest_knn(
+    data_dev,
+    forest: RPForest,
+    k: int,
+    metric: str = "euclidean",
+    trace=None,
+    recall_sample: int = 256,
+    mesh=None,
+):
+    """Approximate neighbor lists from the built forest.
+
+    Per tree: batched per-leaf dense scans (leaf batches sized to the
+    ``_LEAF_ELEM_BUDGET`` distance-block budget), scattered back to
+    point-major order; then one dedup + (distance, id) lex merge across
+    the T per-tree lists. Emits ``knn_index_query`` with a sampled
+    ``recall_at_k`` counter when tracing.
+
+    With a multi-device ``mesh`` (the ``scan_backend=ring`` composition,
+    ``parallel/ring.py``): the forest's leaf batches shard over the mesh
+    (the per-leaf scans are embarrassingly parallel along the leaf axis —
+    each shard scans only its own leaves' members, i.e. its row shard of
+    the forest) and the merged per-point lists live row-sharded; results
+    are bitwise identical to the single-device path (all ops are per-row).
+
+    Returns ``(best_d, best_i)`` padded to a device-divisible row count —
+    callers slice ``[:n]`` after the rescan rounds.
+    """
+    if metric not in METRICS:
+        raise ValueError(f"unknown metric {metric!r}; expected one of {METRICS}")
+    t0 = time.monotonic()
+    n, lmax = forest.n, forest.max_leaf
+    num_leaves = forest.num_leaves
+    kk = min(k, lmax)
+    sentinel = n
+    n_dev, leaf_sh, rows_sh, _repl = _mesh_parts(mesh)
+    n_pad = -(-n // n_dev) * n_dev
+    batch = max(1, _LEAF_ELEM_BUDGET // (lmax * lmax))
+    if n_dev > 1:  # keep sharded leaf-batch slices device-divisible
+        batch = max(n_dev, batch - batch % n_dev)
+    mask_np = forest.leaf_mask
+    per_tree_d, per_tree_i = [], []
+    for t in range(forest.trees):
+        out_d = jnp.full((n_pad, kk), jnp.inf, data_dev.dtype)
+        out_i = jnp.full((n_pad, kk), sentinel, jnp.int32)
+        if rows_sh is not None:
+            out_d, out_i = jax.device_put((out_d, out_i), (rows_sh, rows_sh))
+        for a in range(0, num_leaves, batch):
+            b = min(a + batch, num_leaves)
+            members = jnp.asarray(forest.members[t, a:b])
+            mask = jnp.asarray(mask_np[a:b])
+            if leaf_sh is not None and (b - a) % n_dev == 0:
+                members, mask = jax.device_put(
+                    (members, mask), (leaf_sh, leaf_sh)
+                )
+            nd, ni = _leaf_scan(
+                data_dev, members, mask, kk, metric, sentinel
+            )
+            flat = forest.members[t, a:b].reshape(-1)
+            out_d = out_d.at[flat].set(nd.reshape(-1, kk))
+            out_i = out_i.at[flat].set(ni.reshape(-1, kk))
+        per_tree_d.append(out_d)
+        per_tree_i.append(out_i)
+    from hdbscan_tpu.utils.flops import counter as _flops
+
+    _flops.add_scan(forest.trees * num_leaves * lmax, lmax, forest.d)
+    cat_d = jnp.concatenate(per_tree_d, axis=1)
+    cat_i = jnp.concatenate(per_tree_i, axis=1)
+    best_d, best_i = _dedup_lex_merge_jit(cat_d, cat_i, k=kk, sentinel=sentinel)
+    if rows_sh is not None:
+        best_d, best_i = jax.device_put((best_d, best_i), (rows_sh, rows_sh))
+    best_d.block_until_ready()
+    if trace is not None:
+        fields = dict(
+            n=n,
+            k=kk,
+            trees=forest.trees,
+            candidates=forest.trees * kk,
+        )
+        if recall_sample:
+            recall, rows = _sampled_recall(
+                data_dev[:n], best_i, kk, metric, recall_sample
+            )
+            fields["recall_at_k"] = recall
+            fields["recall_rows"] = rows
+        trace("knn_index_query", wall_s=time.monotonic() - t0, **fields)
+    return best_d, best_i
+
+
+@partial(jax.jit, static_argnames=("m", "k", "metric", "sentinel"))
+def _rescan_chunk(data, best_d, best_i, start, m, k, metric, sentinel):
+    """One rescan dispatch: rows [start, start+m) expand to their
+    neighbors' neighbor lists, distances are computed on device against
+    the gathered candidate panel, and the result dedup+lex-merges into
+    the rows' current k-best. Returns the rows' new lists + improved count."""
+    bd = jax.lax.dynamic_slice_in_dim(best_d, start, m)
+    bi = jax.lax.dynamic_slice_in_dim(best_i, start, m)
+    q = jax.lax.dynamic_slice_in_dim(data, start, m)
+    nb = jnp.clip(bi, 0, sentinel - 1)
+    cand = best_i[nb].reshape(m, k * k)  # neighbor-of-neighbor expansion
+    cand = jnp.where(
+        jnp.repeat(bi == sentinel, k, axis=-1), sentinel, cand
+    )
+    cpts = data[jnp.clip(cand, 0, sentinel - 1)]  # (m, k², d) candidate panel
+    cd = jax.vmap(
+        lambda qq, cc: pairwise_distance(qq[None, :], cc, metric)[0]
+    )(q, cpts)
+    cd = jnp.where(cand == sentinel, jnp.inf, cd).astype(bd.dtype)
+    all_d = jnp.concatenate([bd, cd], axis=1)
+    all_i = jnp.concatenate([bi, cand], axis=1)
+    nd, ni = _dedup_lex_merge(all_d, all_i, k, sentinel)
+    improved = jnp.sum(nd[:, k - 1] < bd[:, k - 1])
+    return nd, ni, improved
+
+
+def rescan_round(
+    data_dev,
+    best_d,
+    best_i,
+    k: int,
+    metric: str,
+    rnd: int,
+    rescan_rounds: int,
+    sentinel: int | None = None,
+    trace=None,
+):
+    """One neighbor-of-neighbor expansion round over all rows (chunked).
+
+    ``best_d``/``best_i`` may carry padded rows past ``sentinel`` real
+    points (the mesh-sharded tier); padded rows hold only sentinel ids and
+    pass through untouched. The only cross-row data movement is the
+    per-chunk gathered candidate-coordinate panel (``cpts``), O(rows · k²
+    · d) — never a full column panel.
+    """
+    t0 = time.monotonic()
+    n_rows = best_d.shape[0]
+    d = data_dev.shape[1]
+    sentinel = data_dev.shape[0] if sentinel is None else sentinel
+    chunk = max(64, _RESCAN_ELEM_BUDGET // max(1, k * k * d))
+    chunk = min(n_rows, chunk)
+    parts_d, parts_i, improved = [], [], 0
+    a = 0
+    while a < n_rows:
+        m = chunk if a + chunk <= n_rows else n_rows - a
+        nd, ni, imp = _rescan_chunk(
+            data_dev, best_d, best_i, a, m, k, metric, sentinel
+        )
+        parts_d.append(nd)
+        parts_i.append(ni)
+        improved += int(imp)
+        a += m
+    best_d = jnp.concatenate(parts_d)
+    best_i = jnp.concatenate(parts_i)
+    best_d.block_until_ready()
+    if trace is not None:
+        trace(
+            "knn_index_rescan",
+            wall_s=time.monotonic() - t0,
+            round=rnd,
+            rescan_rounds=rescan_rounds,
+            improved=improved,
+            n=sentinel,
+            k=k,
+        )
+    return best_d, best_i
+
+
+# ---------------------------------------------------------------------------
+# Recall counter (trace-time) + serving-time query routing.
+
+
+@partial(jax.jit, static_argnames=("k", "metric"))
+def _exact_rows_knn_ids(data, rows, k, metric):
+    dm = pairwise_distance(data[rows], data, metric)
+    ids = jnp.broadcast_to(jnp.arange(data.shape[0]), dm.shape)
+    order = jnp.lexsort((ids, dm), axis=-1)
+    return order[:, :k]
+
+
+def _sampled_recall(data_dev, best_i, k, metric, sample):
+    """Mean per-row recall@k vs a brute-force scan of ``sample`` rows."""
+    n = data_dev.shape[0]
+    rows = np.linspace(0, n - 1, num=min(sample, n), dtype=np.int64)
+    rows = np.unique(rows)
+    exact = np.asarray(
+        _exact_rows_knn_ids(data_dev, jnp.asarray(rows), k, metric)
+    )
+    approx = np.asarray(best_i)[rows]
+    hits = 0
+    for r in range(len(rows)):
+        hits += len(np.intersect1d(exact[r], approx[r]))
+    return float(hits) / float(len(rows) * k), int(len(rows))
+
+
+def route_queries(queries, normals, thresholds, depth: int):
+    """Leaf id per query for ONE tree (jit/vmap friendly).
+
+    ``depth`` gather+dot+compare steps down the heap-indexed planes;
+    projections >= threshold go right, matching the rank-split midpoint
+    recorded at build time. Used by ``serve/predict`` to query a stored
+    forest with fixed shapes (zero steady-state recompiles preserved).
+    """
+    b = queries.shape[0]
+    node = jnp.zeros(b, jnp.int32)
+    for level in range(depth):
+        heap = _heap_base(level) + node
+        plane = normals[heap]
+        thr = thresholds[heap]
+        proj = jnp.einsum("bd,bd->b", queries, plane)
+        node = node * 2 + (proj >= thr).astype(jnp.int32)
+    return node
+
+
+# ---------------------------------------------------------------------------
+# Core-distance entry points (the ``ops.tiled`` return contracts).
+
+
+def rpforest_core_distances(
+    data,
+    min_pts: int,
+    metric: str = "euclidean",
+    k: int | None = None,
+    *,
+    trees: int = 4,
+    leaf_size: int = 1024,
+    rescan_rounds: int = 1,
+    seed: int = 0,
+    dtype=np.float32,
+    return_indices: bool = False,
+    fetch_knn: bool = True,
+    trace=None,
+    recall_sample: int = 256,
+    mesh=None,
+    forest: RPForest | None = None,
+):
+    """Approximate core distances via the rp-forest engine.
+
+    Mirrors :func:`ops.tiled.knn_core_distances` exactly in shape/dtype:
+    returns ``(core, knn)`` — float64 (n,) core (min_pts-th smallest with
+    self included; all zeros at ``min_pts <= 1``) and float64 (n, k)
+    ascending neighbor distances — with the (n, k) int64 id matrix
+    appended under ``return_indices``. ``fetch_knn=False`` returns
+    ``(core, None)``.
+
+    ``leaf_size`` is clamped to ``>= 2k + 2`` so the smallest leaf (which
+    the balanced rank split keeps within 1 of ``floor(n / 2^depth)``)
+    always supplies a full k candidates including self at distance 0.
+    ``mesh`` (the ``scan_backend=ring`` composition) shards the forest's
+    leaf batches and the per-point lists over the devices — see
+    :func:`forest_knn`; results stay bitwise identical to single-device.
+    ``forest`` reuses a pre-built index (serving; bench build/query split).
+    """
+    data = np.asarray(data)
+    n = len(data)
+    k_eff = max(k or 0, max(min_pts - 1, 1))
+    k_eff = min(k_eff, n)
+    leaf_size = min(max(leaf_size, 2 * k_eff + 2, 8), max(n, 2))
+    if forest is None:
+        forest = build_forest(
+            data, trees=trees, leaf_size=leaf_size, seed=seed, dtype=dtype,
+            trace=trace,
+        )
+    n_dev, _leaf_sh, rows_sh, repl_sh = _mesh_parts(mesh)
+    n_pad = -(-n // n_dev) * n_dev
+    data_np = data.astype(dtype)
+    if n_pad > n:
+        data_np = np.concatenate(
+            [data_np, np.zeros((n_pad - n, data.shape[1]), dtype)]
+        )
+    data_dev = jnp.asarray(data_np)
+    if repl_sh is not None:
+        data_dev = jax.device_put(data_dev, repl_sh)
+    best_d, best_i = forest_knn(
+        data_dev,
+        forest,
+        k_eff,
+        metric,
+        trace=trace,
+        recall_sample=recall_sample,
+        mesh=mesh,
+    )
+    for rnd in range(rescan_rounds):
+        best_d, best_i = rescan_round(
+            data_dev, best_d, best_i, k_eff, metric, rnd, rescan_rounds,
+            sentinel=n, trace=trace,
+        )
+        if rows_sh is not None:
+            best_d, best_i = jax.device_put((best_d, best_i), (rows_sh, rows_sh))
+    knn = np.asarray(best_d, np.float64)[:n]
+    if min_pts <= 1:
+        core = np.zeros(n, np.float64)
+    else:
+        core = knn[:, min(min_pts - 1, n) - 1].copy()
+    if not fetch_knn and not return_indices:
+        return core, None
+    if return_indices:
+        idx = np.asarray(best_i, np.int64)[:n]
+        return core, knn, idx
+    return core, knn
+
+
+def rpforest_core_distances_rows(
+    data,
+    row_ids,
+    min_pts: int,
+    metric: str = "euclidean",
+    *,
+    trees: int = 4,
+    leaf_size: int = 1024,
+    rescan_rounds: int = 1,
+    seed: int = 0,
+    dtype=np.float32,
+    trace=None,
+    mesh=None,
+):
+    """Approximate core distances for SELECTED rows (the boundary-rescan
+    contract of ``ops.tiled.knn_core_distances_rows``: (m,) float64).
+
+    The forest indexes the WHOLE dataset (sub-quadratic either way), so
+    the row subset is a post-hoc slice — unlike the exact rows-scan there
+    is no O(m·n) sweep to avoid, and the full-graph pass is what the
+    boundary points' neighbor-of-neighbor rescans need anyway.
+    """
+    row_ids = np.asarray(row_ids)
+    core, _ = rpforest_core_distances(
+        data,
+        min_pts,
+        metric,
+        trees=trees,
+        leaf_size=leaf_size,
+        rescan_rounds=rescan_rounds,
+        seed=seed,
+        dtype=dtype,
+        fetch_knn=False,
+        trace=trace,
+        recall_sample=0,
+        mesh=mesh,
+    )
+    return core[row_ids]

@@ -228,6 +228,9 @@ def knn_core_distances(
     backend: str = "auto",
     fetch_knn: bool = True,
     guarded: bool = True,
+    index: str = "exact",
+    index_opts: dict | None = None,
+    trace=None,
 ):
     """Streaming exact core distances (and the full k-NN distance list).
 
@@ -254,8 +257,25 @@ def knn_core_distances(
     ones) that discard ``knn``. ``guarded`` selects the cond-extracted
     guarded exact selection (see ``_knn_core_scan``; measured ~2.2x on-chip
     at 500k x 28) — exact either way; False forces the r4 concat-top_k form.
+
+    ``index`` is the RESOLVED neighbor-graph tier (``config.knn_index``
+    after ``ops.rpforest.resolve_knn_index``): "exact" (default) is this
+    scan, byte-for-byte unchanged; "rpforest" delegates to the
+    sub-quadratic random-projection-forest engine with ``index_opts``
+    (trees/leaf_size/rescan_rounds/seed) and ``trace`` threaded through —
+    same return contract either way.
     """
     n = len(data)
+    if index == "rpforest":
+        from hdbscan_tpu.ops.rpforest import rpforest_core_distances
+
+        return rpforest_core_distances(
+            data, min_pts, metric, k,
+            dtype=dtype, return_indices=return_indices,
+            fetch_knn=fetch_knn, trace=trace, **(index_opts or {}),
+        )
+    if index != "exact":
+        raise ValueError(f"unknown index {index!r}: exact | rpforest")
     # Reference semantics: core distance = largest of the (minPts - 1)
     # smallest distances with self included (core/knn.py, HDBSCANStar.java:71-106).
     k = max(k or 0, max(min_pts - 1, 1))
@@ -378,6 +398,9 @@ def knn_core_distances_rows(
     col_tile: int = 8192,
     dtype=np.float32,
     backend: str = "xla",
+    index: str = "exact",
+    index_opts: dict | None = None,
+    trace=None,
 ) -> np.ndarray:
     """Exact core distances for SELECTED rows against the whole dataset.
 
@@ -390,7 +413,19 @@ def knn_core_distances_rows(
     ``backend="fused"`` rides the rectangular form of the fused
     distance+selection kernel (``pallas_knn.knn_fused_pallas``) with the
     same guarded-XLA fallback rules as :func:`knn_core_distances`.
+    ``index="rpforest"`` (the resolved ``config.knn_index`` tier) instead
+    slices the rows out of one sub-quadratic forest pass — see
+    ``ops.rpforest.rpforest_core_distances_rows``.
     """
+    if index == "rpforest":
+        from hdbscan_tpu.ops.rpforest import rpforest_core_distances_rows
+
+        return rpforest_core_distances_rows(
+            data, row_ids, min_pts, metric,
+            dtype=dtype, trace=trace, **(index_opts or {}),
+        )
+    if index != "exact":
+        raise ValueError(f"unknown index {index!r}: exact | rpforest")
     n = len(data)
     m = len(row_ids)
     if m == 0:

@@ -342,6 +342,8 @@ def ring_knn_core_distances(
     mesh=None,
     trace=None,
     knn_backend: str = "auto",
+    index: str = "exact",
+    index_opts: dict | None = None,
 ):
     """Ring-sharded exact core distances — the ``scan_backend="ring"`` twin
     of :func:`ops.tiled.knn_core_distances`, bitwise identical output.
@@ -349,11 +351,25 @@ def ring_knn_core_distances(
     Each device holds one row shard; panels circulate (module docstring).
     ``knn_backend`` in ("auto", "fused", "pallas") lets the per-step panel
     scan ride the fused Pallas kernel when eligible on TPU; "xla" forces the
-    guarded tile scan everywhere. Return contract matches the host fn:
-    ``(core, knn)`` or ``(core, knn, idx)``; ``fetch_knn=False`` fetches only
-    the k-th column — ``(core, None)``.
+    guarded tile scan everywhere. ``index="rpforest"`` swaps the quadratic
+    panel circulation for the rp-forest engine sharded over the same mesh:
+    leaf batches and per-point lists row-shard, only candidate-coordinate
+    panels cross shards, and no (n, n) scan is formed. Return contract
+    matches the host fn: ``(core, knn)`` or ``(core, knn, idx)``;
+    ``fetch_knn=False`` fetches only the k-th column — ``(core, None)``.
     """
     n = len(data)
+    if index == "rpforest":
+        from hdbscan_tpu.ops.rpforest import rpforest_core_distances
+
+        return rpforest_core_distances(
+            data, min_pts, metric, k, dtype=dtype,
+            return_indices=return_indices, fetch_knn=fetch_knn,
+            trace=trace, mesh=mesh if mesh is not None else get_mesh(),
+            **(index_opts or {}),
+        )
+    if index != "exact":
+        raise ValueError(f"unknown knn index {index!r}")
     k = max(k or 0, max(min_pts - 1, 1))
     mesh = mesh if mesh is not None else get_mesh()
     n_dev = device_count(mesh)
@@ -417,16 +433,29 @@ def ring_knn_core_distances_rows(
     dtype=np.float32,
     mesh=None,
     trace=None,
+    index: str = "exact",
+    index_opts: dict | None = None,
 ) -> np.ndarray:
     """Ring-sharded twin of :func:`ops.tiled.knn_core_distances_rows`: core
     distances for SELECTED rows (the mr-hdbscan boundary rescan) — the m
     query rows shard across devices, the full column set circulates as
     panels. Returns (m,) float64 core distances aligned with ``row_ids``.
+    ``index="rpforest"`` answers the same rows from a mesh-sharded forest.
     """
     n = len(data)
     m = len(row_ids)
     if m == 0:
         return np.zeros(0, np.float64)
+    if index == "rpforest":
+        from hdbscan_tpu.ops.rpforest import rpforest_core_distances_rows
+
+        return rpforest_core_distances_rows(
+            data, row_ids, min_pts, metric, dtype=dtype, trace=trace,
+            mesh=mesh if mesh is not None else get_mesh(),
+            **(index_opts or {}),
+        )
+    if index != "exact":
+        raise ValueError(f"unknown knn index {index!r}")
     k = max(min_pts - 1, 1)
     mesh = mesh if mesh is not None else get_mesh()
     n_dev = device_count(mesh)

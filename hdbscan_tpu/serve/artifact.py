@@ -40,13 +40,37 @@ from hdbscan_tpu.utils.checkpoint import _data_digest
 #: any backwards-incompatible array-layout change; ``load`` refuses other
 #: versions outright (a served prediction from misread arrays is silent
 #: corruption, unlike a checkpoint, which can just start fresh).
-MODEL_SCHEMA = "hdbscan-tpu-model/1"
+#: ``/2`` adds the OPTIONAL rp-forest index arrays (``rpf_*``) so servers
+#: can answer approximate_predict sub-quadratically; every ``/1`` array is
+#: unchanged, so ``/1`` artifacts still load (they simply carry no index).
+MODEL_SCHEMA = "hdbscan-tpu-model/2"
+
+#: Schemas :meth:`ClusterModel.load` accepts. ``/1`` is the pre-rpforest
+#: layout — a strict subset of ``/2`` — and loads with ``rpf=None``.
+_COMPAT_SCHEMAS = ("hdbscan-tpu-model/1", MODEL_SCHEMA)
+
+#: The arrays of a stored rp-forest index (``ops/rpforest.RPForest`` field
+#: order); the artifact stores each under an ``rpf_`` key prefix.
+_RPF_ARRAYS = ("normals", "thresholds", "members", "leaf_mask")
 
 #: The parameter fields that must match for a model to serve a dataset —
 #: the serve-relevant subset of ``utils/checkpoint._fingerprint`` (fit-only
 #: knobs like ``k`` or ``refine_iterations`` are baked into the stored tree
 #: and need not match at load time).
 _FINGERPRINT_FIELDS = ("min_points", "min_cluster_size", "dist_function")
+
+
+def _rpf_pack(forest) -> dict:
+    """Host-side dict form of an ``ops/rpforest.RPForest`` for storage."""
+    return {
+        "trees": int(forest.trees),
+        "depth": int(forest.depth),
+        "leaf_size": int(forest.leaf_size),
+        "normals": np.asarray(forest.normals, np.float32),
+        "thresholds": np.asarray(forest.thresholds, np.float32),
+        "members": np.asarray(forest.members, np.int32),
+        "leaf_mask": np.asarray(forest.leaf_mask, bool),
+    }
 
 
 def _fingerprint(params, n: int, data_digest: str | None) -> dict:
@@ -81,6 +105,13 @@ class ClusterModel:
     eps_min: np.ndarray  # (C+1,) float64 per-selected-cluster min exit eps
     eps_max: np.ndarray  # (C+1,) float64 lowest descendant death (GLOSH)
     schema: str = MODEL_SCHEMA
+    #: Optional rp-forest index (schema /2): ``{"trees", "depth",
+    #: "leaf_size"}`` ints plus the ``ops/rpforest.RPForest`` arrays —
+    #: ``normals`` (T, 2^depth - 1, d) f32, ``thresholds`` (T, 2^depth - 1)
+    #: f32, ``members`` (T, L, Lmax) i32, ``leaf_mask`` (L, Lmax) bool.
+    #: ``serve/predict`` routes queries down the stored planes instead of
+    #: scanning all n train rows when ``predict_backend="rpforest"``.
+    rpf: dict | None = None
 
     @property
     def n_train(self) -> int:
@@ -103,7 +134,9 @@ class ClusterModel:
     # -- construction ------------------------------------------------------
 
     @classmethod
-    def from_fit_result(cls, result, data: np.ndarray, params) -> "ClusterModel":
+    def from_fit_result(
+        cls, result, data: np.ndarray, params, forest=None
+    ) -> "ClusterModel":
         """Build the artifact from a fit result (``models/hdbscan.
         HDBSCANResult`` or ``models/mr_hdbscan.MRHDBSCANResult``) plus the
         training data and params it was fitted with.
@@ -111,6 +144,12 @@ class ClusterModel:
         Consensus results are stored as their REPRESENTATIVE draw's tree
         with the consensus flat labels — the same mixed provenance the
         five-file output set documents (``write_outputs`` sidecar).
+
+        ``forest``: an ``ops/rpforest.RPForest`` to embed as the artifact's
+        serving index. When omitted and ``params.knn_index`` resolves to
+        rpforest for this n, a forest is built here (same knobs and seed the
+        fit's scans used), so an approximate fit round-trips into an
+        approximate-serving artifact with no extra caller step.
         """
         from hdbscan_tpu.models._finalize import serving_tables
 
@@ -131,6 +170,29 @@ class ClusterModel:
             last = last[inv]
         tables = serving_tables(tree)
         mode = "mr" if hasattr(result, "n_levels") else "exact"
+        rpf = None
+        if forest is not None:
+            rpf = _rpf_pack(forest)
+        elif getattr(params, "knn_index", "exact") != "exact":
+            from hdbscan_tpu.ops.rpforest import build_forest, resolve_knn_index
+
+            index = resolve_knn_index(
+                params.knn_index, n,
+                getattr(params, "knn_index_threshold", 1),
+            )
+            if index == "rpforest":
+                k = max(getattr(params, "min_points", 2) - 1, 1)
+                leaf_size = max(
+                    getattr(params, "rpf_leaf_size", 1024), 2 * k + 2, 8
+                )
+                rpf = _rpf_pack(
+                    build_forest(
+                        data,
+                        trees=getattr(params, "rpf_trees", 4),
+                        leaf_size=min(leaf_size, max(n, 2)),
+                        seed=getattr(params, "seed", 0),
+                    )
+                )
         return cls(
             mode=mode,
             params={f: getattr(params, f) for f in _FINGERPRINT_FIELDS},
@@ -145,6 +207,7 @@ class ClusterModel:
             sel_anc=np.asarray(tables["sel_anc"], np.int64),
             eps_min=np.asarray(tables["eps_min"], np.float64),
             eps_max=np.asarray(tables["eps_max"], np.float64),
+            rpf=rpf,
         )
 
     # -- persistence -------------------------------------------------------
@@ -161,6 +224,12 @@ class ClusterModel:
             "params": self.params,
             "fingerprint": self.fingerprint,
         }
+        extra = {}
+        if self.rpf is not None:
+            meta["rpf"] = {
+                k: int(self.rpf[k]) for k in ("trees", "depth", "leaf_size")
+            }
+            extra = {f"rpf_{k}": self.rpf[k] for k in _RPF_ARRAYS}
         fd, tmp = tempfile.mkstemp(dir=out_dir, suffix=".tmp")
         os.close(fd)
         try:
@@ -178,6 +247,7 @@ class ClusterModel:
                     sel_anc=self.sel_anc,
                     eps_min=self.eps_min,
                     eps_max=self.eps_max,
+                    **extra,
                 )
             os.replace(tmp, path)
         finally:
@@ -189,8 +259,9 @@ class ClusterModel:
     def load(cls, path: str, params=None, data=None) -> "ClusterModel":
         """Load and verify an artifact.
 
-        Raises ``ValueError`` on (1) a schema version other than
-        ``MODEL_SCHEMA`` — arrays of another layout must not be misread;
+        Raises ``ValueError`` on (1) a schema version this build cannot read
+        (``/1`` loads compatibly with no index; ``/2`` is current) — arrays
+        of another layout must not be misread;
         (2) a corrupt payload — the stored training data's digest must equal
         the stored fingerprint's; (3) a fingerprint mismatch against the
         caller's ``params`` and/or ``data`` when supplied (a server asked to
@@ -200,11 +271,16 @@ class ClusterModel:
         with np.load(path) as z:
             meta = json.loads(bytes(z["meta"]).decode())
             schema = meta.get("schema")
-            if schema != MODEL_SCHEMA:
+            if schema not in _COMPAT_SCHEMAS:
                 raise ValueError(
                     f"model {path} has schema {schema!r}; this build reads "
-                    f"{MODEL_SCHEMA!r} only"
+                    f"{' / '.join(map(repr, _COMPAT_SCHEMAS))} only"
                 )
+            rpf = None
+            if meta.get("rpf") is not None:
+                rpf = dict(meta["rpf"])
+                for key in _RPF_ARRAYS:
+                    rpf[key] = z[f"rpf_{key}"]
             model = cls(
                 mode=meta["mode"],
                 params=meta["params"],
@@ -220,6 +296,7 @@ class ClusterModel:
                 eps_min=z["eps_min"],
                 eps_max=z["eps_max"],
                 schema=schema,
+                rpf=rpf,
             )
         stored_digest = model.fingerprint.get("data")
         if stored_digest is not None and _data_digest(model.data) != stored_digest:
@@ -247,7 +324,7 @@ class ClusterModel:
 
     def summary(self) -> dict:
         """Small JSON-safe description (the ``/healthz`` payload core)."""
-        return {
+        out = {
             "schema": self.schema,
             "mode": self.mode,
             "n_train": int(self.n_train),
@@ -256,3 +333,8 @@ class ClusterModel:
             "n_selected": int(self.selected.sum()),
             "params": dict(self.params),
         }
+        if self.rpf is not None:
+            out["rpf"] = {
+                k: int(self.rpf[k]) for k in ("trees", "depth", "leaf_size")
+            }
+        return out

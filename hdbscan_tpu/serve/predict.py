@@ -6,7 +6,9 @@ formulation rendered in this repo's eps-level representation:
 
 1. **k-NN**: q's k nearest training points (k = minPts - 1) via the same
    tiled exact scan the fit used (``ops/tiled._knn_core_scan``, or the fused
-   Pallas kernel under ``predict_backend=fused``).
+   Pallas kernel under ``predict_backend=fused``; under
+   ``predict_backend=rpforest`` the artifact's stored rp-forest routes q to
+   T leaves and only their members are scanned — sub-quadratic, approximate).
 2. **Core distance**: ``core_q`` = the (minPts - 1)-th smallest training
    distance — identical to the fit's self-included semantics for training
    rows (their own row sits in the train set at distance 0).
@@ -63,14 +65,26 @@ _MAX_ROW_TILE = 128
 
 
 def _resolve_backend(backend: str, model, dtype) -> tuple[str, bool]:
-    """('xla'|'fused', interpret) with ``knn_backend``-style fallback rules:
-    'fused' silently falls back to the XLA scan when the kernel cannot run
-    (non-euclidean, d > 128, k > 128, non-f32, or off-TPU at large n, where
-    only the slow interpreter exists)."""
-    if backend not in ("auto", "xla", "fused"):
+    """('xla'|'fused'|'rpforest', interpret) with ``knn_backend``-style
+    fallback rules: 'fused' silently falls back to the XLA scan when the
+    kernel cannot run (non-euclidean, d > 128, k > 128, non-f32, or off-TPU
+    at large n, where only the slow interpreter exists). 'rpforest' is
+    opt-in only — never picked by 'auto', because it answers from the
+    artifact's stored index (approximate) instead of the exact train scan —
+    and requires a ``/2`` artifact that carries one."""
+    if backend not in ("auto", "xla", "fused", "rpforest"):
         raise ValueError(
-            f"predict backend must be 'auto', 'xla' or 'fused', got {backend!r}"
+            f"predict backend must be 'auto', 'xla', 'fused' or 'rpforest', "
+            f"got {backend!r}"
         )
+    if backend == "rpforest":
+        if getattr(model, "rpf", None) is None:
+            raise ValueError(
+                "predict_backend='rpforest' needs a model artifact that "
+                "carries an rp-forest index (hdbscan-tpu-model/2, fitted "
+                "with knn_index=rpforest or saved with forest=...)"
+            )
+        return "rpforest", False
     on_tpu = jax.devices()[0].platform == "tpu"
     k = max(model.min_points - 1, 1)
     fusable = (
@@ -168,6 +182,41 @@ def _predict_kernel_xla(
     )
 
 
+def _predict_kernel_rpf(
+    xq, normals, thresholds, members, train, core_t, labels_t, last_t, anc,
+    birth, sel_anc, eps_min, eps_max, sel_ids,
+    k: int, kth_col: int, metric: str, depth: int, sentinel: int,
+    with_membership: bool,
+):
+    """Sub-quadratic k-NN: route each query down the stored forest planes
+    (``ops/rpforest.route_queries``, ``depth`` gather+dot steps per tree),
+    scan only the T visited leaves' members (T * Lmax candidates instead of
+    all n train rows), and keep everything downstream of the k-NN list —
+    attachment, climb, labels — identical to the exact kernels. Candidate
+    count is fixed by the stored forest geometry, so every bucket still
+    compiles exactly once (the zero-steady-state-recompile property)."""
+    from hdbscan_tpu.core.distances import pairwise_distance
+    from hdbscan_tpu.ops.rpforest import _dedup_lex_merge, route_queries
+
+    xqf = xq.astype(normals.dtype)
+    # (T, B) leaf per tree; members[t, leaf] -> (T, B, Lmax) candidate ids.
+    leaves = jax.vmap(
+        lambda nrm, thr: route_queries(xqf, nrm, thr, depth)
+    )(normals, thresholds)
+    cand = jax.vmap(lambda mem, lv: mem[lv])(members, leaves)
+    cand = jnp.moveaxis(cand, 0, 1).reshape(xq.shape[0], -1).astype(jnp.int32)
+    dm = jax.vmap(
+        lambda q, pts: pairwise_distance(q[None, :], pts, metric)[0]
+    )(xqf, train[cand])
+    knn_d, knn_i = _dedup_lex_merge(
+        dm.astype(train.dtype), cand, k, sentinel
+    )
+    return _attach(
+        knn_d, knn_i, xq, train, core_t, labels_t, last_t, anc, birth,
+        sel_anc, eps_min, eps_max, sel_ids, kth_col, with_membership,
+    )
+
+
 def _predict_kernel_fused(
     xq, train_rows, train_t, colmask, core_t, labels_t, last_t, anc, birth,
     sel_anc, eps_min, eps_max, sel_ids,
@@ -194,6 +243,15 @@ def _jitted_kernel(which: str):
             _predict_kernel_xla,
             static_argnames=(
                 "k", "kth_col", "metric", "row_tile", "col_tile",
+                "with_membership",
+            ),
+            donate_argnums=donate,
+        )
+    if which == "rpforest":
+        return jax.jit(
+            _predict_kernel_rpf,
+            static_argnames=(
+                "k", "kth_col", "metric", "depth", "sentinel",
                 "with_membership",
             ),
             donate_argnums=donate,
@@ -275,6 +333,22 @@ class Predictor:
             self._train_t = jax.device_put(np.ascontiguousarray(x.T))
             self._colmask = jax.device_put(colmask)
             self._lanes = LANES
+        elif self.backend == "rpforest":
+            # One pad row past the sentinel id (= n_train), so a short
+            # candidate list's sentinel entries gather a zero row whose inf
+            # distance keeps them out of every argmin.
+            self._row_mult = 1
+            n_pad = n + 1
+            rpf = model.rpf
+            self._train = jax.device_put(
+                jnp.asarray(_pad_rows(np.asarray(model.data, dtype), n_pad))
+            )
+            self._rpf_normals = jax.device_put(jnp.asarray(rpf["normals"]))
+            self._rpf_thresholds = jax.device_put(
+                jnp.asarray(rpf["thresholds"])
+            )
+            self._rpf_members = jax.device_put(jnp.asarray(rpf["members"]))
+            self._rpf_depth = int(rpf["depth"])
         else:
             self._row_mult = 1
             self.row_tile_cap = _MAX_ROW_TILE
@@ -337,6 +411,16 @@ class Predictor:
                 self._birth, self._sel_anc, self._eps_min, self._eps_max,
                 self._sel_ids, k=self.k, kth_col=self.kth_col,
                 with_membership=with_membership, interpret=self._interpret,
+            )
+        if self.backend == "rpforest":
+            return _jitted_kernel("rpforest")(
+                staged, self._rpf_normals, self._rpf_thresholds,
+                self._rpf_members, self._train, self._core_t,
+                self._labels_t, self._last_t, self._anc, self._birth,
+                self._sel_anc, self._eps_min, self._eps_max, self._sel_ids,
+                k=self.k, kth_col=self.kth_col, metric=self.model.metric,
+                depth=self._rpf_depth, sentinel=self.model.n_train,
+                with_membership=with_membership,
             )
         dev_rows = max(bucket, self._row_mult)
         row_tile = min(_next_pow2(max(dev_rows, 8)), self.row_tile_cap)

@@ -295,6 +295,9 @@ def build_report(
         "total_wall_s": round(sum(p["wall_s"] for p in phases.values()), 6),
         "event_count": len(tracer.events),
     }
+    knn_index = knn_index_section(tracer)
+    if knn_index is not None:
+        report["knn_index"] = knn_index
     if memory is not None:
         report["memory"] = json_sanitize(memory)
     if per_host is not None:
@@ -327,6 +330,46 @@ def latency_percentiles(walls: list[float] | tuple[float, ...]) -> dict:
         "p99_s": round(rank(0.99), 6),
         "max_s": round(ws[-1], 6),
     }
+
+
+def knn_index_section(tracer: Tracer) -> dict | None:
+    """The run report's ``knn_index`` section: build/query/rescan aggregates
+    for the rp-forest approximate-neighbor tier (``config.knn_index``).
+    Walls sum per stage; ``recall_at_k`` reports the LAST query event's
+    sampled recall (the post-merge figure — earlier events are per-stage
+    diagnostics) and ``rescan_improved`` totals the rows each
+    neighbor-of-neighbor round tightened. None when the run never built an
+    index (exact tier), so the section is omitted rather than empty."""
+    build = [e for e in tracer.events if e.name == "knn_index_build"]
+    query = [e for e in tracer.events if e.name == "knn_index_query"]
+    rescan = [e for e in tracer.events if e.name == "knn_index_rescan"]
+    if not build and not query and not rescan:
+        return None
+    section: dict = {
+        "builds": len(build),
+        "build_wall_s": round(sum(e.wall_s for e in build), 6),
+        "queries": len(query),
+        "query_wall_s": round(sum(e.wall_s for e in query), 6),
+        "rescan_rounds": len(rescan),
+        "rescan_wall_s": round(sum(e.wall_s for e in rescan), 6),
+    }
+    if build:
+        last = build[-1].fields
+        for key in ("trees", "depth", "leaf_size", "max_leaf", "n"):
+            if last.get(key) is not None:
+                section[key] = int(last[key])
+    recalls = [
+        e.fields["recall_at_k"]
+        for e in query
+        if e.fields.get("recall_at_k") is not None
+    ]
+    if recalls:
+        section["recall_at_k"] = float(recalls[-1])
+    if rescan:
+        section["rescan_improved"] = int(
+            sum(int(e.fields.get("improved", 0)) for e in rescan)
+        )
+    return section
 
 
 def predict_latency_section(tracer: Tracer) -> dict | None:

@@ -1,0 +1,302 @@
+#!/usr/bin/env python3
+"""Replay a model dump (+ optional trace) and measure k-NN recall vs exact.
+
+Usage::
+
+    python scripts/check_recall.py MODEL.npz [TRACE.jsonl]
+        [--k K] [--sample N] [--min-recall R]
+
+Root-cause helper for the approximate-neighbor tier (README "Approximate
+neighbors"): loads a ``hdbscan-tpu-model/2`` artifact, routes a subsample of
+its own training rows down the STORED rp-forest planes (the exact
+arithmetic ``serve/predict``'s rpforest backend runs — ``depth`` dot+compare
+steps per tree, then a scan of only the T visited leaves' members), and
+reports per-point recall@k against a full exact scan recomputed here. The
+subsample is capped at 5000 rows (``--sample``, default 512) so the
+validator stays tractable in pure Python. Given a trace, it also validates
+the three ``knn_index_*`` event schemas (the ``scripts/check_trace.py``
+invariants: positive geometry fields, rescan ``round`` within
+``rescan_rounds``, recall in [0, 1]) and prints the fit-time recorded
+recall next to the replayed figure — fit-time recall includes the
+multi-tree merge AND rescan rounds, so it upper-bounds the stored-index
+(serving-path) recall printed here.
+
+Exit code 0 = recall >= ``--min-recall`` (default 0, report-only) and no
+trace violations; 1 otherwise. Pure stdlib on purpose — including the
+``.npz`` reader — so the validator runs where run artifacts land, without
+numpy or jax installed.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import math
+import struct
+import sys
+import zipfile
+
+TRACE_SCHEMA_PREFIX = "hdbscan-tpu-trace/"
+MODEL_SCHEMAS = ("hdbscan-tpu-model/1", "hdbscan-tpu-model/2")
+MAX_SAMPLE = 5000
+
+#: numpy descr -> (struct format char, item size). Covers every dtype the
+#: artifact writes (float64/float32/int64/int32/bool).
+_DESCR = {
+    "<f8": ("d", 8),
+    "<f4": ("f", 4),
+    "<i8": ("q", 8),
+    "<i4": ("i", 4),
+    "|b1": ("B", 1),
+    "|u1": ("B", 1),
+}
+
+
+def read_npy(buf: bytes):
+    """Minimal ``.npy`` v1/v2 parser: returns ``(flat_values, shape)``."""
+    if buf[:6] != b"\x93NUMPY":
+        raise ValueError("not a .npy payload")
+    major = buf[6]
+    if major == 1:
+        (hlen,) = struct.unpack("<H", buf[8:10])
+        off = 10
+    else:
+        (hlen,) = struct.unpack("<I", buf[8:12])
+        off = 12
+    header = ast.literal_eval(buf[off : off + hlen].decode("latin1"))
+    descr, shape = header["descr"], tuple(header["shape"])
+    if header.get("fortran_order"):
+        raise ValueError("fortran-order arrays are not produced by the artifact")
+    try:
+        fmt, size = _DESCR[descr]
+    except KeyError:
+        raise ValueError(f"unsupported dtype {descr!r}") from None
+    count = 1
+    for s in shape:
+        count *= s
+    data = buf[off + hlen : off + hlen + count * size]
+    vals = list(struct.unpack(f"<{count}{fmt}", data))
+    return vals, shape
+
+
+def load_model(path: str) -> dict:
+    """Artifact arrays as ``{name: (flat, shape)}`` plus parsed ``meta``."""
+    out: dict = {}
+    with zipfile.ZipFile(path) as z:
+        for name in z.namelist():
+            key = name[:-4] if name.endswith(".npy") else name
+            buf = z.read(name)
+            if key == "meta":
+                vals, _ = read_npy(buf)
+                out["meta"] = json.loads(bytes(int(v) for v in vals).decode())
+            else:
+                out[key] = read_npy(buf)
+    return out
+
+
+def _dist2(a, b, d: int, ao: int, bo: int) -> float:
+    """Squared euclidean between row ``ao`` of flat ``a`` and ``bo`` of
+    ``b`` (monotone in the true distance, so top-k sets are identical)."""
+    s = 0.0
+    for j in range(d):
+        t = a[ao + j] - b[bo + j]
+        s += t * t
+    return s
+
+
+def _manhattan(a, b, d, ao, bo):
+    return sum(abs(a[ao + j] - b[bo + j]) for j in range(d))
+
+
+def _chebyshev(a, b, d, ao, bo):
+    return max(abs(a[ao + j] - b[bo + j]) for j in range(d))
+
+
+_METRIC_FNS = {
+    "euclidean": _dist2,  # squared: same ordering, cheaper
+    "manhattan": _manhattan,
+    "chebyshev": _chebyshev,
+}
+
+
+def exact_topk(data, n, d, qrow: int, k: int, dist) -> list[int]:
+    """ids of the k nearest rows to ``qrow`` (self included, (dist, id)
+    lex tie-break — the repo-wide deterministic ordering)."""
+    pairs = [(dist(data, data, d, qrow * d, i * d), i) for i in range(n)]
+    pairs.sort()
+    return [i for _, i in pairs[:k]]
+
+
+def routed_topk(data, n, d, qrow, k, dist, rpf_meta, normals, thresholds,
+                members) -> list[int]:
+    """ids of the k nearest rows among the T routed leaves' members — the
+    ``serve/predict`` rpforest candidate set, replayed stdlib-only."""
+    trees, depth = rpf_meta["trees"], rpf_meta["depth"]
+    nvals, nshape = normals
+    tvals, _ = thresholds
+    mvals, mshape = members
+    planes = nshape[1]  # 2^depth - 1
+    lmax = mshape[2]
+    cand: set[int] = set()
+    for t in range(trees):
+        node = 0
+        for level in range(depth):
+            heap = (1 << level) - 1 + node
+            base = (t * planes + heap) * d
+            proj = sum(
+                data[qrow * d + j] * nvals[base + j] for j in range(d)
+            )
+            node = node * 2 + (1 if proj >= tvals[t * planes + heap] else 0)
+        off = (t * mshape[1] + node) * lmax
+        cand.update(int(mvals[off + j]) for j in range(lmax))
+    pairs = sorted((dist(data, data, d, qrow * d, i * d), i) for i in cand)
+    return [i for _, i in pairs[:k]]
+
+
+def check_knn_index_events(path: str) -> tuple[list[dict], list[str]]:
+    """The ``knn_index_*`` schema checks, shared contract with
+    ``scripts/check_trace.py`` (duplicated stdlib-only on purpose)."""
+    events: list[dict] = []
+    errors: list[str] = []
+
+    def pos(v):
+        return isinstance(v, int) and not isinstance(v, bool) and v > 0
+
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError as e:
+                errors.append(f"{path}:{lineno}: not valid JSON ({e})")
+                continue
+            stage = ev.get("stage")
+            if not isinstance(stage, str) or not stage.startswith("knn_index_"):
+                continue
+            events.append(ev)
+            schema = ev.get("schema")
+            if not isinstance(schema, str) or not schema.startswith(
+                TRACE_SCHEMA_PREFIX
+            ):
+                errors.append(f"{path}:{lineno}: bad schema tag {schema!r}")
+            if stage == "knn_index_build":
+                for key in ("trees", "depth", "leaf_size", "n"):
+                    if not pos(ev.get(key)):
+                        errors.append(
+                            f"{path}:{lineno}: build {key}={ev.get(key)!r}"
+                        )
+            elif stage == "knn_index_query":
+                recall = ev.get("recall_at_k")
+                if recall is not None and not (
+                    isinstance(recall, (int, float))
+                    and 0.0 <= float(recall) <= 1.0
+                ):
+                    errors.append(
+                        f"{path}:{lineno}: recall_at_k={recall!r} not in [0,1]"
+                    )
+            elif stage == "knn_index_rescan":
+                rnd, rounds = ev.get("round"), ev.get("rescan_rounds")
+                if not (
+                    isinstance(rnd, int)
+                    and pos(rounds)
+                    and 0 <= rnd < rounds
+                ):
+                    errors.append(
+                        f"{path}:{lineno}: round={rnd!r} not in "
+                        f"[0, rescan_rounds={rounds!r})"
+                    )
+    return events, errors
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    k, sample, min_recall = 16, 512, 0.0
+    paths = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--k":
+            k = int(argv[i + 1]); i += 2
+        elif a == "--sample":
+            sample = int(argv[i + 1]); i += 2
+        elif a == "--min-recall":
+            min_recall = float(argv[i + 1]); i += 2
+        else:
+            paths.append(a); i += 1
+    if not paths or len(paths) > 2:
+        print(__doc__, file=sys.stderr)
+        return 1
+    sample = min(sample, MAX_SAMPLE)
+
+    model = load_model(paths[0])
+    meta = model["meta"]
+    if meta.get("schema") not in MODEL_SCHEMAS:
+        print(f"FAIL {paths[0]}: unknown schema {meta.get('schema')!r}",
+              file=sys.stderr)
+        return 1
+    errors: list[str] = []
+    fit_recall = None
+    if len(paths) == 2:
+        events, errors = check_knn_index_events(paths[1])
+        recalls = [
+            e["recall_at_k"]
+            for e in events
+            if e.get("stage") == "knn_index_query"
+            and e.get("recall_at_k") is not None
+        ]
+        if recalls:
+            fit_recall = float(recalls[-1])
+        print(f"trace: {len(events)} knn_index_* events, "
+              f"{len(errors)} violation(s)")
+
+    rpf_meta = meta.get("rpf")
+    if rpf_meta is None:
+        for err in errors:
+            print(f"FAIL {err}", file=sys.stderr)
+        print(f"{paths[0]}: no rp-forest index stored "
+              f"({meta.get('schema')}); nothing to replay")
+        return 1 if errors else 0
+
+    data, shape = model["data"]
+    n, d = shape
+    metric = meta.get("params", {}).get("dist_function", "euclidean")
+    dist = _METRIC_FNS.get(metric)
+    if dist is None:
+        print(f"FAIL unsupported metric {metric!r} for stdlib replay",
+              file=sys.stderr)
+        return 1
+    k = min(k, n)
+    count = min(sample, n)
+    step = max(1, n // count)
+    rows = list(range(0, n, step))[:count]
+    recalls = []
+    for qrow in rows:
+        exact = set(exact_topk(data, n, d, qrow, k, dist))
+        routed = routed_topk(
+            data, n, d, qrow, k, dist, rpf_meta,
+            model["rpf_normals"], model["rpf_thresholds"],
+            model["rpf_members"],
+        )
+        recalls.append(len(exact.intersection(routed)) / k)
+    recalls.sort()
+    mean = sum(recalls) / len(recalls)
+    p5 = recalls[max(0, math.ceil(0.05 * len(recalls)) - 1)]
+    print(
+        f"stored-index recall@{k} over {len(recalls)} rows: "
+        f"mean={mean:.4f} p5={p5:.4f} min={recalls[0]:.4f}"
+        + (f" (fit-time traced recall: {fit_recall:.4f})"
+           if fit_recall is not None else "")
+    )
+    for err in errors:
+        print(f"FAIL {err}", file=sys.stderr)
+    if mean < min_recall:
+        print(f"FAIL mean recall {mean:.4f} < --min-recall {min_recall}",
+              file=sys.stderr)
+        return 1
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -21,7 +21,13 @@ strictly increasing per (process, device). Host finalize events
 merge forest, ``vectorized``/``reference`` for the tree stages). Serving
 events (``serve/predict.py``, README "Serving") add three: every
 ``predict_batch`` event must carry a power-of-two ``bucket``, ``rows`` in
-``[1, bucket]``, and a per-process strictly increasing ``batch_seq``. Given
+``[1, bucket]``, and a per-process strictly increasing ``batch_seq``.
+Approximate-neighbor events (``ops/rpforest.py``, README "Approximate
+neighbors") add three schemas: ``knn_index_build`` must carry positive
+integer ``trees``/``depth``/``leaf_size``/``n`` with ``max_leaf <=
+leaf_size``; ``knn_index_query`` positive ``n``/``k``/``trees`` and, when
+sampled, ``recall_at_k`` in [0, 1]; ``knn_index_rescan`` an integer
+``round`` in ``[0, rescan_rounds)`` and a non-negative ``improved``. Given
 a report (``utils/telemetry.REPORT_SCHEMA``), additionally cross-checks
 that the report's per-phase wall totals equal the trace's per-stage wall
 sums within 1e-6, and — when the report carries a ``predict_latency``
@@ -167,6 +173,10 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
                             f"increasing (prev {prev})"
                         )
                     last_batch_seq[proc] = bseq
+            # Approximate-neighbor invariants (ops/rpforest.py): the three
+            # knn_index_* events each pin their geometry fields.
+            if stage in ("knn_index_build", "knn_index_query", "knn_index_rescan"):
+                errors += _check_knn_index(path, lineno, stage, ev)
             # Per-device wall events: each device's timeline must be ordered.
             device = ev.get("device")
             if isinstance(device, int) and isinstance(seq, int):
@@ -179,6 +189,50 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
                     )
                 last_dev_seq[key] = seq
     return events, errors
+
+
+def _pos_int(val) -> bool:
+    return isinstance(val, int) and not isinstance(val, bool) and val > 0
+
+
+def _check_knn_index(path: str, lineno: int, stage: str, ev: dict) -> list[str]:
+    """The three rp-forest event schemas (ops/rpforest.py)."""
+    errors: list[str] = []
+    where = f"{path}:{lineno}: {stage}"
+    if stage == "knn_index_build":
+        for key in ("trees", "depth", "leaf_size", "n"):
+            if not _pos_int(ev.get(key)):
+                errors.append(f"{where} {key}={ev.get(key)!r} not a positive int")
+        max_leaf = ev.get("max_leaf")
+        leaf_size = ev.get("leaf_size")
+        if _pos_int(max_leaf) and _pos_int(leaf_size) and max_leaf > leaf_size:
+            errors.append(
+                f"{where} max_leaf={max_leaf} exceeds leaf_size={leaf_size}"
+            )
+    elif stage == "knn_index_query":
+        for key in ("n", "k", "trees"):
+            if not _pos_int(ev.get(key)):
+                errors.append(f"{where} {key}={ev.get(key)!r} not a positive int")
+        recall = ev.get("recall_at_k")
+        if recall is not None and (
+            not isinstance(recall, (int, float))
+            or isinstance(recall, bool)
+            or not (0.0 <= float(recall) <= 1.0)
+        ):
+            errors.append(f"{where} recall_at_k={recall!r} not in [0, 1]")
+    else:  # knn_index_rescan
+        rnd = ev.get("round")
+        rounds = ev.get("rescan_rounds")
+        if not isinstance(rnd, int) or not _pos_int(rounds) or not (
+            0 <= rnd < rounds
+        ):
+            errors.append(
+                f"{where} round={rnd!r} not in [0, rescan_rounds={rounds!r})"
+            )
+        improved = ev.get("improved")
+        if not isinstance(improved, int) or isinstance(improved, bool) or improved < 0:
+            errors.append(f"{where} improved={improved!r} not a non-negative int")
+    return errors
 
 
 def validate_report(

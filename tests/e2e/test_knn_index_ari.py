@@ -1,0 +1,82 @@
+"""e2e ARI acceptance gate for the approximate-neighbor tier (ISSUE 6):
+an rpforest fit on the 5k synthetic acceptance dataset must score at least
+0.99x the exact-path ARI against ground truth, for both fit families.
+"""
+
+import numpy as np
+
+from hdbscan_tpu import HDBSCANParams
+from hdbscan_tpu.models import exact, mr_hdbscan
+from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+from tests.conftest import make_blobs
+
+
+def _dataset():
+    rng = np.random.default_rng(13)
+    return make_blobs(rng, n=5000, d=3, centers=5, spread=0.25)
+
+
+def _params(**overrides):
+    base = dict(min_points=8, min_cluster_size=100, processing_units=2048)
+    base.update(overrides)
+    return HDBSCANParams(**base)
+
+
+def test_rpforest_ari_gate_exact_family():
+    data, truth = _dataset()
+    ari_exact = adjusted_rand_index(
+        exact.fit(data, _params()).labels, truth
+    )
+    ari_rpf = adjusted_rand_index(
+        exact.fit(
+            data,
+            _params(
+                knn_index="rpforest", rpf_trees=4, rpf_leaf_size=512,
+                rpf_rescan_rounds=1,
+            ),
+        ).labels,
+        truth,
+    )
+    assert ari_rpf >= 0.99 * ari_exact, (
+        f"rpforest ARI {ari_rpf:.4f} < 0.99 x exact ARI {ari_exact:.4f}"
+    )
+
+
+def test_rpforest_ari_gate_mr_family():
+    data, truth = _dataset()
+    ari_exact = adjusted_rand_index(
+        mr_hdbscan.fit(data, _params()).labels, truth
+    )
+    ari_rpf = adjusted_rand_index(
+        mr_hdbscan.fit(
+            data,
+            _params(
+                knn_index="rpforest", rpf_trees=4, rpf_leaf_size=512,
+                rpf_rescan_rounds=1,
+            ),
+        ).labels,
+        truth,
+    )
+    assert ari_rpf >= 0.99 * ari_exact, (
+        f"rpforest ARI {ari_rpf:.4f} < 0.99 x mr exact ARI {ari_exact:.4f}"
+    )
+
+
+def test_auto_flips_to_rpforest_and_matches():
+    """``knn_index=auto`` above the threshold runs the same deterministic
+    forest as an explicit ``rpforest`` fit — identical labels; below the
+    threshold it stays bitwise with the exact tier."""
+    data, truth = _dataset()
+    knobs = dict(rpf_trees=4, rpf_leaf_size=512, rpf_rescan_rounds=1)
+    auto = exact.fit(
+        data, _params(knn_index="auto", knn_index_threshold=1000, **knobs)
+    )
+    explicit = exact.fit(data, _params(knn_index="rpforest", **knobs))
+    np.testing.assert_array_equal(auto.labels, explicit.labels)
+
+    below = exact.fit(
+        data,
+        _params(knn_index="auto", knn_index_threshold=10**9, **knobs),
+    )
+    exact_fit = exact.fit(data, _params())
+    np.testing.assert_array_equal(below.labels, exact_fit.labels)

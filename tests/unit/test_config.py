@@ -1,0 +1,68 @@
+"""Eager config validation (``config.HDBSCANParams.__post_init__``): every
+backend-style flag rejects unknown values AT CONSTRUCTION with the allowed
+list in the message, instead of failing deep inside a fit.
+"""
+
+import pytest
+
+from hdbscan_tpu.config import HDBSCANParams
+
+
+@pytest.mark.parametrize(
+    "field,bad,allowed",
+    [
+        ("knn_backend", "cuda", ("auto", "xla", "pallas", "fused")),
+        ("scan_backend", "nccl", ("auto", "host", "ring")),
+        ("tree_backend", "gpu", ("auto", "reference", "vectorized")),
+        ("predict_backend", "onnx", ("auto", "xla", "fused", "rpforest")),
+        ("knn_index", "annoy", ("auto", "exact", "rpforest")),
+    ],
+)
+def test_backend_flags_validate_eagerly(field, bad, allowed):
+    with pytest.raises(ValueError) as exc:
+        HDBSCANParams(**{field: bad})
+    msg = str(exc.value)
+    assert field in msg and repr(bad) in msg
+    for value in allowed:
+        assert f"'{value}'" in msg, f"{field} error must list {value!r}"
+
+
+@pytest.mark.parametrize(
+    "field,bad",
+    [
+        ("knn_index_threshold", 0),
+        ("rpf_trees", 0),
+        ("rpf_leaf_size", 3),
+        ("rpf_rescan_rounds", -1),
+    ],
+)
+def test_rpforest_knob_ranges(field, bad):
+    with pytest.raises(ValueError, match=field):
+        HDBSCANParams(**{field: bad})
+
+
+def test_valid_backend_values_construct():
+    for knn_index in ("auto", "exact", "rpforest"):
+        p = HDBSCANParams(
+            knn_index=knn_index, rpf_trees=2, rpf_leaf_size=64,
+            rpf_rescan_rounds=0, knn_index_threshold=12345,
+        )
+        assert p.knn_index == knn_index
+    for predict_backend in ("auto", "xla", "fused", "rpforest"):
+        assert HDBSCANParams(
+            predict_backend=predict_backend
+        ).predict_backend == predict_backend
+
+
+def test_flag_parsing_roundtrip():
+    """The CLI flag table covers the new knobs (``FLAG_FIELDS``)."""
+    from hdbscan_tpu.config import FLAG_FIELDS
+
+    for flag, field, conv in (
+        ("knn_index", "knn_index", str),
+        ("knn_index_threshold", "knn_index_threshold", int),
+        ("rpf_trees", "rpf_trees", int),
+        ("rpf_leaf_size", "rpf_leaf_size", int),
+        ("rpf_rescan", "rpf_rescan_rounds", int),
+    ):
+        assert FLAG_FIELDS.get(flag) == (field, conv)

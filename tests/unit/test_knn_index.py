@@ -1,0 +1,370 @@
+"""Approximate-neighbor tier (``ops/rpforest.py``, README "Approximate
+neighbors"): forest construction invariants, recall floors across dataset
+shapes and seeds, the exact-tier bitwise escape hatch, the ``auto`` flip
+threshold, mesh-sharded parity, and the three ``knn_index_*`` trace events
+against the ``scripts/check_trace.py`` validator.
+"""
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.config import HDBSCANParams
+from hdbscan_tpu.core.knn import resolve_index_for
+from hdbscan_tpu.ops.rpforest import (
+    RPForest,
+    build_forest,
+    forest_depth,
+    resolve_knn_index,
+    rpforest_core_distances,
+    rpforest_core_distances_rows,
+)
+from hdbscan_tpu.ops.tiled import knn_core_distances
+from hdbscan_tpu.utils.tracing import Tracer
+
+K = 16
+
+
+def _blobs(n: int, seed: int, d: int = 8) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(6, d)) * 6.0
+    per = n // 6 + 1
+    return np.concatenate(
+        [c + rng.normal(size=(per, d)) for c in centers]
+    )[:n]
+
+
+def _moons(n: int, seed: int) -> np.ndarray:
+    """Two interleaved half-circles + noise (no sklearn in the container)."""
+    rng = np.random.default_rng(seed)
+    half = n // 2
+    t1 = rng.uniform(0, np.pi, half)
+    t2 = rng.uniform(0, np.pi, n - half)
+    x = np.concatenate(
+        [
+            np.stack([np.cos(t1), np.sin(t1)], 1),
+            np.stack([1.0 - np.cos(t2), 0.5 - np.sin(t2)], 1),
+        ]
+    )
+    return x + rng.normal(scale=0.05, size=x.shape)
+
+
+def _anisotropic(n: int, seed: int) -> np.ndarray:
+    """Blobs sheared by a fixed linear map — elongated level sets stress
+    the axis-free random hyperplanes."""
+    x = _blobs(n, seed, d=2)
+    return x @ np.array([[0.6, -0.64], [-0.41, 0.85]])
+
+
+def _exact_ids(data: np.ndarray, k: int) -> np.ndarray:
+    _, _, idx = knn_core_distances(
+        data, 2, "euclidean", k=k, return_indices=True
+    )
+    return idx
+
+
+def _recall(exact_ids: np.ndarray, got_ids: np.ndarray) -> float:
+    k = exact_ids.shape[1]
+    hits = [
+        len(np.intersect1d(exact_ids[i], got_ids[i]))
+        for i in range(len(exact_ids))
+    ]
+    return float(np.mean(hits)) / k
+
+
+# -- construction invariants -------------------------------------------------
+
+
+def test_forest_depth_geometry():
+    assert forest_depth(4000, 256) == 4  # ceil(4000/16) = 250 <= 256
+    assert forest_depth(100, 1024) == 0  # whole set fits one leaf
+    # the cap: never split below 1 point per leaf
+    assert 2 ** forest_depth(10, 4) < 10
+
+
+def test_forest_invariants():
+    data = _blobs(1500, 0)
+    forest = build_forest(data, trees=3, leaf_size=200, seed=7)
+    assert isinstance(forest, RPForest)
+    assert forest.members.shape[0] == 3
+    assert forest.depth == forest_depth(1500, 200)
+    assert forest.max_leaf <= 200
+    # every tree's leaves partition the rows: ignoring padding, each row id
+    # appears exactly once per tree
+    mask = np.asarray(forest.leaf_mask)
+    for t in range(3):
+        members = np.asarray(forest.members[t])[mask]
+        assert sorted(members.tolist()) == list(range(1500))
+    # distinct trees use distinct hyperplanes
+    assert not np.allclose(
+        np.asarray(forest.normals[0]), np.asarray(forest.normals[1])
+    )
+
+
+def test_forest_seed_determinism():
+    data = _blobs(800, 3)
+    f1 = build_forest(data, trees=2, leaf_size=128, seed=11)
+    f2 = build_forest(data, trees=2, leaf_size=128, seed=11)
+    f3 = build_forest(data, trees=2, leaf_size=128, seed=12)
+    assert np.array_equal(np.asarray(f1.members), np.asarray(f2.members))
+    assert not np.array_equal(np.asarray(f1.normals), np.asarray(f3.normals))
+
+
+# -- recall sweep ------------------------------------------------------------
+
+
+@pytest.mark.parametrize("maker", [_blobs, _moons, _anisotropic])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_recall_sweep(maker, seed):
+    """>= 0.95 mean recall@16 across dataset shapes and seeds — the
+    acceptance floor for the approximate tier."""
+    data = maker(2000, seed)
+    exact = _exact_ids(data, K)
+    _, _, idx = rpforest_core_distances(
+        data, 2, "euclidean", K, trees=4, leaf_size=256, rescan_rounds=1,
+        seed=seed, return_indices=True,
+    )
+    r = _recall(exact, idx)
+    assert r >= 0.95, f"{maker.__name__} seed={seed}: recall {r:.4f}"
+
+
+def test_rescan_improves_recall():
+    data = _blobs(2000, 5)
+    exact = _exact_ids(data, K)
+    rs = []
+    for rounds in (0, 2):
+        _, _, idx = rpforest_core_distances(
+            data, 2, "euclidean", K, trees=2, leaf_size=128,
+            rescan_rounds=rounds, seed=5, return_indices=True,
+        )
+        rs.append(_recall(exact, idx))
+    assert rs[1] >= rs[0]
+
+
+def test_self_always_present():
+    data = _blobs(700, 2)
+    _, knn, idx = rpforest_core_distances(
+        data, 2, "euclidean", 8, trees=2, leaf_size=64, rescan_rounds=0,
+        seed=0, return_indices=True,
+    )
+    assert np.array_equal(idx[:, 0], np.arange(700))
+    assert np.all(knn[:, 0] == 0.0)
+    assert np.all(np.diff(knn, axis=1) >= 0)  # ascending lists
+
+
+# -- exact-tier escape hatch --------------------------------------------------
+
+
+def test_exact_tier_bitwise_identical():
+    """``index="exact"`` must route through the very same scan — bitwise."""
+    data = _blobs(900, 4)
+    base = knn_core_distances(data, 7, "euclidean", k=12, return_indices=True)
+    via = knn_core_distances(
+        data, 7, "euclidean", k=12, return_indices=True, index="exact"
+    )
+    for a, b in zip(base, via):
+        assert np.array_equal(a, b)
+
+
+def test_unknown_index_rejected():
+    data = _blobs(64, 0)
+    with pytest.raises(ValueError, match="index"):
+        knn_core_distances(data, 3, index="annoy")
+
+
+# -- contract mirror ----------------------------------------------------------
+
+
+def test_core_contract_mirrors_exact():
+    """min_pts semantics (self included, <=1 all zeros), float64 outputs,
+    fetch_knn=False — the ``ops.tiled`` contract on the approximate path."""
+    data = _blobs(500, 6)
+    core, knn, idx = rpforest_core_distances(
+        data, 5, "euclidean", 16, trees=3, leaf_size=128, rescan_rounds=1,
+        seed=1, return_indices=True,
+    )
+    assert core.dtype == np.float64 and knn.dtype == np.float64
+    assert idx.dtype == np.int64
+    # min_pts - 1 = 4 smallest distances INCLUDE self at col 0, so the core
+    # is column 3 — the ``ops.tiled`` min(min_pts - 1, n) - 1 contract.
+    assert np.array_equal(core, knn[:, 3])
+    core0, none = rpforest_core_distances(
+        data, 1, "euclidean", 16, trees=3, leaf_size=128, rescan_rounds=0,
+        seed=1, fetch_knn=False,
+    )
+    assert none is None and np.all(core0 == 0.0)
+
+
+def test_rows_entry_point_matches_full():
+    data = _blobs(1100, 7)
+    core = rpforest_core_distances(
+        data, 6, "euclidean", trees=3, leaf_size=128, rescan_rounds=1, seed=2,
+        fetch_knn=False,
+    )[0]
+    rows = np.array([0, 13, 512, 1099])
+    got = rpforest_core_distances_rows(
+        data, rows, 6, "euclidean", trees=3, leaf_size=128, rescan_rounds=1,
+        seed=2,
+    )
+    assert got.shape == (4,) and got.dtype == np.float64
+    assert np.array_equal(got, core[rows])
+
+
+# -- auto threshold -----------------------------------------------------------
+
+
+def test_auto_threshold_respected():
+    assert resolve_knn_index("auto", 100, 1000) == "exact"
+    assert resolve_knn_index("auto", 1000, 1000) == "rpforest"
+    assert resolve_knn_index("exact", 10**9, 1) == "exact"
+    assert resolve_knn_index("rpforest", 10, 10**9) == "rpforest"
+    with pytest.raises(ValueError, match="knn_index"):
+        resolve_knn_index("annoy", 10, 10)
+
+
+def test_resolve_index_for_params():
+    p = HDBSCANParams(
+        knn_index="auto", knn_index_threshold=500, rpf_trees=3,
+        rpf_leaf_size=64, rpf_rescan_rounds=2, seed=9,
+    )
+    assert resolve_index_for(p, 100) == ("exact", {})
+    index, opts = resolve_index_for(p, 600)
+    assert index == "rpforest"
+    assert opts == {
+        "trees": 3, "leaf_size": 64, "rescan_rounds": 2, "seed": 9,
+    }
+
+
+# -- mesh-sharded parity ------------------------------------------------------
+
+
+def test_mesh_sharded_bitwise_parity():
+    """The ring-tier composition (leaf batches + merged lists row-sharded
+    over the 8-device test mesh) is placement-only: bitwise identical."""
+    from hdbscan_tpu.parallel.mesh import get_mesh
+
+    data = _blobs(2003, 8)  # deliberately not divisible by 8
+    kwargs = dict(
+        trees=3, leaf_size=128, rescan_rounds=1, seed=3, return_indices=True
+    )
+    host = rpforest_core_distances(data, 5, "euclidean", K, **kwargs)
+    mesh = rpforest_core_distances(
+        data, 5, "euclidean", K, mesh=get_mesh(), **kwargs
+    )
+    for a, b in zip(host, mesh):
+        assert np.array_equal(a, b)
+
+
+def test_ring_entry_point_routes_rpforest():
+    from hdbscan_tpu.parallel.ring import ring_knn_core_distances
+
+    data = _blobs(1000, 9)
+    host = rpforest_core_distances(
+        data, 5, "euclidean", trees=2, leaf_size=128, rescan_rounds=0, seed=4,
+        fetch_knn=False,
+    )[0]
+    ring = ring_knn_core_distances(
+        data, 5, "euclidean", fetch_knn=False, index="rpforest",
+        index_opts={"trees": 2, "leaf_size": 128, "rescan_rounds": 0,
+                    "seed": 4},
+    )[0]
+    assert np.array_equal(host, ring)
+
+
+# -- trace events -------------------------------------------------------------
+
+
+def _load_checker(name: str):
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "..", "scripts", f"{name}.py"
+    )
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_trace_events_and_validator(tmp_path):
+    from hdbscan_tpu.utils.tracing import JsonlSink
+
+    trace_path = str(tmp_path / "trace.jsonl")
+    tracer = Tracer(sinks=[JsonlSink(trace_path, static={"process": 0})])
+    data = _blobs(1200, 10)
+    rpforest_core_distances(
+        data, 5, "euclidean", K, trees=3, leaf_size=128, rescan_rounds=2,
+        seed=6, trace=tracer,
+    )
+    tracer.close()
+    names = [e.name for e in tracer.events]
+    assert names.count("knn_index_build") == 1
+    assert names.count("knn_index_query") == 1
+    assert names.count("knn_index_rescan") == 2
+    build = next(e for e in tracer.events if e.name == "knn_index_build")
+    assert build.fields["trees"] == 3
+    assert build.fields["max_leaf"] <= build.fields["leaf_size"]
+    query = next(e for e in tracer.events if e.name == "knn_index_query")
+    assert 0.0 <= query.fields["recall_at_k"] <= 1.0
+    rounds = [
+        e.fields["round"]
+        for e in tracer.events
+        if e.name == "knn_index_rescan"
+    ]
+    assert rounds == [0, 1]
+
+    check_trace = _load_checker("check_trace")
+    events, errors = check_trace.validate_trace(trace_path)
+    assert errors == []
+    assert len(events) == len(tracer.events)
+
+
+def test_check_trace_flags_bad_knn_events(tmp_path):
+    import json
+
+    bad = [
+        {"schema": "hdbscan-tpu-trace/1", "stage": "knn_index_build",
+         "wall_s": 0.1, "seq": 0, "process": 0, "trees": 0, "depth": 2,
+         "leaf_size": 64, "max_leaf": 70, "n": 100},
+        {"schema": "hdbscan-tpu-trace/1", "stage": "knn_index_rescan",
+         "wall_s": 0.1, "seq": 1, "process": 0, "round": 3,
+         "rescan_rounds": 2, "improved": -1, "n": 100, "k": 8},
+        {"schema": "hdbscan-tpu-trace/1", "stage": "knn_index_query",
+         "wall_s": 0.1, "seq": 2, "process": 0, "n": 100, "k": 8,
+         "trees": 2, "recall_at_k": 1.5},
+    ]
+    path = tmp_path / "bad.jsonl"
+    path.write_text("".join(json.dumps(e) + "\n" for e in bad))
+    check_trace = _load_checker("check_trace")
+    _, errors = check_trace.validate_trace(str(path))
+    text = "\n".join(errors)
+    assert "trees=0" in text
+    assert "max_leaf=70 exceeds leaf_size=64" in text
+    assert "round=3" in text
+    assert "improved=-1" in text
+    assert "recall_at_k=1.5" in text
+
+
+def test_check_recall_replay(tmp_path):
+    """The stdlib validator's replayed stored-index recall agrees with a
+    numpy recomputation of the same routed candidate sets."""
+    from hdbscan_tpu.serve.artifact import ClusterModel
+    from hdbscan_tpu.models import hdbscan as small
+
+    data = _blobs(600, 11, d=4)
+    p = HDBSCANParams(
+        min_points=6, min_cluster_size=15, knn_index="rpforest",
+        rpf_trees=3, rpf_leaf_size=64, rpf_rescan_rounds=1,
+    )
+    res = small.fit(data, p)
+    model = ClusterModel.from_fit_result(res, data, p)
+    assert model.rpf is not None
+    path = str(tmp_path / "model.npz")
+    model.save(path)
+    check_recall = _load_checker("check_recall")
+    rc = check_recall.main([path, "--k", "8", "--sample", "64",
+                            "--min-recall", "0.5"])
+    assert rc == 0
+    rc = check_recall.main([path, "--k", "8", "--sample", "64",
+                            "--min-recall", "1.01"])
+    assert rc == 1

@@ -293,3 +293,87 @@ def test_to_cluster_model_methods(fitted):
     data, params, result, _ = fitted
     model = result.to_cluster_model(data, params)
     assert isinstance(model, ClusterModel) and model.mode == "exact"
+
+
+# -- rp-forest serving (schema /2) ------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fitted_rpf():
+    """An approximate (knn_index=rpforest) fit whose artifact carries the
+    stored forest: (data, params, result, model)."""
+    rng = np.random.default_rng(17)
+    data, _ = make_blobs(rng, n=400, d=4, centers=4, spread=0.2)
+    params = HDBSCANParams(
+        min_points=8, min_cluster_size=8, knn_index="rpforest",
+        rpf_trees=3, rpf_leaf_size=64, rpf_rescan_rounds=1,
+    )
+    result = exact.fit(data, params)
+    return data, params, result, ClusterModel.from_fit_result(result, data, params)
+
+
+def test_rpf_artifact_roundtrip(tmp_path, fitted_rpf):
+    data, params, result, model = fitted_rpf
+    assert model.rpf is not None
+    assert model.schema == "hdbscan-tpu-model/2"
+    path = model.save(str(tmp_path / "model_rpf.npz"))
+    loaded = ClusterModel.load(path, params=params, data=data)
+    assert loaded.rpf is not None
+    for key in ("normals", "thresholds", "members", "leaf_mask"):
+        np.testing.assert_array_equal(loaded.rpf[key], model.rpf[key])
+    for key in ("trees", "depth", "leaf_size"):
+        assert loaded.rpf[key] == model.rpf[key]
+    assert loaded.summary()["rpf"]["trees"] == model.rpf["trees"]
+
+
+def test_rpf_v1_artifact_loads_without_index(tmp_path, fitted):
+    """A pre-index /1 artifact still loads (back-compat), just with no
+    forest — and the rpforest backend refuses it with a clear error."""
+    import dataclasses
+
+    *_, model = fitted
+    v1 = dataclasses.replace(model, schema="hdbscan-tpu-model/1", rpf=None)
+    path = v1.save(str(tmp_path / "model_v1.npz"))
+    loaded = ClusterModel.load(path)
+    assert loaded.schema == "hdbscan-tpu-model/1"
+    assert loaded.rpf is None
+    with pytest.raises(ValueError, match="rpforest"):
+        Predictor(loaded, backend="rpforest")
+
+
+def test_rpf_exact_fit_artifact_carries_no_index(fitted):
+    *_, model = fitted
+    assert model.rpf is None  # exact fits don't pay the forest build
+
+
+def test_rpf_training_points_reproduce_fit_labels(fitted_rpf):
+    data, params, result, model = fitted_rpf
+    labels, prob, score = Predictor(model, backend="rpforest").predict(data)
+    np.testing.assert_array_equal(labels, np.asarray(result.labels))
+    assert np.all(prob[np.asarray(result.labels) > 0] > 0)
+    assert np.all((score >= 0) & (score <= 1))
+
+
+def test_rpf_predict_agrees_with_exact_backend(fitted_rpf):
+    data, params, result, model = fitted_rpf
+    rng = np.random.default_rng(23)
+    queries = data[rng.integers(0, len(data), 60)] + rng.normal(
+        0, 0.05, size=(60, data.shape[1])
+    )
+    lab_x, prob_x, _ = Predictor(model, backend="xla").predict(queries)
+    lab_r, prob_r, _ = Predictor(model, backend="rpforest").predict(queries)
+    assert np.mean(lab_x == lab_r) >= 0.95
+
+
+def test_rpf_zero_recompiles_after_warmup(fitted_rpf):
+    from hdbscan_tpu.utils.telemetry import compile_counter
+
+    *_, model = fitted_rpf
+    pred = Predictor(model, backend="rpforest", max_batch=32)
+    pred.warmup(with_membership=True)
+    counter = compile_counter()
+    before = counter()
+    for rows in (1, 5, 8, 17, 32, 70):
+        pred.predict(np.zeros((rows, model.data.shape[1])))
+    pred.predict(np.zeros((4, model.data.shape[1])), with_membership=True)
+    assert counter() - before == 0
