@@ -174,6 +174,43 @@ def main(argv: list[str] | None = None) -> None:
         "calibrated",
     )
 
+    # --- exact path with the device-resident finalize (mst_device leg) -----
+    # Same literal config, mst_backend=device (README "Device-resident
+    # finalize"): the Borůvka round loop runs as one jitted while_loop and
+    # the merge forest is reconstructed from the device event program, so
+    # everything downstream of the core distances crosses the host boundary
+    # in ONE device_get. The row reports the trace-counted contract — one
+    # host_sync per fit, tree_build_device fallbacks — next to the wall and
+    # finalize (tree_*) figures of the host-loop headline above.
+    esnap_dev = len(tracer.events)
+    dev_wall, dev_spread, dev_ari, _, dev_tree = run_exact(
+        HDBSCANParams(
+            min_points=LIT_MIN_PTS,
+            min_cluster_size=MIN_CL_SIZE,
+            mst_backend="device",
+        ),
+        "mst_device",
+    )
+    dev_events = tracer.events[esnap_dev:]
+    dev_fits = 4  # one warm + three timed runs
+    dev_syncs = sum(1 for e in dev_events if e.name == "host_sync")
+    dev_builds = [e for e in dev_events if e.name == "tree_build_device"]
+    mst_device_fields = {
+        "mst_device_wall_s": round(dev_wall, 3),
+        "mst_device_spread_s": [
+            round(dev_spread[0], 3),
+            round(dev_spread[1], 3),
+        ],
+        "mst_device_vs_baseline": round(RB_BASELINE_S / dev_wall, 3),
+        "mst_device_vs_host": round(lit_wall / dev_wall, 3),
+        "mst_device_ari": round(dev_ari, 4),
+        "mst_device_tree_wall_s": round(dev_tree, 3),
+        "mst_device_host_syncs_per_fit": dev_syncs / dev_fits,
+        "mst_device_fallbacks": sum(
+            1 for e in dev_builds if e.fields.get("fallback")
+        ),
+    }
+
     # --- exact path over the ring-sharded scan engine (ring_e2e leg) -------
     # Same literal config, scan_backend=ring: row shards own the k-NN and
     # Borůvka sweeps, column panels circulate over the mesh ring (README
@@ -412,6 +449,7 @@ def main(argv: list[str] | None = None) -> None:
                 "db_flat_vs_baseline": round(DB_BASELINE_S / fl_wall, 3),
                 "db_flat_ari": round(fl_ari, 4),
                 "db_flat_tree_wall_s": round(fl_tree, 3),
+                **mst_device_fields,
                 **rpf_fields,
                 **predict_fields,
                 **ring_fields,

@@ -594,6 +594,181 @@ def bench_finalize(out_path, n=245_057, iters=3, seed=0, min_cluster_size=3000):
         _emit(out_path, row)
 
 
+def _dup_proxy_pool(n, seed=0):
+    """Skin-shaped duplicate-heavy spanning pool (eligible for the device
+    engine: exact-tie lattice weights, no near-tied-unequal pairs).
+
+    Skin's integer lattice collapses ~80% of rows into duplicate groups
+    with a heavy head (the biggest tie groups hold thousands of rows); the
+    proxy reproduces that with a top-50 geometric head over zero-weight
+    duplicate stars plus a near-uniform tail of small groups, joined by a
+    chain of distinct lattice weights.
+    """
+    rng = np.random.default_rng(seed)
+    head = np.maximum(2, ((n // 20) * 0.8 ** np.arange(50)).astype(np.int64))
+    tail_total = n - int(head.sum())
+    tail_n = max(1, int(tail_total / 3.6))
+    k_unique = 50 + tail_n
+    base = tail_total // tail_n
+    sizes = np.full(k_unique, base, np.int64)
+    sizes[:50] = head
+    sizes[50 : 50 + (tail_total - base * tail_n)] += 1
+    starts = np.zeros(k_unique + 1, np.int64)
+    np.cumsum(sizes, out=starts[1:])
+    us, vs = [], []
+    for g in range(k_unique):
+        s0, s1 = starts[g], starts[g + 1]
+        if s1 - s0 > 1:
+            us.append(np.full(s1 - s0 - 1, s0))
+            vs.append(np.arange(s0 + 1, s1))
+    uz, vz = np.concatenate(us), np.concatenate(vs)
+    gi = rng.permutation(k_unique)
+    u = np.concatenate([uz, starts[gi[:-1]]])
+    v = np.concatenate([vz, starts[gi[1:]]])
+    # Dyadic lattice weights (k/1024): exactly float32-representable, so
+    # the device engine stays eligible with jax_enable_x64 off.
+    w = np.concatenate(
+        [np.zeros(len(uz)), 1.0 + np.arange(k_unique - 1) / 1024.0]
+    )
+    return u.astype(np.int64), v.astype(np.int64), w
+
+
+def _erosion_proxy_pool(n, seed=0):
+    """Random-attachment spanning pool with distinct lattice weights + 30%
+    zero-weight duplicate mass — the one-point-at-a-time erosion regime."""
+    rng = np.random.default_rng(seed)
+    v = np.arange(1, n)
+    u = rng.integers(0, v)
+    w = 1.0 + np.arange(n - 1) / 16384.0  # dyadic: f32-exact up to ~16
+    w[np.random.default_rng(seed + 1).random(n - 1) < 0.3] = 0.0
+    return u.astype(np.int64), v, w
+
+
+def bench_mst_device(out_path, n=245_057, iters=3, seed=0,
+                     round_n=50_000, round_d=3, min_pts=8):
+    """Device-resident MST -> merge-forest legs (README "Device-resident
+    finalize").
+
+    ``mst_round``: the jitted Borůvka ``while_loop`` (``core/mst_device.
+    boruvka_mst_device`` — in-jit contraction, one fetch at the end) vs the
+    host round loop (``models/exact.mst_edges_from_core`` — per-round label
+    round-trips), same data/cores, edge lists asserted identical.
+
+    ``finalize_device``: ``build_merge_forest_device`` (device lexsort +
+    union-find event scan, ONE device_get, vectorized host reconstruction)
+    vs the host builder — both the native-C and pure-Python engines — on the
+    Skin-shaped duplicate-heavy 245k proxy, MergeForest fields asserted
+    bitwise equal. Acceptance: ``vs_host_python >= 3x`` at 245k (the
+    cuSLINK-style split: GPU/TPU edge program + array dendrogram assembly).
+    An erosion-shaped secondary row tracks the chain-heavy regime.
+    """
+    from hdbscan_tpu.core import mst_device as MD
+    from hdbscan_tpu.core import tree as T
+    from hdbscan_tpu import native as native_mod
+    from hdbscan_tpu.models.exact import mst_edges_from_core
+    from hdbscan_tpu.ops.tiled import knn_core_distances
+
+    platform = jax.devices()[0].platform
+
+    # --- mst_round: device round loop vs host round loop -------------------
+    rng = np.random.default_rng(seed)
+    centers = rng.uniform(-4, 4, size=(8, round_d))
+    data = (
+        centers[rng.integers(0, 8, round_n)]
+        + rng.normal(0, 0.3, (round_n, round_d))
+    ).astype(np.float64)
+    core, _ = knn_core_distances(
+        data, min_pts, fetch_knn=False, dtype=np.float64
+    )
+
+    def dev_edges():
+        return jax.device_get(
+            MD.boruvka_mst_device(data, core, dtype=np.float64)
+        )
+
+    res = dev_edges()  # warmup + parity edges
+    count = int(res["count"])
+    rounds = int(res["rounds"])
+    walls_d = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        res = dev_edges()
+        walls_d.append(time.perf_counter() - t0)
+    u_h, v_h, w_h = mst_edges_from_core(data, core, dtype=np.float64)
+    walls_h = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        u_h, v_h, w_h = mst_edges_from_core(data, core, dtype=np.float64)
+        walls_h.append(time.perf_counter() - t0)
+    assert count == len(u_h)
+    assert (
+        np.array_equal(res["u"][:count], u_h)
+        and np.array_equal(res["v"][:count], v_h)
+        and np.array_equal(res["w"][:count], w_h)
+    ), "device Borůvka diverged from the host round loop"
+    wd, wh = float(np.median(walls_d)), float(np.median(walls_h))
+    _emit(out_path, dict(
+        leg="mst_round", n=round_n, d=round_d, min_pts=min_pts,
+        platform=platform, rounds=rounds, edges=count, iters=iters,
+        device_wall_s=round(wd, 4), host_wall_s=round(wh, 4),
+        device_per_round_s=round(wd / max(rounds, 1), 4),
+        vs_host=round(wh / wd, 2), edges_bitwise=True,
+    ))
+
+    # --- finalize_device: forest build device vs host (native + python) ----
+    for tag, (u, v, w) in (
+        ("", _dup_proxy_pool(n, seed)),
+        ("_erosion", _erosion_proxy_pool(n, seed)),
+    ):
+        assert MD.supports_inputs(w)
+        MD.build_merge_forest_device(n, u, v, w, build_children=False)
+        walls_dev = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            f_dev = MD.build_merge_forest_device(
+                n, u, v, w, build_children=False
+            )
+            walls_dev.append(time.perf_counter() - t0)
+        host = {}
+        saved = native_mod._lib, native_mod._lib_tried
+        for eng in ("native", "python"):
+            native_mod._lib_tried = eng == "python" or saved[1]
+            native_mod._lib = None if eng == "python" else saved[0]
+            T.build_merge_forest(n, u, v, w)
+            ws = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                ref = T.build_merge_forest(n, u, v, w)
+                ws.append(time.perf_counter() - t0)
+            host[eng] = (float(np.median(ws)), ref)
+        native_mod._lib, native_mod._lib_tried = saved
+        ref = host["native"][1]
+        assert f_dev is not None
+        assert (
+            np.array_equal(f_dev.dist, ref.dist)
+            and np.array_equal(f_dev.sizes, ref.sizes)
+            and list(f_dev.roots) == [int(r) for r in ref.roots]
+            and (
+                ref.kids_csr is None
+                or (
+                    np.array_equal(f_dev.kids_csr[0], ref.kids_csr[0])
+                    and np.array_equal(f_dev.kids_csr[1], ref.kids_csr[1])
+                )
+            )
+        ), "device merge forest diverged from the host builder"
+        wdev = float(np.median(walls_dev))
+        _emit(out_path, dict(
+            leg=f"finalize_device{tag}", n=n, edges=len(u),
+            platform=platform, iters=iters,
+            device_wall_s=round(wdev, 4),
+            host_native_wall_s=round(host["native"][0], 4),
+            host_python_wall_s=round(host["python"][0], 4),
+            vs_host_native=round(host["native"][0] / wdev, 2),
+            vs_host_python=round(host["python"][0] / wdev, 2),
+            bitwise_match=True,
+        ))
+
+
 def bench_rpforest(out_path, n=200_000, d=8, min_pts=16, k=16, trees=4,
                    leaf_size=1024, rescan_rounds=1, iters=1, seed=0,
                    ari_n=5000, recall_sample=256):
@@ -773,7 +948,8 @@ def main():
         os.path.dirname(__file__), "devicebench_r6.jsonl"))
     ap.add_argument(
         "--legs",
-        default="dispatch,exact,rescan,ring,finalize,rpforest,predict",
+        default="dispatch,exact,rescan,ring,finalize,mst_device,rpforest,"
+                "predict",
     )
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument("--compile-cache", default="auto",
@@ -790,6 +966,11 @@ def main():
     ap.add_argument("--finalize-n", type=int, default=245_057,
                     help="finalize-leg vertices (defaults to the "
                          "Skin_NonSkin row count)")
+    ap.add_argument("--mst-n", type=int, default=245_057,
+                    help="finalize_device-leg vertices (Skin row count)")
+    ap.add_argument("--mst-round-n", type=int, default=50_000,
+                    help="mst_round-leg rows (the host loop's O(n^2) scans "
+                         "dominate off-TPU; use ~5000 for CPU smoke rows)")
     ap.add_argument("--rescan-n", type=int, default=1_000_000)
     ap.add_argument("--rescan-col-tile", type=int, default=8192)
     ap.add_argument("--rescan-tiles", default="64,1024",
@@ -824,6 +1005,11 @@ def main():
         )
     if "finalize" in legs:
         bench_finalize(args.out, n=args.finalize_n, iters=args.iters)
+    if "mst_device" in legs:
+        bench_mst_device(
+            args.out, n=args.mst_n, iters=args.iters,
+            round_n=args.mst_round_n,
+        )
     if "rpforest" in legs:
         bench_rpforest(
             args.out, n=args.rpf_n, d=args.rpf_d, trees=args.rpf_trees,

@@ -14,6 +14,7 @@ Usage mirrors the reference's documented contract (``main/Main.java:534-614``)::
         [rpf_trees=N] [rpf_leaf_size=N] [rpf_rescan=N] \
         [scan_backend={auto,host,ring}] \
         [tree_backend={auto,reference,vectorized}] \
+        [mst_backend={auto,host,device}] \
         [consensus=N] [compat_cf={true,false}] \
         [clusterName={local,auto,<host:port>,<pid>,<np>}] \
         [--trace-out PATH] [--report PATH] [--compile-cache {auto,off,DIR}]
@@ -40,7 +41,13 @@ TPU mesh. ``tree_backend`` picks the host finalize engine for the condensed
 tree (README "Finalize pipeline"): ``reference`` is the per-node Python
 walk, ``vectorized`` the array-level engine with bitwise-identical outputs,
 and ``auto`` uses vectorized with a reference fallback on unsupported
-inputs. ``--compile-cache`` controls jax's persistent XLA compile cache:
+inputs. ``mst_backend`` picks the MST -> merge-forest engine upstream of
+that (README "Device-resident finalize"): ``host`` keeps the per-round
+host contraction plus the sequential host forest builder, ``device`` runs
+every Borůvka round and the union-find forest scan in-jit with exactly one
+host sync per fit (trace event ``host_sync``), and ``auto`` uses device on
+big eligible edge pools with a host fallback (bitwise-identical outputs
+either way). ``--compile-cache`` controls jax's persistent XLA compile cache:
 ``auto`` (default) resolves JAX_COMPILATION_CACHE_DIR then the per-user
 default dir, ``off`` disables it, anything else is the cache directory.
 Reports record per-phase ``cache_hits`` next to ``jit_compiles`` so warmed

@@ -236,6 +236,18 @@ class HDBSCANParams:
     #: to reference otherwise. Applies to every finalize call site, including
     #: the per-iteration rebuilds of the refine/refine_flat loops.
     tree_backend: str = "auto"
+    #: MST -> merge-forest engine for the exact path (``core/mst_device.py``):
+    #: "host" keeps the per-round host contraction glue plus the sequential
+    #: host forest builder (the parity oracle), "device" runs every Borůvka
+    #: round in one jitted program and builds the merge forest from a single
+    #: device union-find scan — exactly ONE host sync per fit (trace event
+    #: ``host_sync``), "auto" (default) picks device at/above
+    #: ``core/mst_device.MST_DEVICE_THRESHOLD`` vertices when the edge pool
+    #: is eligible (``mst_device.supports_inputs`` — no near-tied-but-unequal
+    #: weights, integral point weights) and host otherwise. Device output is
+    #: bitwise-identical to host on every MergeForest/CondensedTree field;
+    #: ineligible pools fall back to the host builder (flagged in the trace).
+    mst_backend: str = "auto"
     #: Persistent XLA compilation cache: "auto" (default) enables it at the
     #: default directory (``utils/cache.py`` — ``$JAX_COMPILATION_CACHE_DIR``
     #: or ``~/.cache/hdbscan_tpu_xla``), "off" disables it, any other value
@@ -295,6 +307,11 @@ class HDBSCANParams:
             raise ValueError(
                 "tree_backend must be 'auto', 'reference' or 'vectorized', "
                 f"got {self.tree_backend!r}"
+            )
+        if self.mst_backend not in ("auto", "host", "device"):
+            raise ValueError(
+                "mst_backend must be 'auto', 'host' or 'device', "
+                f"got {self.mst_backend!r}"
             )
         if not self.compile_cache:
             raise ValueError(
@@ -409,6 +426,7 @@ FLAG_FIELDS = {
     "rpf_rescan": ("rpf_rescan_rounds", int),
     "scan_backend": ("scan_backend", str),
     "tree_backend": ("tree_backend", str),
+    "mst_backend": ("mst_backend", str),
     "compile_cache": ("compile_cache", str),
     "predict_backend": ("predict_backend", str),
     "predict_batch": ("predict_max_batch", int),

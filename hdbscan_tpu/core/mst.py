@@ -143,8 +143,8 @@ def mst_edges_with_self_edges(u, v, w, mask, core, valid=None):
 
     Mirrors ``hdbscanstar/HDBSCANStar.java:196-203``: the hierarchy uses the
     self edge (i, i, core_i) to record the level at which point i becomes
-    noise. Host-side helper (numpy-compatible); returns concatenated
-    (u, v, w, mask).
+    noise. Device helper (jnp arrays, traceable under jit); returns
+    concatenated (u, v, w, mask).
     """
     n = core.shape[0]
     idx = jnp.arange(n, dtype=u.dtype)

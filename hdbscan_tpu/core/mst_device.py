@@ -1,0 +1,655 @@
+"""Device-resident MST -> merge-forest engine (``mst_backend=device``).
+
+ROADMAP item 2: after the device Borůvka scans, the seed pipeline
+round-tripped through host NumPy/C twice per fit — ``contract_min_edges``
+glued every Borůvka round and ``core/tree.py::build_merge_forest`` walked
+the sorted edge list one union at a time. This module keeps both stages on
+device (cuSLINK arXiv 2306.16354 / PANDORA arXiv 2401.06089 direction:
+segment-min contraction rounds + pointer-doubling union-find, parallel
+forest reconstruction) so an exact fit performs exactly ONE host sync —
+the final fetch of the forest/result arrays (trace event ``host_sync``).
+
+Engine shape — one device scan plus vectorized host reconstruction:
+
+- Kruskal union order is inherently sequential, so the union-find runs as
+  a ``lax.scan`` over the lexsorted edge list. XLA handles the carried
+  parent array well ONLY in a narrow shape: carry ``par`` alone, resolve
+  both roots in one fused ``while_loop`` (``_find2``), path-compress at
+  the *xs* indices, and make exactly one write at a while-derived index
+  (``par[rb] = ra``). Every richer variant that was tried — union by
+  size, carried top/size/count arrays (even with purely xs-derived
+  indices and a single extra array), select-derived winner indices — hits
+  a copy-inserting alias-analysis path and regresses the 245k-edge scan
+  from 0.2 s to 19 s..timeout. The scan therefore emits only the union
+  event stream ``(ra, rb)`` (a step is a merge iff ``ra != rb``).
+- EVERYTHING else reconstructs from that stream with O(m log m)
+  vectorized numpy on host AFTER the single fetch (host numpy gathers run
+  ~10x faster than XLA CPU's scattered gathers and pay no per-shape
+  compile; none of it is per-edge Python):
+
+  * merge-tree child tops ``(ta, tb)`` — a 2t-row (value, time) sweep:
+    per merge one fused query+publish row and one query row, one argsort
+    on the packed key, then a segmented running-max over event payloads.
+  * absorption flags by exact weight equality (see eligibility below),
+    owner (= nearest non-absorbed ancestor) via pointer doubling.
+  * one global Euler tour over the merge forest (roots chained in
+    ascending order, so a single distance-to-terminal pointer-doubling
+    list ranking orders every slot), giving DFS preorder — kids of one
+    owner sort by their entry rank, which reproduces the host builder's
+    a-side-before-b-side splice order — and subtree leaf intervals.
+  * sizes as leaf-interval prefix-sum differences over the tour order,
+    and roots via pointer-jumped flattening of the element parent map.
+
+Survivor convention matches the host reference exactly: ``parent[rb] =
+ra`` with no union-by-size (``core/tree.py::build_merge_forest``), so the
+event stream replays the same unions the host loop performs.
+
+Eligibility contract (``supports_inputs``): the host builder absorbs a
+child node into its parent when their weights are *near*-tied
+(``_tied(anchor, w, 1e-9)`` against the child's tie-group anchor). On
+device that chained-anchor recursion is replaced by exact equality, which
+is equivalent IFF the edge pool contains no near-tied-but-unequal weight
+pair: then every tie group is exactly equal, group anchors equal group
+weights, and ``absorb(parent, child) <=> w_child == w_parent``. The
+adjacent-pair check on the sorted weights certifies this (for sorted
+a <= b <= c, gaps (a,b) and (b,c) both far implies (a,c) far). Sizes are
+interval sums rather than the host's per-merge nested additions, so point
+weights must be integral with an exactly-representable total (< 2**53) —
+integer f64 sums in that range are exact in any association order, hence
+bitwise equal to the host's. Unit weights always qualify.
+``mst_backend=auto`` only attempts the device engine when this predicate
+holds; a pool that fails the post-fetch re-check falls back to the host
+builder (flagged in the trace) rather than diverge.
+
+Bitwise parity with the host reference on every ``MergeForest`` field —
+children (including ``None`` for absorbed), dist, roots, sizes, kids CSR —
+is pinned by the randomized sweep in ``tests/unit/test_mst_device.py``.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from hdbscan_tpu.core.tree import TIE_RTOL, MergeForest
+
+__all__ = [
+    "supports_inputs",
+    "resolve_mst_backend",
+    "forest_events_device",
+    "assemble_merge_forest",
+    "build_merge_forest_device",
+    "boruvka_mst_device",
+]
+
+#: ``mst_backend=auto`` flip point (vertices). Below it the host builder
+#: (C fast path) wins on latency and per-shape compile cost; the device
+#: engine pays one compile per (n, m) shape, which tier-1's many tiny fits
+#: must not re-pay hundreds of times.
+MST_DEVICE_THRESHOLD = 65536
+
+def _ties_exact(w, tie_rtol: float = TIE_RTOL) -> bool:
+    """No near-tied-but-unequal pair among the (finite) weights."""
+    w = np.asarray(w, np.float64)
+    w = w[np.isfinite(w)]
+    if w.size < 2:
+        return True
+    sw = np.sort(w)
+    a, b = sw[:-1], sw[1:]
+    gap = b - a
+    near = gap <= tie_rtol * np.maximum(np.abs(a), np.abs(b))
+    return not bool(np.any(near & (gap != 0)))
+
+
+def supports_inputs(
+    w,
+    point_weights=None,
+    tie_rtol: float = TIE_RTOL,
+) -> bool:
+    """Host-side predicate: device forest output is bitwise-equal to host.
+
+    True iff no two distinct edge weights are near-tied within ``tie_rtol``
+    (so exact-equality absorption matches the host's anchor-chained
+    ``_tied``) and point weights sum exactly in any association order
+    (integral, total < 2**53; unit weights always do) — sizes come from
+    interval prefix sums, not the host's per-merge addition order.
+    """
+    if not _ties_exact(w, tie_rtol):
+        return False
+    if point_weights is not None:
+        pw = np.asarray(point_weights, np.float64)
+        if pw.size and (
+            bool(np.any(pw != np.floor(pw))) or float(np.sum(pw)) >= 2**53
+        ):
+            return False
+    return True
+
+
+def resolve_mst_backend(
+    params=None,
+    n: int | None = None,
+    mst_backend: str | None = None,
+) -> str:
+    """The MST/forest engine a fit will *attempt*: "host" or "device".
+
+    ``auto`` picks device only above :data:`MST_DEVICE_THRESHOLD` vertices
+    (per-shape compile cost; see the constant's note). Input eligibility
+    (``supports_inputs``) is checked later against the actual edge pool —
+    an ineligible pool falls back to the host builder even when this
+    resolves "device".
+    """
+    backend = mst_backend or getattr(params, "mst_backend", "auto")
+    if backend in ("host", "device"):
+        return backend
+    if n is not None and n >= MST_DEVICE_THRESHOLD:
+        return "device"
+    return "host"
+
+
+# ---------------------------------------------------------------------------
+# Device stage: lexsort + two scans
+# ---------------------------------------------------------------------------
+
+
+def _find2(par, x, y):
+    """Resolve both roots in ONE while loop (fused termination test)."""
+
+    def cond(s):
+        a, b = s
+        return (par[a] != a) | (par[b] != b)
+
+    def body(s):
+        a, b = s
+        return (
+            jnp.where(par[a] != a, par[a], a),
+            jnp.where(par[b] != b, par[b], b),
+        )
+
+    return lax.while_loop(cond, body, (x, y))
+
+
+def _uf_scan(su, sv, n: int):
+    """Kruskal union-find over lexsorted edges -> (final par, (ra, rb)).
+
+    Keep this carry shape EXACTLY as is (see module docstring): ``par``
+    alone, compression writes at xs indices, one union write at the raw
+    while output. Padded edges arrive as self-loops (u = v = 0) and fall
+    out as non-merges.
+    """
+    par0 = jnp.arange(n, dtype=jnp.int32)
+
+    def step(par, xs):
+        ue, ve = xs
+        ra, rb = _find2(par, ue, ve)
+        par = par.at[ue].set(ra).at[ve].set(rb)
+        par = par.at[rb].set(ra)  # no-op self-write when ra == rb
+        return par, (ra, rb)
+
+    # unroll=8 amortizes XLA CPU's per-step loop overhead (measured 0.22 s
+    # -> 0.095 s at 245k edges) without touching the op sequence.
+    return lax.scan(step, par0, (su, sv), unroll=8)
+
+
+@partial(jax.jit, static_argnames=("n", "presorted"))
+def forest_events_device(u, v, w, n: int, presorted: bool = False):
+    """Edge pool -> union event stream, on device.
+
+    ``u``/``v``: (m,) endpoints (self-loops and duplicate/cycle edges are
+    skipped, matching the host Kruskal; +inf-weight padding rows sort last
+    and must be self-loops). Returns the device pytree
+    ``assemble_merge_forest`` consumes after ONE fetch. ``presorted``
+    callers (host edge pools) skip the device lexsort.
+    """
+    if presorted:
+        su, sv, sw = u.astype(jnp.int32), v.astype(jnp.int32), w
+    else:
+        # Canonical (w, u, v) order — np.lexsort's key, as three stable
+        # passes from the least-significant key up (int32 keys only: the
+        # production default runs without jax_enable_x64).
+        o = jnp.argsort(v.astype(jnp.int32), stable=True)
+        o = o[jnp.argsort(u[o].astype(jnp.int32), stable=True)]
+        order = o[jnp.argsort(w[o], stable=True)]
+        su = u[order].astype(jnp.int32)
+        sv = v[order].astype(jnp.int32)
+        sw = w[order]
+
+    _, (ra, rb) = _uf_scan(su, sv, n)
+    return {"sw": sw, "ra": ra, "rb": rb}  # merge steps: ra != rb
+
+
+# ---------------------------------------------------------------------------
+# Host stage: vectorized reconstruction from the fetched event records
+# ---------------------------------------------------------------------------
+
+
+def _doubling_rounds(size: int) -> int:
+    return max(1, int(math.ceil(math.log2(max(size, 2)))) + 1)
+
+
+def _merge_tops(n: int, t: int, ra_m, rb_m):
+    """Per-merge child tops (ta, tb) from the union event stream.
+
+    2t-row (value, time) sweep: merge k contributes one fused row at value
+    ``ra`` (query the component's current top, then publish node k as its
+    new top) and one query row at value ``rb``. One argsort groups rows by
+    root value in time order; a running max over event payloads (later
+    events have larger node ids, and the value dominates the packed key so
+    segments can't bleed) answers every query with the latest preceding
+    event — exclusive of the fused row's own event (``prevmax``) — or the
+    leaf itself when none. The fused row can't leak into the same step's
+    ``rb`` query because a merge has ``ra != rb``.
+    """
+    if t == 0:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    rows = 2 * t
+    vals = np.empty(rows, np.int64)
+    vals[0::2] = ra_m
+    vals[1::2] = rb_m
+    payload = np.full(rows, -1, np.int64)
+    payload[0::2] = np.arange(t)
+    ordk = np.argsort(vals * rows + np.arange(rows), kind="stable")
+    base = vals[ordk] * np.int64(t + 2)
+    runmax = np.maximum.accumulate(base + payload[ordk] + 1)
+    prevmax = np.empty_like(runmax)
+    prevmax[0] = -1
+    prevmax[1:] = np.where(base[1:] == base[:-1], runmax[:-1], -1)
+    fused = (np.arange(rows) % 2 == 0)[ordk]
+    last = np.empty(rows, np.int64)
+    last[ordk] = np.where(fused, prevmax, runmax) - base - 1
+    ta = np.where(last[0::2] >= 0, n + last[0::2], ra_m)
+    tb = np.where(last[1::2] >= 0, n + last[1::2], rb_m)
+    return ta, tb
+
+
+def assemble_merge_forest(
+    n: int, out: dict, point_weights=None, build_children: bool = True
+) -> MergeForest | None:
+    """Fetched ``forest_events_device`` pytree -> host ``MergeForest``.
+
+    Vectorized numpy only (pointer-doubling loops run log2 rounds of
+    whole-array gathers; nothing is per-edge Python). Returns ``None``
+    when the sorted weights fail the exact-tie gate — the caller falls
+    back to the host builder. ``build_children=False`` skips the Python
+    ``children`` list cut — ``core/tree_vec.py`` consumes ``kids_csr``
+    directly, so the default device fit never pays it; the reference
+    engine (``tree_backend=reference``) needs the lists.
+    """
+    sw = np.asarray(out["sw"], np.float64)
+    if not _ties_exact(sw):
+        return None
+    ra_all = np.asarray(out["ra"], np.int64)
+    rb_all = np.asarray(out["rb"], np.int64)
+    mi = np.nonzero(ra_all != rb_all)[0]  # merge steps joined two roots
+    t = len(mi)
+    dist = sw[mi]  # node k's weight: merges are numbered in step order
+    ra_m = ra_all[mi]
+    rb_m = rb_all[mi]
+    nid = n + np.arange(t, dtype=np.int64)
+    el_n = n + t
+    el = np.arange(el_n, dtype=np.int64)
+    ta, tb = _merge_tops(n, t, ra_m, rb_m)
+
+    # Absorption by exact equality (see module docstring): child node's
+    # weight equals the merge weight.
+    safe_ta = np.clip(ta - n, 0, max(t - 1, 0))
+    safe_tb = np.clip(tb - n, 0, max(t - 1, 0))
+    absorb_a = (ta >= n) & (dist[safe_ta] == dist) if t else np.zeros(0, bool)
+    absorb_b = (tb >= n) & (dist[safe_tb] == dist) if t else np.zeros(0, bool)
+
+    par_el = np.full(el_n, -1, np.int64)
+    par_el[ta] = nid
+    par_el[tb] = nid
+    absorbed = np.zeros(el_n, bool)
+    absorbed[ta[absorb_a]] = True
+    absorbed[tb[absorb_b]] = True
+
+    # One global Euler tour: en(x) = 2x, ex(x) = 2x + 1; a node's entry
+    # leads to its a-child (the host's splice order), roots chain in
+    # ascending order so a single distance-to-terminal list ranking orders
+    # every slot of every tree.
+    slots = 2 * el_n
+    s = np.empty(slots, np.int32)
+    s[0::2] = np.arange(1, slots, 2, dtype=np.int32)  # childless: en -> ex
+    s[2 * nid] = 2 * ta
+    s[2 * ta + 1] = 2 * tb
+    s[2 * tb + 1] = 2 * nid + 1
+    roots_el = np.nonzero(par_el < 0)[0]
+    s[2 * roots_el[:-1] + 1] = 2 * roots_el[1:]
+    term = 2 * roots_el[-1] + 1
+    s[term] = term
+    nxt = s
+    dd = (nxt != np.arange(slots, dtype=np.int32)).astype(np.int32)
+    for _ in range(_doubling_rounds(slots)):
+        dd = dd + dd[nxt]  # terminal keeps dd 0, so no mask needed
+        nxt = nxt[nxt]
+    rk = slots - dd  # int32: ascending along the tour, unique
+
+    # Owner of a kid = nearest non-absorbed ancestor: pointer-double the
+    # "absorbed forwards to its parent" map (parents always outrank kids;
+    # absorption chains are usually shallow, so stop once settled).
+    g = np.where(absorbed, par_el, el).astype(np.int32)
+    for _ in range(_doubling_rounds(el_n)):
+        g2 = g[g]
+        if np.array_equal(g2, g):
+            break
+        g = g2
+    is_kid = (par_el >= 0) & ~absorbed
+    owner = np.where(par_el >= 0, g[np.clip(par_el, 0, None)].astype(np.int64), -1)
+
+    # Kid lists: within one owner, DFS preorder = ascending entry rank.
+    big = np.int64(slots + 1)
+    ckey = np.where(
+        is_kid, owner * big + rk[2 * el].astype(np.int64), np.iinfo(np.int64).max
+    )
+    kid_flat = np.argsort(ckey, kind="stable")[: int(is_kid.sum())]
+    kid_count = np.zeros(max(t, 1), np.int64)
+    np.add.at(kid_count, owner[is_kid] - n, 1)
+    kid_count = kid_count[:t]
+
+    # Sizes: a node's subtree leaves occupy the open rank interval
+    # (rk[en], rk[ex]); prefix sums over the tour-ordered leaf weights.
+    pw = (
+        np.ones(n, np.float64)
+        if point_weights is None
+        else np.asarray(point_weights, np.float64)
+    )
+    lr = rk[0: 2 * n: 2]
+    lord = np.argsort(lr, kind="stable")
+    cum = np.zeros(n + 1, np.float64)
+    np.cumsum(pw[lord], out=cum[1:])
+    lr_sorted = lr[lord]
+    node_sizes = (
+        cum[np.searchsorted(lr_sorted, rk[2 * nid + 1])]
+        - cum[np.searchsorted(lr_sorted, rk[2 * nid])]
+    )
+    sizes = np.concatenate([pw, node_sizes])
+
+    children = None
+    absorbed_nodes = absorbed[n:]
+    if build_children:
+        flat_list = kid_flat.tolist()
+        offs = np.zeros(t + 1, np.int64)
+        np.cumsum(kid_count, out=offs[1:])
+        children = [
+            flat_list[offs[k]: offs[k + 1]] if not absorbed_nodes[k] else None
+            for k in range(t)
+        ]
+
+    # Roots: exactly the parentless elements (every final component's top
+    # has no parent; isolated points are their own top), ascending — the
+    # host's np.unique-over-tops order.
+    roots = [int(r) for r in roots_el]
+
+    return MergeForest(
+        n_points=n,
+        children=children,
+        dist=dist,
+        roots=roots,
+        sizes=sizes,
+        kids_csr=(kid_flat, kid_count),
+    )
+
+
+def build_merge_forest_device(
+    n: int,
+    u,
+    v,
+    w,
+    point_weights=None,
+    trace=None,
+    build_children: bool = True,
+) -> MergeForest | None:
+    """Device twin of ``core/tree.py::build_merge_forest`` (one host sync).
+
+    Accepts host or device-resident edge arrays. Returns ``None`` when the
+    pool fails the runtime eligibility gate (near-tied unequal weights) —
+    the caller falls back to the host builder; a ``None`` here costs the
+    device attempt but never a wrong tree. Emits ``tree_build_device`` and
+    exactly one ``host_sync`` event.
+    """
+    m = int(np.shape(u)[0])
+    if m == 0 or n == 0:
+        return None  # trivial pools: the host builder is already O(1)
+    if point_weights is not None and not supports_inputs([], point_weights):
+        return None  # non-integral weights: interval sums would diverge
+    t0 = time.monotonic()
+    # Host pools pre-sort here (np.lexsort beats the device sort on CPU
+    # and the scan needs the canonical order either way); device-resident
+    # pools go through the in-program lexsort instead.
+    if not isinstance(u, jax.Array):
+        u = np.asarray(u)
+        v = np.asarray(v)
+        w = np.asarray(w)
+        # Without jax_enable_x64 (the production default) a float64 host
+        # pool would silently downcast to float32 on device and the forest
+        # dists would no longer be bitwise-equal to the host builder's.
+        # Decline unless the weights are exactly float32-representable
+        # (device-native f32 pools and lattice weights always are).
+        if w.dtype == np.float64 and not jax.config.jax_enable_x64:
+            if not np.array_equal(w, w.astype(np.float32).astype(np.float64)):
+                return None
+        order = np.lexsort((v, u, w))
+        out = forest_events_device(
+            jnp.asarray(u[order]),
+            jnp.asarray(v[order]),
+            jnp.asarray(w[order]),
+            n,
+            presorted=True,
+        )
+    else:
+        out = forest_events_device(u, v, w, n)
+    build_wall = time.monotonic() - t0
+    t0 = time.monotonic()
+    fetched = jax.device_get(out)
+    sync_wall = time.monotonic() - t0
+    if trace is not None:
+        trace(
+            "host_sync",
+            arrays=len(fetched),
+            bytes=int(sum(a.nbytes for a in fetched.values())),
+            wall_s=round(sync_wall, 6),
+        )
+    t0 = time.monotonic()
+    forest = assemble_merge_forest(
+        n, fetched, point_weights=point_weights, build_children=build_children
+    )
+    if trace is not None:
+        trace(
+            "tree_build_device",
+            n=n,
+            edges=m,
+            nodes=-1 if forest is None else len(forest.dist),
+            backend="device",
+            fallback=forest is None,
+            wall_s=round(build_wall + (time.monotonic() - t0), 6),
+        )
+    return forest
+
+
+# ---------------------------------------------------------------------------
+# Device Borůvka rounds (contraction stays on device)
+# ---------------------------------------------------------------------------
+
+
+def _contract_round(comp, bw, bj, valid, n: int):
+    """One Borůvka contraction in label space — the in-jit twin of
+    ``utils/unionfind.contract_min_edges``.
+
+    ``comp``: (n_pad,) labels; values are representative VERTEX ids in
+    [0, n), so segment reductions run over fixed-size (n,) label arrays and
+    no ``np.unique`` compaction is needed. Winner per component: minimum by
+    the shared (w, lo, hi) key then lowest row id — the host's stable
+    lexsort tie-break — found with a weight scatter-min followed by a
+    cascade of int32 scatter-mins (lo, then hi, then row) over the rows
+    still tied at each stage (int32 throughout: the production default
+    runs without jax_enable_x64).
+
+    Returns (emit_mask(n,), win_row(n,), rep(n,), n_comp, edges_added) with
+    ``emit_mask`` in ascending-label order (the host's emission order).
+    """
+    n_pad = comp.shape[0]
+    rows = jnp.arange(n_pad, dtype=jnp.int32)
+    bj_c = jnp.clip(bj, 0, n_pad - 1)
+    cross = valid & (bj >= 0) & (comp != comp[bj_c])
+    lab = jnp.where(cross, comp, n)
+
+    wmin = (
+        jnp.full((n,), jnp.inf, bw.dtype)
+        .at[lab]
+        .min(bw, mode="drop")
+    )
+    tied = cross & (bw == wmin[jnp.clip(comp, 0, n - 1)])
+    comp_c = jnp.clip(comp, 0, n - 1)
+    sentinel = jnp.iinfo(jnp.int32).max
+
+    def _seg_min(mask, val):
+        return (
+            jnp.full((n,), sentinel, jnp.int32)
+            .at[jnp.where(mask, lab, n)]
+            .min(val, mode="drop")
+        )
+
+    lo = jnp.minimum(rows, bj_c)
+    hi = jnp.maximum(rows, bj_c)
+    lo_min = _seg_min(tied, lo)
+    tied = tied & (lo == lo_min[comp_c])
+    hi_min = _seg_min(tied, hi)
+    tied = tied & (hi == hi_min[comp_c])
+    row_min = _seg_min(tied, rows)
+    has_edge = row_min < sentinel
+    win_row = jnp.where(has_edge, row_min, 0)
+
+    labels = jnp.arange(n, dtype=jnp.int32)
+    t = jnp.where(has_edge, comp[jnp.clip(bj[win_row], 0, n_pad - 1)], labels)
+
+    # Pointer doubling with orbit-min accumulation: every label lands on
+    # its group's cycle and the cycle minimum becomes the group root.
+    mn = labels
+
+    def dbl(_, c):
+        mn, s = c
+        return jnp.minimum(mn, mn[s]), s[s]
+
+    mn, s = lax.fori_loop(0, _doubling_rounds(n), dbl, (mn, t))
+    rep = mn[s]
+    is_root = rep == labels
+    active = (
+        jnp.zeros((n,), bool)
+        .at[jnp.where(valid, comp, n)]
+        .set(True, mode="drop")
+    )
+    emit_mask = active & ~is_root & has_edge
+    n_comp = jnp.sum(active & is_root)
+    return emit_mask, win_row, rep, n_comp, jnp.sum(emit_mask)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n", "metric", "row_tile", "col_tile", "max_rounds"),
+)
+def _boruvka_rounds_device(
+    data_p, core_p, valid, n: int, metric: str, row_tile: int, col_tile: int,
+    max_rounds: int,
+):
+    """All Borůvka rounds in ONE device program (no per-round host glue).
+
+    Emits into fixed (n-1,) edge buffers (weights init +inf, endpoints 0,
+    so unused tail rows pass straight through ``forest_events_device`` as
+    inert self-loop padding) and records per-round (components,
+    edges_added) for the retrospective ``mst_round`` trace events.
+    """
+    from hdbscan_tpu.ops.pallas_segmin import min_outgoing_all_rows
+
+    n_pad = data_p.shape[0]
+    buf = max(n - 1, 1)
+    state = dict(
+        comp=jnp.arange(n_pad, dtype=jnp.int32),
+        eu=jnp.zeros((buf,), jnp.int32),
+        ev=jnp.zeros((buf,), jnp.int32),
+        ew=jnp.full((buf,), jnp.inf, data_p.dtype),
+        count=jnp.int32(0),
+        rnd=jnp.int32(0),
+        n_comp=jnp.int32(n),
+        progress=jnp.asarray(True),
+        stat_comp=jnp.zeros((max_rounds,), jnp.int32),
+        stat_edges=jnp.zeros((max_rounds,), jnp.int32),
+    )
+
+    def cond(st):
+        return (st["rnd"] < max_rounds) & (st["n_comp"] > 1) & st["progress"]
+
+    def body(st):
+        bw, bj = min_outgoing_all_rows(
+            data_p, core_p, st["comp"], valid, metric, row_tile, col_tile
+        )
+        emit_mask, win_row, rep, n_comp, added = _contract_round(
+            st["comp"], bw, bj, valid, n
+        )
+        pos = st["count"] + jnp.cumsum(emit_mask.astype(jnp.int32)) - 1
+        slot = jnp.where(emit_mask, pos, buf)
+        wr = jnp.clip(win_row, 0, n_pad - 1)
+        eu = st["eu"].at[slot].set(wr, mode="drop")
+        ev = st["ev"].at[slot].set(
+            jnp.clip(bj[wr], 0, n_pad - 1).astype(jnp.int32), mode="drop"
+        )
+        ew = st["ew"].at[slot].set(bw[wr], mode="drop")
+        comp = rep[st["comp"]]
+        rnd = st["rnd"]
+        return dict(
+            comp=comp,
+            eu=eu,
+            ev=ev,
+            ew=ew,
+            count=st["count"] + added.astype(jnp.int32),
+            rnd=rnd + 1,
+            n_comp=n_comp.astype(jnp.int32),
+            progress=added > 0,
+            stat_comp=st["stat_comp"].at[rnd].set(n_comp.astype(jnp.int32)),
+            stat_edges=st["stat_edges"].at[rnd].set(added.astype(jnp.int32)),
+        )
+
+    st = lax.while_loop(cond, body, state)
+    return {
+        "u": st["eu"],
+        "v": st["ev"],
+        "w": st["ew"],
+        "count": st["count"],
+        "rounds": st["rnd"],
+        "stat_comp": st["stat_comp"],
+        "stat_edges": st["stat_edges"],
+    }
+
+
+def boruvka_mst_device(
+    data: np.ndarray,
+    core: np.ndarray,
+    metric: str = "euclidean",
+    row_tile: int = 1024,
+    col_tile: int = 8192,
+    dtype=np.float32,
+    max_rounds: int = 64,
+):
+    """Device-resident Borůvka MST: pad once, run every round in one jit.
+
+    Same tiling/padding as ``ops.tiled.BoruvkaScanner`` so per-round
+    candidates are bitwise-identical to the host loop's; the contraction
+    replays ``contract_min_edges`` exactly (see ``_contract_round``).
+    Returns DEVICE arrays — callers feed them straight into
+    ``forest_events_device`` and fetch once.
+    """
+    from hdbscan_tpu.ops.tiled import _pad_rows, _tile_sizes
+
+    n = len(data)
+    row_tile, col_tile, n_pad = _tile_sizes(n, row_tile, col_tile)
+    data_p = jnp.asarray(_pad_rows(np.asarray(data, dtype), n_pad))
+    core_p = jnp.asarray(_pad_rows(np.asarray(core, dtype), n_pad))
+    valid = jnp.asarray(np.arange(n_pad) < n)
+    return _boruvka_rounds_device(
+        data_p, core_p, valid, n, metric, row_tile, col_tile, max_rounds
+    )

@@ -14,7 +14,15 @@ the array-level engine in ``core/tree_vec.py`` (bitwise-identical outputs),
 and ``auto`` (default) picks vectorized whenever
 ``tree_vec.supports_inputs`` accepts the inputs, falling back to reference
 otherwise (non-integral point weights). Every ``tree_*`` trace event carries
-the backend that actually ran (``native``/``python`` for the merge forest).
+the backend that actually ran (``native``/``python``/``device`` for the
+merge forest).
+
+``params.mst_backend`` selects the merge-forest builder upstream of that:
+``device`` (or ``auto`` on big eligible pools) builds the forest from one
+device union-find scan (``core/mst_device.py`` — trace events ``host_sync``
+and ``tree_build_device``), falling back to the host builder when the pool
+fails the runtime eligibility gate. Callers that already hold a forest
+(the device-resident exact fit) pass it in and skip the rebuild.
 """
 
 from __future__ import annotations
@@ -76,6 +84,7 @@ def finalize_clustering(
     point_weights: np.ndarray | None = None,
     constraint_index_map: np.ndarray | None = None,
     trace=None,
+    forest: tree_mod.MergeForest | None = None,
 ) -> tuple[tree_mod.CondensedTree, np.ndarray, np.ndarray, bool]:
     """Edge pool + core distances -> (tree, labels, outlier_scores, infinite).
 
@@ -87,6 +96,9 @@ def finalize_clustering(
     ``trace``: optional per-stage event callable — isolates the host tree
     layers (merge forest / condense / propagate / labels / GLOSH) so the
     multi-M-row runs can tell scan wall from tree wall.
+    ``forest``: pre-built merge forest (the device-resident exact fit builds
+    it before its single host sync); when omitted, ``params.mst_backend``
+    picks the builder here.
     """
     import time as _time
 
@@ -95,16 +107,36 @@ def finalize_clustering(
     backend = resolve_tree_backend(params, point_weights)
     eng = tree_vec if backend == "vectorized" else tree_mod
 
-    t0 = _time.monotonic()
-    forest = tree_mod.build_merge_forest(n, u, v, w, point_weights=point_weights)
-    if trace is not None:
-        trace(
-            "tree_merge_forest",
-            n=n,
-            edges=len(u),
-            backend="native" if merge_forest_lib() is not None else "python",
-            wall_s=round(_time.monotonic() - t0, 6),
+    if forest is None:
+        from hdbscan_tpu.core import mst_device
+
+        if mst_device.resolve_mst_backend(
+            params, n
+        ) == "device" and mst_device.supports_inputs(w, point_weights):
+            # Reference condense walks Python children lists; the vectorized
+            # engine consumes kids_csr directly, so skip the list cut there.
+            forest = mst_device.build_merge_forest_device(
+                n,
+                u,
+                v,
+                w,
+                point_weights=point_weights,
+                trace=trace,
+                build_children=(backend == "reference"),
+            )
+    if forest is None:
+        t0 = _time.monotonic()
+        forest = tree_mod.build_merge_forest(
+            n, u, v, w, point_weights=point_weights
         )
+        if trace is not None:
+            trace(
+                "tree_merge_forest",
+                n=n,
+                edges=len(u),
+                backend="native" if merge_forest_lib() is not None else "python",
+                wall_s=round(_time.monotonic() - t0, 6),
+            )
     t0 = _time.monotonic()
     tree = eng.condense_forest(
         forest,

@@ -376,6 +376,30 @@ def fit(
             num_constraints_satisfied=num_constraints_satisfied,
             trace=trace,
         )
+    from hdbscan_tpu.core.mst_device import resolve_mst_backend
+    from hdbscan_tpu.parallel.ring import resolve_scan_backend
+
+    # Device-resident MST -> forest pipeline (``core/mst_device.py``): every
+    # Borůvka round and the union-find forest scan run in-jit, ONE host sync
+    # downstream of the core-distance scan. The ring scanner shards its own
+    # per-round host reduction, so the single-program device path only runs
+    # when the scan backend is the replicated one.
+    if (
+        resolve_mst_backend(params, n) == "device"
+        and resolve_scan_backend(getattr(params, "scan_backend", "auto"), mesh)
+        != "ring"
+    ):
+        result = _fit_device(
+            data,
+            params,
+            row_tile=row_tile,
+            col_tile=col_tile,
+            dtype=dtype,
+            num_constraints_satisfied=num_constraints_satisfied,
+            trace=trace,
+        )
+        if result is not None:
+            return result
     from hdbscan_tpu.core.knn import resolve_index_for
 
     index, index_opts = resolve_index_for(params, n)
@@ -396,6 +420,151 @@ def fit(
 
     tree, labels, scores, infinite = finalize_clustering(
         n, u, v, w, core, params, num_constraints_satisfied, trace=trace
+    )
+    return HDBSCANResult(
+        labels=labels,
+        tree=tree,
+        core_distances=core,
+        mst=(u, v, w),
+        outlier_scores=scores,
+        infinite_stability=infinite,
+    )
+
+
+def _fit_device(
+    data: np.ndarray,
+    params: HDBSCANParams,
+    *,
+    row_tile: int,
+    col_tile: int,
+    dtype,
+    num_constraints_satisfied,
+    trace,
+) -> HDBSCANResult | None:
+    """The ``mst_backend=device`` exact fit: ONE host sync past the cores.
+
+    Core distances keep their pipelined chunk drain (bounded per-dispatch
+    runtime — see ``ops/tiled.knn_core_distances``); everything downstream —
+    every Borůvka contraction round, the edge lexsort, and the union-find
+    forest scan — runs device-resident, and the fit performs exactly one
+    ``jax.device_get`` (the trace-counted ``host_sync`` event) to land the
+    union event stream, the MST edges, and the per-round stats together.
+    The merge forest then reconstructs with vectorized host numpy
+    (``mst_device.assemble_merge_forest``) and feeds the shared finalize
+    tail unchanged.
+
+    A pool that fails the post-fetch tie-eligibility gate falls back only
+    for the forest build (the fetched MST edges are reused; no second
+    device pass).
+    """
+    import time
+
+    import jax
+
+    from hdbscan_tpu.core.knn import resolve_index_for
+    from hdbscan_tpu.core.mst_device import (
+        assemble_merge_forest,
+        boruvka_mst_device,
+        forest_events_device,
+    )
+    from hdbscan_tpu.models._finalize import (
+        finalize_clustering,
+        resolve_tree_backend,
+    )
+    from hdbscan_tpu.utils.flops import counter as _flops
+    from hdbscan_tpu.utils.flops import phase_stats
+
+    n = len(data)
+    index, index_opts = resolve_index_for(params, n)
+    t0 = time.monotonic()
+    fsnap = _flops.snapshot()
+    core, _ = knn_core_distances(
+        data, params.min_points, params.dist_function, row_tile=row_tile,
+        col_tile=col_tile, dtype=dtype, fetch_knn=False,
+        backend=params.knn_backend, index=index, index_opts=index_opts,
+        trace=trace,
+    )
+    if trace is not None:
+        wall = time.monotonic() - t0
+        trace(
+            "core_distances", n=n, wall_s=round(wall, 6), **phase_stats(fsnap, wall)
+        )
+
+    t0 = time.monotonic()
+    res = boruvka_mst_device(
+        data, core, params.dist_function, row_tile=row_tile,
+        col_tile=col_tile, dtype=dtype,
+    )
+    # Padded (+inf, self-loop) tail rows pass straight through the forest
+    # scan as non-merges, so the event program consumes the fixed buffers
+    # without a host-side slice in between.
+    events = forest_events_device(res["u"], res["v"], res["w"], n)
+    t1 = time.monotonic()
+    fetched = jax.device_get(
+        {
+            "sw": events["sw"],
+            "ra": events["ra"],
+            "rb": events["rb"],
+            "u": res["u"],
+            "v": res["v"],
+            "w": res["w"],
+            "count": res["count"],
+            "rounds": res["rounds"],
+            "stat_comp": res["stat_comp"],
+            "stat_edges": res["stat_edges"],
+        }
+    )
+    sync_wall = time.monotonic() - t1
+    rounds = int(fetched["rounds"])
+    count = int(fetched["count"])
+    if trace is not None:
+        # Dispatch is async: the sync wall carries the device compute, the
+        # retrospective round events replay the per-round stats it landed.
+        for r in range(rounds):
+            trace(
+                "mst_round",
+                round=r,
+                components=int(fetched["stat_comp"][r]),
+                edges_added=int(fetched["stat_edges"][r]),
+            )
+        trace(
+            "host_sync",
+            arrays=len(fetched),
+            bytes=int(sum(np.asarray(a).nbytes for a in fetched.values())),
+            wall_s=round(sync_wall, 6),
+        )
+        trace(
+            "boruvka_mst",
+            rounds=rounds,
+            edges=count,
+            wall_s=round(time.monotonic() - t0, 6),
+        )
+    u = np.asarray(fetched["u"][:count], np.int64)
+    v = np.asarray(fetched["v"][:count], np.int64)
+    w = np.asarray(fetched["w"][:count], np.float64)
+
+    t1 = time.monotonic()
+    tree_backend = resolve_tree_backend(params, None)
+    forest = assemble_merge_forest(
+        n,
+        {"sw": fetched["sw"], "ra": fetched["ra"], "rb": fetched["rb"]},
+        build_children=(tree_backend == "reference"),
+    )
+    if trace is not None:
+        trace(
+            "tree_build_device",
+            n=n,
+            edges=count,
+            nodes=-1 if forest is None else len(forest.dist),
+            backend="device",
+            fallback=forest is None,
+            wall_s=round(time.monotonic() - t1, 6),
+        )
+    # forest=None (near-tied unequal weights): finalize re-gates on the
+    # fetched w and lands on the host builder — no second device pass.
+    tree, labels, scores, infinite = finalize_clustering(
+        n, u, v, w, core, params, num_constraints_satisfied, trace=trace,
+        forest=forest,
     )
     return HDBSCANResult(
         labels=labels,

@@ -1162,7 +1162,11 @@ def _fit_rows(
             )
 
     # Semi-supervised selection (constraints= flag) applies to the GLOBAL
-    # condensed tree, exactly as in the single-block path.
+    # condensed tree, exactly as in the single-block path. The pooled-edge
+    # merge forest inherits ``params.mst_backend`` here: big eligible pools
+    # build on device (one union-find scan + one host sync per rebuild,
+    # ``core/mst_device.py``) — this covers the refine/refine_flat rebuild
+    # loop below too, where the forest build repeats every iteration.
     from hdbscan_tpu.models._finalize import finalize_clustering
 
     def build_tree(u_, v_, w_):

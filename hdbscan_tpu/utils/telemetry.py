@@ -179,6 +179,7 @@ def run_manifest(params=None, argv=None, extra: dict | None = None) -> dict:
             "knn_backend": getattr(params, "knn_backend", None),
             "scan_backend": getattr(params, "scan_backend", None),
             "tree_backend": getattr(params, "tree_backend", None),
+            "mst_backend": getattr(params, "mst_backend", None),
         },
         "topology": device_topology(),
         "env": env_overrides(),
@@ -298,6 +299,9 @@ def build_report(
     knn_index = knn_index_section(tracer)
     if knn_index is not None:
         report["knn_index"] = knn_index
+    mst_device = mst_device_section(tracer)
+    if mst_device is not None:
+        report["mst_device"] = mst_device
     if memory is not None:
         report["memory"] = json_sanitize(memory)
     if per_host is not None:
@@ -370,6 +374,33 @@ def knn_index_section(tracer: Tracer) -> dict | None:
             sum(int(e.fields.get("improved", 0)) for e in rescan)
         )
     return section
+
+
+def mst_device_section(tracer: Tracer) -> dict | None:
+    """The run report's ``mst_device`` section: the single-sync contract of
+    the device-resident MST -> forest pipeline (``core/mst_device.py``) made
+    auditable. ``host_syncs``/``sync_bytes`` count and size every
+    ``host_sync`` fetch (exactly one per device fit/forest rebuild),
+    ``rounds`` the retrospective Borůvka ``mst_round`` events, and
+    ``fallbacks`` how many ``tree_build_device`` builds hit the runtime
+    eligibility gate and fell back to the host builder. None when the run
+    never entered the device path (the section is omitted, not empty)."""
+    syncs = [e for e in tracer.events if e.name == "host_sync"]
+    rounds = [e for e in tracer.events if e.name == "mst_round"]
+    builds = [e for e in tracer.events if e.name == "tree_build_device"]
+    if not syncs and not rounds and not builds:
+        return None
+    return {
+        "host_syncs": len(syncs),
+        "sync_bytes": int(sum(int(e.fields.get("bytes", 0)) for e in syncs)),
+        "sync_wall_s": round(sum(e.wall_s for e in syncs), 6),
+        "rounds": len(rounds),
+        "forest_builds": len(builds),
+        "fallbacks": int(
+            sum(1 for e in builds if e.fields.get("fallback"))
+        ),
+        "build_wall_s": round(sum(e.wall_s for e in builds), 6),
+    }
 
 
 def predict_latency_section(tracer: Tracer) -> dict | None:

@@ -22,6 +22,14 @@ merge forest, ``vectorized``/``reference`` for the tree stages). Serving
 events (``serve/predict.py``, README "Serving") add three: every
 ``predict_batch`` event must carry a power-of-two ``bucket``, ``rows`` in
 ``[1, bucket]``, and a per-process strictly increasing ``batch_seq``.
+Device MST events (``core/mst_device.py``, README "Device-resident
+finalize") add three schemas: ``mst_round`` must carry an integer
+``round >= 0``, ``components >= 1`` and ``edges_added >= 0``; ``host_sync``
+positive ``arrays`` and non-negative ``bytes``; ``tree_build_device``
+(a finalize stage, so it also carries the ``backend`` tag) a boolean
+``fallback`` and ``nodes == -1`` exactly when it fell back — plus one
+GLOBAL invariant: the single-sync contract, per process exactly ONE
+``host_sync`` per ``tree_build_device`` forest build.
 Approximate-neighbor events (``ops/rpforest.py``, README "Approximate
 neighbors") add three schemas: ``knn_index_build`` must carry positive
 integer ``trees``/``depth``/``leaf_size``/``n`` with ``max_leaf <=
@@ -66,6 +74,12 @@ TREE_STAGES = frozenset(
     }
 )
 
+#: ``tree_``-prefixed stages that are legal but not part of the mandatory
+#: split set: ``tree_build_device`` only appears when the device engine
+#: built the merge forest (core/mst_device.py).
+TREE_STAGES_OPTIONAL = frozenset({"tree_build_device"})
+TREE_STAGES_ALL = TREE_STAGES | TREE_STAGES_OPTIONAL
+
 
 def validate_trace(path: str) -> tuple[list[dict], list[str]]:
     """Parse + validate one JSONL trace file.
@@ -78,6 +92,7 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
     last_seq: dict = {}  # per-process strictly-increasing seq check
     last_dev_seq: dict = {}  # per-(process, device) seq for ring wall events
     last_batch_seq: dict = {}  # per-process batch_seq for predict_batch
+    sync_counts: dict = {}  # per-process [host_syncs, device forest builds]
     with open(path, encoding="utf-8") as f:
         for lineno, line in enumerate(f, 1):
             line = line.strip()
@@ -105,10 +120,10 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
             stage = ev.get("stage")
             if isinstance(stage, str) and stage.startswith("tree_"):
                 # Finalize-stage invariants (models/_finalize.py).
-                if stage not in TREE_STAGES:
+                if stage not in TREE_STAGES_ALL:
                     errors.append(
                         f"{path}:{lineno}: unknown finalize stage {stage!r} "
-                        f"(want one of {sorted(TREE_STAGES)})"
+                        f"(want one of {sorted(TREE_STAGES_ALL)})"
                     )
                 backend = ev.get("backend")
                 if not isinstance(backend, str) or not backend:
@@ -177,6 +192,14 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
             # knn_index_* events each pin their geometry fields.
             if stage in ("knn_index_build", "knn_index_query", "knn_index_rescan"):
                 errors += _check_knn_index(path, lineno, stage, ev)
+            # Device-MST invariants (core/mst_device.py): per-event schemas
+            # here; the one-sync-per-forest-build count check runs after the
+            # file is fully read (see below).
+            if stage in ("mst_round", "host_sync", "tree_build_device"):
+                errors += _check_mst_device(path, lineno, stage, ev)
+                if stage != "mst_round":
+                    counts = sync_counts.setdefault(proc, [0, 0])
+                    counts[0 if stage == "host_sync" else 1] += 1
             # Per-device wall events: each device's timeline must be ordered.
             device = ev.get("device")
             if isinstance(device, int) and isinstance(seq, int):
@@ -188,6 +211,16 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
                         f"increasing (prev {prev})"
                     )
                 last_dev_seq[key] = seq
+    # The single-sync contract: the device MST pipeline fetches ONCE per
+    # forest build, so a process's host_sync count must equal its
+    # tree_build_device count (core/mst_device.py / models/exact._fit_device).
+    for proc, (syncs, builds) in sync_counts.items():
+        if syncs != builds:
+            errors.append(
+                f"{path}: process {proc!r} has {syncs} host_sync event(s) "
+                f"for {builds} tree_build_device build(s) — the device MST "
+                f"pipeline must sync exactly once per forest build"
+            )
     return events, errors
 
 
@@ -232,6 +265,46 @@ def _check_knn_index(path: str, lineno: int, stage: str, ev: dict) -> list[str]:
         improved = ev.get("improved")
         if not isinstance(improved, int) or isinstance(improved, bool) or improved < 0:
             errors.append(f"{where} improved={improved!r} not a non-negative int")
+    return errors
+
+
+def _nonneg_int(val) -> bool:
+    return isinstance(val, int) and not isinstance(val, bool) and val >= 0
+
+
+def _check_mst_device(path: str, lineno: int, stage: str, ev: dict) -> list[str]:
+    """The three device-MST event schemas (core/mst_device.py)."""
+    errors: list[str] = []
+    where = f"{path}:{lineno}: {stage}"
+    if stage == "mst_round":
+        if not _nonneg_int(ev.get("round")):
+            errors.append(f"{where} round={ev.get('round')!r} not a non-negative int")
+        if not _pos_int(ev.get("components")):
+            errors.append(
+                f"{where} components={ev.get('components')!r} not a positive int"
+            )
+        if not _nonneg_int(ev.get("edges_added")):
+            errors.append(
+                f"{where} edges_added={ev.get('edges_added')!r} not a "
+                f"non-negative int"
+            )
+    elif stage == "host_sync":
+        if not _pos_int(ev.get("arrays")):
+            errors.append(f"{where} arrays={ev.get('arrays')!r} not a positive int")
+        if not _nonneg_int(ev.get("bytes")):
+            errors.append(f"{where} bytes={ev.get('bytes')!r} not a non-negative int")
+    else:  # tree_build_device
+        fallback = ev.get("fallback")
+        nodes = ev.get("nodes")
+        if not isinstance(fallback, bool):
+            errors.append(f"{where} fallback={fallback!r} not a bool")
+        elif not isinstance(nodes, int) or isinstance(nodes, bool) or (
+            (nodes == -1) != fallback or nodes < -1
+        ):
+            errors.append(
+                f"{where} nodes={nodes!r} inconsistent with fallback={fallback}"
+                f" (want nodes == -1 exactly on fallback)"
+            )
     return errors
 
 

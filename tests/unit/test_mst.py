@@ -101,3 +101,81 @@ def test_self_edges_append(rng):
     uu, vv, ww, mm = (np.asarray(a) for a in M.mst_edges_with_self_edges(u, v, w, mask, core))
     assert mm.sum() == 9 + 10
     np.testing.assert_allclose(ww[-10:], np.asarray(core))
+
+
+def test_boruvka_all_equal_weights():
+    # every pairwise MRD identical: any spanning tree is minimal, but the
+    # result must still be a deterministic spanning tree of total (n-1)*w
+    n = 12
+    mrd = np.full((n, n), 2.5)
+    np.fill_diagonal(mrd, np.inf)
+    u, v, w, mask, labels = (np.asarray(a) for a in M.boruvka_mst(mrd))
+    assert mask.sum() == n - 1
+    assert len(np.unique(labels)) == 1
+    np.testing.assert_array_equal(w[mask], np.full(n - 1, 2.5))
+    parent = np.arange(n)
+
+    def find(a):
+        while parent[a] != a:
+            parent[a] = parent[parent[a]]
+            a = parent[a]
+        return a
+
+    for a, b in zip(u[mask], v[mask]):
+        ra, rb = find(a), find(b)
+        assert ra != rb
+        parent[ra] = rb
+    # and twice in a row gives the identical edge list
+    r2 = [np.asarray(a) for a in M.boruvka_mst(mrd)]
+    for a, b in zip((u, v, w, mask, labels), r2):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_boruvka_single_point():
+    # n=1 keeps the m=max(n-1,1)=1 edge buffer but emits nothing
+    mrd = np.full((1, 1), np.inf)
+    u, v, w, mask, labels = (np.asarray(a) for a in M.boruvka_mst(mrd))
+    assert u.shape == (1,)
+    assert mask.sum() == 0
+    np.testing.assert_array_equal(labels, [0])
+
+
+def test_boruvka_two_points():
+    mrd = np.array([[np.inf, 3.0], [3.0, np.inf]])
+    u, v, w, mask, labels = (np.asarray(a) for a in M.boruvka_mst(mrd))
+    assert mask.sum() == 1
+    assert {int(u[mask][0]), int(v[mask][0])} == {0, 1}
+    assert w[mask][0] == 3.0
+    assert labels[0] == labels[1]
+
+
+def test_boruvka_vmap_padded_blocks(rng):
+    # padded blocks under vmap with per-block num_valid, including the
+    # degenerate single-valid-point block: padding rows never contribute
+    # edges and each block's tree only spans its valid prefix
+    b, n = 4, 24
+    xs = rng.normal(size=(b, n, 3))
+    nv = np.array([n, 10, 2, 1])
+    mrds = []
+    for i in range(b):
+        k = int(nv[i])
+        valid = np.arange(n) < k
+        mrds.append(np.asarray(
+            K.mutual_reachability_block(xs[i], min(4, max(k - 1, 1)), valid=valid)[0]
+        ))
+    mrds = np.stack(mrds)
+    u, v, w, mask, labels = (
+        np.asarray(a) for a in jax.vmap(M.boruvka_mst)(mrds, nv)
+    )
+    for i in range(b):
+        k = int(nv[i])
+        assert mask[i].sum() == k - 1
+        if k > 1:
+            assert u[i][mask[i]].max() < k
+            assert v[i][mask[i]].max() < k
+            sub = mrds[i][:k, :k]
+            np.testing.assert_allclose(
+                w[i][mask[i]].sum(), mst_total_weight_prim(sub), rtol=1e-9
+            )
+        # valid prefix collapses to one component
+        assert len(np.unique(labels[i][:k])) == 1

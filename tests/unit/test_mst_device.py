@@ -1,0 +1,385 @@
+"""Device-resident MST -> merge-forest engine (``core/mst_device.py``).
+
+The contract under test is BITWISE parity: for every eligible edge pool
+(``supports_inputs``) the device engine's ``MergeForest`` — dist, sizes,
+roots, children (including ``None`` for absorbed nodes), kids CSR — equals
+the host reference's exactly, across heavy exact ties, duplicate groups
+(zero-weight stars), integral point weights, and multi-root (disconnected)
+pools. On top of that: the device Borůvka contraction replays the host
+round loop edge-for-edge, the eligibility gate really declines what it
+cannot reproduce, and the ``mst_backend=device`` exact fit performs exactly
+one trace-counted ``host_sync``.
+"""
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.config import HDBSCANParams
+from hdbscan_tpu.core import mst_device as MD
+from hdbscan_tpu.core import tree as T
+from tests.conftest import make_blobs
+
+
+def assert_forest_bitwise_equal(dev, ref):
+    assert dev is not None, "device engine unexpectedly declined"
+    assert dev.n_points == ref.n_points
+    np.testing.assert_array_equal(np.asarray(dev.dist), np.asarray(ref.dist))
+    assert [int(r) for r in dev.roots] == [int(r) for r in ref.roots]
+    np.testing.assert_array_equal(np.asarray(dev.sizes), np.asarray(ref.sizes))
+    if dev.children is not None and ref.children is not None:
+        assert len(dev.children) == len(ref.children)
+        for a, b in zip(dev.children, ref.children):
+            assert (a is None) == (b is None)
+            if a is not None:
+                assert [int(x) for x in a] == [int(x) for x in b]
+    if dev.kids_csr is not None and ref.kids_csr is not None:
+        np.testing.assert_array_equal(
+            np.asarray(dev.kids_csr[0]), np.asarray(ref.kids_csr[0])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(dev.kids_csr[1]), np.asarray(ref.kids_csr[1])
+        )
+
+
+# Fixed n palette + padded edge counts: the event program compiles per
+# (n, m) shape, so the sweep buckets its shapes (inert +inf self-loop
+# padding rows — exactly what the fixed Borůvka buffers feed the engine
+# in production) and 480 trials share a few dozen compiles.
+_N_PALETTE = (2, 3, 5, 9, 17, 33, 49, 60)
+
+
+def _pad_pool(u, v, w, m_pad):
+    pad = m_pad - len(u)
+    return (
+        np.concatenate([u, np.zeros(pad, np.int64)]),
+        np.concatenate([v, np.zeros(pad, np.int64)]),
+        np.concatenate([w, np.full(pad, np.inf)]),
+    )
+
+
+def test_randomized_sweep_bitwise_parity():
+    """>= 480 randomized trials: ties / duplicates / weighted / multi-root."""
+    rng = np.random.default_rng(7)
+    trials = 480
+    ran = 0
+    for trial in range(trials * 2):
+        if ran >= trials:
+            break
+        n = int(_N_PALETTE[int(rng.integers(0, len(_N_PALETTE)))])
+        m = int(rng.integers(1, 2 * n + 1))
+        u = rng.integers(0, n, size=m)
+        v = rng.integers(0, n, size=m)
+        keep = u != v
+        u, v = u[keep], v[keep]
+        if len(u) == 0:
+            continue
+        mode = trial % 4
+        if mode == 0:
+            w = np.round(rng.random(len(u)), 2)  # heavy exact ties
+        elif mode == 1:
+            w = np.round(rng.random(len(u)), 1)  # heavier ties
+            w[rng.random(len(u)) < 0.4] = 0.0  # duplicate-group zeros
+        elif mode == 2:
+            w = np.full(len(u), 0.5)  # everything tied
+        else:
+            w = rng.integers(0, 4, size=len(u)).astype(np.float64)
+        pw = (
+            rng.integers(1, 5, size=n).astype(np.float64)
+            if trial % 3 == 0
+            else None
+        )
+        assert MD.supports_inputs(w, pw), "sweep generated an ineligible pool"
+        ref = T.build_merge_forest(n, u, v, w, point_weights=pw)
+        m_pad = -(-len(u) // 16) * 16
+        up, vp, wp = _pad_pool(u, v, w, m_pad)
+        dev = MD.build_merge_forest_device(n, up, vp, wp, point_weights=pw)
+        assert_forest_bitwise_equal(dev, ref)
+        ran += 1
+    assert ran >= trials
+
+
+@pytest.mark.parametrize("n,m", [(1, 1), (2, 1), (2, 3)])
+def test_trivial_pools(n, m):
+    rng = np.random.default_rng(n * 10 + m)
+    if n == 1:
+        u, v, w = np.zeros(1, np.int64), np.zeros(1, np.int64), np.full(1, np.inf)
+        # all-padding pool: no merges, every point its own root
+        dev = MD.build_merge_forest_device(n, u, v, w)
+        assert dev is not None
+        assert list(dev.roots) == [0]
+        assert len(dev.dist) == 0
+        return
+    u = rng.integers(0, n, size=m)
+    v = (u + 1 + rng.integers(0, n - 1, size=m)) % n
+    w = np.round(rng.random(m), 1)
+    ref = T.build_merge_forest(n, u, v, w)
+    dev = MD.build_merge_forest_device(n, u, v, w)
+    assert_forest_bitwise_equal(dev, ref)
+
+
+def test_supports_inputs_gate():
+    # exact ties are fine; near-tied-but-unequal is the one poison
+    assert MD.supports_inputs([0.5, 0.5, 1.0])
+    assert MD.supports_inputs([])
+    assert not MD.supports_inputs([1.0, 1.0 * (1.0 + 1e-12), 2.0])
+    # +inf padding rows never disqualify
+    assert MD.supports_inputs([0.5, 0.5, np.inf, np.inf])
+    # point weights must sum exactly in any order: integral, < 2**53
+    assert MD.supports_inputs([1.0, 2.0], point_weights=[1.0, 3.0])
+    assert not MD.supports_inputs([1.0, 2.0], point_weights=[1.5, 3.0])
+    assert not MD.supports_inputs([1.0, 2.0], point_weights=[2.0**53, 1.0])
+
+
+def test_ineligible_pool_falls_back_to_none():
+    n = 4
+    u = np.array([0, 1, 2])
+    v = np.array([1, 2, 3])
+    w = np.array([1.0, 1.0 * (1.0 + 1e-12), 2.0])
+    assert MD.build_merge_forest_device(n, u, v, w) is None
+
+
+def test_resolve_mst_backend():
+    assert MD.resolve_mst_backend(mst_backend="host", n=10**9) == "host"
+    assert MD.resolve_mst_backend(mst_backend="device", n=2) == "device"
+    thr = MD.MST_DEVICE_THRESHOLD
+    assert MD.resolve_mst_backend(mst_backend="auto", n=thr - 1) == "host"
+    assert MD.resolve_mst_backend(mst_backend="auto", n=thr) == "device"
+    params = HDBSCANParams(mst_backend="device")
+    assert MD.resolve_mst_backend(params, n=2) == "device"
+    assert MD.resolve_mst_backend(HDBSCANParams(), n=2) == "host"
+
+
+def test_config_validates_mst_backend():
+    with pytest.raises(ValueError, match="mst_backend"):
+        HDBSCANParams(mst_backend="gpu")
+    assert HDBSCANParams.from_args(["mst_backend=device"]).mst_backend == "device"
+
+
+# ---------------------------------------------------------------------------
+# Device Borůvka contraction parity
+# ---------------------------------------------------------------------------
+
+
+def test_contract_round_replays_host_contraction(rng):
+    """One device contraction round == ``contract_min_edges`` exactly."""
+    import jax.numpy as jnp
+
+    from hdbscan_tpu.ops.tiled import BoruvkaScanner, knn_core_distances
+    from hdbscan_tpu.utils.unionfind import contract_min_edges
+
+    data, _ = make_blobs(rng, n=96, d=3, centers=4)
+    core, _ = knn_core_distances(data, 4, fetch_knn=False, dtype=np.float64)
+    scanner = BoruvkaScanner(data, core, "euclidean", dtype=np.float64)
+    n = len(data)
+    comp = np.arange(n, dtype=np.int64)
+    for _round in range(3):
+        bw, bj = scanner.min_outgoing(comp)
+        emit_h, comp_h, n_comp_h = contract_min_edges(comp, bj, bw)
+        n_pad = len(bw)
+        comp_p = np.zeros(n_pad, np.int32)
+        comp_p[:n] = comp
+        valid_p = np.zeros(n_pad, bool)
+        valid_p[:n] = True
+        emit_mask, win_row, rep, n_comp_d, added_d = (
+            np.asarray(a)
+            for a in MD._contract_round(
+                jnp.asarray(comp_p),
+                jnp.asarray(np.asarray(bw)),
+                jnp.asarray(np.asarray(bj, np.int32)),
+                jnp.asarray(valid_p),
+                n,
+            )
+        )
+        # device emits in ascending-label order, same as the host
+        labels = np.nonzero(emit_mask)[0]
+        emit_dev = win_row[labels]
+        np.testing.assert_array_equal(emit_h, emit_dev)
+        assert int(n_comp_d) == n_comp_h
+        assert int(added_d) == len(emit_h)
+        np.testing.assert_array_equal(comp_h, rep[comp])
+        comp = comp_h
+        if n_comp_h <= 1:
+            break
+
+
+def test_boruvka_device_matches_host_rounds(rng):
+    """Full device Borůvka == the host round loop, edge list bitwise."""
+    import jax
+
+    from hdbscan_tpu.models.exact import mst_edges_from_core
+    from hdbscan_tpu.ops.tiled import knn_core_distances
+
+    data, _ = make_blobs(rng, n=210, d=3, centers=3)
+    core, _ = knn_core_distances(data, 4, fetch_knn=False, dtype=np.float64)
+    u_h, v_h, w_h = mst_edges_from_core(data, core, dtype=np.float64)
+    res = jax.device_get(
+        MD.boruvka_mst_device(data, core, dtype=np.float64)
+    )
+    count = int(res["count"])
+    assert count == len(u_h)
+    np.testing.assert_array_equal(np.asarray(res["u"][:count]), u_h)
+    np.testing.assert_array_equal(np.asarray(res["v"][:count]), v_h)
+    np.testing.assert_array_equal(np.asarray(res["w"][:count]), w_h)
+    # the fixed buffers pad with inert +inf self-loops
+    assert np.all(np.isinf(np.asarray(res["w"][count:])))
+
+
+# ---------------------------------------------------------------------------
+# e2e: the device fit path
+# ---------------------------------------------------------------------------
+
+
+def _fit_both(data, **kw):
+    from hdbscan_tpu.models import exact
+    from hdbscan_tpu.utils.tracing import Tracer
+
+    tracer = Tracer()
+    host = exact.fit(data, HDBSCANParams(mst_backend="host", **kw))
+    dev = exact.fit(
+        data, HDBSCANParams(mst_backend="device", **kw), trace=tracer
+    )
+    return host, dev, tracer
+
+
+def test_exact_fit_device_bitwise_parity_and_single_sync(rng):
+    """labels/outlier_scores parity + exactly ONE host_sync per device fit."""
+    data = np.concatenate(
+        [
+            rng.normal(0, 1, (2200, 3)),
+            rng.normal(6, 1, (1900, 3)),
+            rng.normal((0, 8, 0), 1, (900, 3)),
+        ]
+    )
+    host, dev, tracer = _fit_both(data, min_points=5, min_cluster_size=10)
+    np.testing.assert_array_equal(host.labels, dev.labels)
+    np.testing.assert_array_equal(host.outlier_scores, dev.outlier_scores)
+    np.testing.assert_array_equal(host.mst[2], dev.mst[2])
+    names = [e.name for e in tracer.events]
+    assert names.count("host_sync") == 1
+    builds = [e for e in tracer.events if e.name == "tree_build_device"]
+    assert len(builds) == 1 and builds[0].fields["fallback"] is False
+    assert names.count("mst_round") >= 1
+    rounds = [e.fields for e in tracer.events if e.name == "mst_round"]
+    assert all(r["components"] >= 1 and r["edges_added"] >= 0 for r in rounds)
+
+
+def test_exact_fit_auto_declines_small_inputs(rng):
+    from hdbscan_tpu.models import exact
+    from hdbscan_tpu.utils.tracing import Tracer
+
+    data, _ = make_blobs(rng, n=150, d=3)
+    tracer = Tracer()
+    res = exact.fit(data, HDBSCANParams(min_points=4), trace=tracer)
+    assert res.labels is not None
+    assert all(e.name != "host_sync" for e in tracer.events)
+
+
+def test_finalize_routes_pool_through_device(rng):
+    """``finalize_clustering`` (the mr-hdbscan/dedup pool tail) builds the
+    forest on device when ``mst_backend=device`` and the pool is eligible."""
+    from hdbscan_tpu.models._finalize import finalize_clustering
+    from hdbscan_tpu.utils.tracing import Tracer
+
+    n = 300
+    rng2 = np.random.default_rng(3)
+    v = np.arange(1, n)
+    u = rng2.integers(0, v)
+    w = np.round(rng2.random(n - 1), 2)
+    core = np.zeros(n)
+    for backend in ("host", "device"):
+        tracer = Tracer()
+        params = HDBSCANParams(
+            min_points=1, min_cluster_size=5, mst_backend=backend
+        )
+        out = finalize_clustering(n, u, v, w, core, params, trace=tracer)
+        names = [e.name for e in tracer.events]
+        if backend == "device":
+            assert names.count("host_sync") == 1
+            assert names.count("tree_build_device") == 1
+            dev_out = out
+        else:
+            assert names.count("host_sync") == 0
+            host_out = out
+    np.testing.assert_array_equal(host_out[1], dev_out[1])  # labels
+    np.testing.assert_array_equal(host_out[2], dev_out[2])  # scores
+
+
+def test_trace_roundtrip_validates_and_flags_violations(rng, tmp_path):
+    """JSONL trace from a device fit passes ``scripts/check_trace.py``; a
+    dropped host_sync line violates the single-sync contract check."""
+    import json
+
+    from hdbscan_tpu.models import exact
+    from hdbscan_tpu.utils.tracing import JsonlSink, Tracer
+    from scripts import check_trace
+
+    data, _ = make_blobs(rng, n=220, d=3)
+    trace_path = str(tmp_path / "trace.jsonl")
+    sink = JsonlSink(trace_path)
+    tracer = Tracer(sinks=[sink])
+    exact.fit(
+        data, HDBSCANParams(min_points=4, mst_backend="device"), trace=tracer
+    )
+    tracer.close()
+    events, errors = check_trace.validate_trace(trace_path)
+    assert errors == []
+    assert sum(1 for e in events if e.get("stage") == "host_sync") == 1
+
+    # drop the host_sync line -> the one-sync-per-build invariant trips
+    lines = [
+        line
+        for line in open(trace_path, encoding="utf-8").read().splitlines()
+        if json.loads(line).get("stage") != "host_sync"
+    ]
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("\n".join(lines) + "\n", encoding="utf-8")
+    _, errors = check_trace.validate_trace(str(bad))
+    assert any("host_sync" in e for e in errors)
+
+    # malformed mst_round / tree_build_device events are flagged too
+    rec = {
+        "schema": "hdbscan-tpu-trace/1",
+        "seq": 0,
+        "stage": "mst_round",
+        "wall_s": 0.0,
+        "round": -1,
+        "components": 0,
+        "edges_added": -2,
+    }
+    bad2 = tmp_path / "bad2.jsonl"
+    bad2.write_text(json.dumps(rec) + "\n", encoding="utf-8")
+    _, errors = check_trace.validate_trace(str(bad2))
+    assert len(errors) >= 2
+
+    rec2 = {
+        "schema": "hdbscan-tpu-trace/1",
+        "seq": 0,
+        "stage": "tree_build_device",
+        "backend": "device",
+        "wall_s": 0.0,
+        "fallback": False,
+        "nodes": -1,
+    }
+    bad3 = tmp_path / "bad3.jsonl"
+    bad3.write_text(json.dumps(rec2) + "\n", encoding="utf-8")
+    _, errors = check_trace.validate_trace(str(bad3))
+    assert any("inconsistent" in e for e in errors)
+
+
+def test_report_mst_device_section(rng):
+    from hdbscan_tpu.models import exact
+    from hdbscan_tpu.utils.telemetry import build_report
+    from hdbscan_tpu.utils.tracing import Tracer
+
+    data, _ = make_blobs(rng, n=220, d=3)
+    tracer = Tracer()
+    exact.fit(
+        data, HDBSCANParams(min_points=4, mst_backend="device"), trace=tracer
+    )
+    report = build_report(tracer)
+    section = report["mst_device"]
+    assert section["host_syncs"] == 1
+    assert section["forest_builds"] == 1
+    assert section["fallbacks"] == 0
+    assert section["sync_bytes"] > 0
+    assert section["rounds"] >= 1
