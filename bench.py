@@ -32,7 +32,12 @@ Secondary rows in the same JSON line:
 - the approximate-neighbor tier (``knn_index=rpforest``, README "Approximate
   neighbors") at the literal config: end-to-end wall vs the exact headline
   (``rpforest_e2e_vs_exact``), ARI, and the engine's own traced build wall,
-  post-merge sampled recall and query throughput (``knn_index_*`` events).
+  post-merge sampled recall and query throughput (``knn_index_*`` events),
+- the streaming ingest leg (README "Streaming"): sustained ``/ingest``
+  throughput through the served model (rows/s), the absorb ratio on
+  near-manifold traffic, and the blue/green swap pause p50/p99 over repeated
+  hot swaps. ``--stream-synthetic`` runs ONLY this leg on synthetic blobs
+  (for hosts without the Skin dataset).
 """
 
 from __future__ import annotations
@@ -51,6 +56,109 @@ CAL_MIN_PTS = 8  # calibrated macro-structure setting
 MIN_CL_SIZE = 3000
 
 
+def stream_leg(model, params, query_sampler, tracer, swaps=8, chunks=20,
+               chunk_rows=512):
+    """Streaming-ingest bench through the serving stack (README "Streaming").
+
+    Measures, against a live ``ClusterServer`` in ingest mode (no HTTP on
+    the timed path — the HTTP front adds json encode/decode, not subsystem
+    wall): sustained ``ingest()`` throughput in rows/s (predict + absorb +
+    drift sketch per chunk), the absorb ratio on near-manifold traffic, and
+    the blue/green swap pause (the served-handle pointer assignment, NOT
+    the off-critical-path predictor build/warmup) as p50/p99 over
+    ``swaps`` repeated hot swaps of the same artifact.
+    """
+    from hdbscan_tpu.serve.server import ClusterServer
+    from hdbscan_tpu.utils.telemetry import latency_percentiles
+
+    tracer("bench_leg", leg="stream")
+    # A budget no stream reaches + an unreachable drift threshold: the leg
+    # measures steady-state ingest, not background re-fit wall.
+    leg_params = params.replace(
+        stream_refit_budget=10**9, stream_drift_threshold=1e9
+    )
+    srv = ClusterServer(
+        model, max_batch=chunk_rows, port=0, tracer=tracer,
+        ingest=True, params=leg_params,
+    )
+    try:
+        srv.ingest(query_sampler(chunk_rows))  # warm the ingest path
+        rows = absorbed = 0
+        t0 = time.monotonic()
+        for _ in range(chunks):
+            out = srv.ingest(query_sampler(chunk_rows))
+            rows += out["rows"]
+            absorbed += out["absorbed"]
+        ingest_wall = time.monotonic() - t0
+        pauses = [
+            srv.swap_model(model, reason="bench")["pause_s"]
+            for _ in range(swaps)
+        ]
+    finally:
+        srv.close()
+    pct = latency_percentiles(pauses)
+    fields = {
+        "stream_ingest_rows_per_s": round(rows / max(ingest_wall, 1e-9), 1),
+        "stream_ingest_rows": rows,
+        "stream_absorb_ratio": round(absorbed / max(rows, 1), 4),
+        "stream_swap_pause_p50_us": round(pct["p50_s"] * 1e6, 3),
+        "stream_swap_pause_p99_us": round(pct["p99_s"] * 1e6, 3),
+        "stream_swaps": swaps,
+    }
+    print(
+        f"[bench] stream: rows/s={fields['stream_ingest_rows_per_s']} "
+        f"absorb={fields['stream_absorb_ratio']} "
+        f"swap_pause p50={fields['stream_swap_pause_p50_us']}us "
+        f"p99={fields['stream_swap_pause_p99_us']}us over {swaps} swaps",
+        file=sys.stderr,
+    )
+    return fields
+
+
+def _stream_synthetic() -> None:
+    """The stream leg alone, on synthetic blobs — for containers without
+    the Skin dataset (BENCH_r07 precedent). Prints one JSON line."""
+    from hdbscan_tpu.config import HDBSCANParams
+    from hdbscan_tpu.models import hdbscan
+    from hdbscan_tpu.utils.tracing import Tracer
+
+    import jax
+
+    rng = np.random.default_rng(0)
+    centers = np.asarray([(0.0, 0.0, 0.0), (6.0, 6.0, 6.0), (0.0, 8.0, 0.0)])
+    n = 5000
+    data = centers[np.arange(n) % 3] + rng.normal(0, 0.25, (n, 3))
+    params = HDBSCANParams(min_points=8, min_cluster_size=100)
+    t0 = time.monotonic()
+    model = hdbscan.fit(data, params).to_cluster_model(data, params)
+    fit_wall = time.monotonic() - t0
+
+    def sampler(k):
+        # training rows + jitter: near-manifold traffic that exercises both
+        # the absorb shortcut (exact duplicates) and the attachment climb
+        q = data[rng.integers(0, n, k)]
+        jitter = rng.normal(0, 0.02, (k, 3))
+        jitter[:: 4] = 0.0  # every 4th row is a bitwise training duplicate
+        return q + jitter
+
+    tracer = Tracer()
+    fields = stream_leg(model, params, sampler, tracer)
+    print(
+        json.dumps(
+            {
+                "metric": "stream_ingest_rows_per_s_synthetic_5k",
+                "value": fields["stream_ingest_rows_per_s"],
+                "unit": "rows/s",
+                "n_train": n,
+                "fit_wall_s": round(fit_wall, 3),
+                "platform": jax.devices()[0].platform,
+                "cpu_smoke": jax.devices()[0].platform != "tpu",
+                **fields,
+            }
+        )
+    )
+
+
 def main(argv: list[str] | None = None) -> None:
     import jax
 
@@ -63,6 +171,12 @@ def main(argv: list[str] | None = None) -> None:
 
     argv = list(sys.argv[1:] if argv is None else argv)
     argv_full = list(argv)
+    if "--stream-synthetic" in argv:
+        argv.remove("--stream-synthetic")
+        if argv:
+            raise SystemExit(f"bench.py: unknown arguments {argv!r}")
+        _stream_synthetic()
+        return
     trace_out = _pop_path_flag(argv, "--trace-out")
     report_out = _pop_path_flag(argv, "--report")
     compile_cache = _pop_path_flag(argv, "--compile-cache") or "auto"
@@ -406,6 +520,17 @@ def main(argv: list[str] | None = None) -> None:
         steady_counter() - steady_before
     )
 
+    # --- streaming ingest leg (README "Streaming") -------------------------
+    # Same mr-db model served in ingest mode: sustained ingest rows/s,
+    # absorb ratio on near-manifold traffic, swap pause p50/p99.
+    def skin_sampler(k):
+        q = data[rng_q.integers(0, len(data), k)]
+        jitter = rng_q.normal(0, 0.01, (k, data.shape[1]))
+        jitter[::4] = 0.0  # every 4th row a bitwise training duplicate
+        return q + jitter
+
+    stream_fields = stream_leg(model, mr_params, skin_sampler, tracer)
+
     print(
         json.dumps(
             {
@@ -452,6 +577,7 @@ def main(argv: list[str] | None = None) -> None:
                 **mst_device_fields,
                 **rpf_fields,
                 **predict_fields,
+                **stream_fields,
                 **ring_fields,
             }
         )

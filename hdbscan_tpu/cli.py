@@ -69,7 +69,9 @@ invocation still means ``fit`` (the reference-compatible form above)::
         [--trace-out PATH] [--report PATH]
     python -m hdbscan_tpu serve --model MODEL.npz [--host H] [--port P] \
         [predict_backend=...] [predict_batch=N] [--trace-out PATH] \
-        [--report PATH]
+        [--report PATH] [--ingest] [--model-dir DIR] \
+        [absorb_eps=F] [drift_stat={psi,ks}] [drift_threshold=F] \
+        [refit_budget=N] [stream_reload={auto,manual}]
 
 ``fit --model-out`` persists the fitted clustering as one atomic
 schema-versioned ``.npz`` (``serve/artifact.ClusterModel``); ``predict``
@@ -80,6 +82,16 @@ dispatch. Both serving commands AOT-warm every power-of-two batch bucket so
 steady state recompiles nothing, emit per-batch ``predict_batch`` trace
 events, and report p50/p95/p99 latency in the run report
 (``predict_latency``).
+
+``serve --ingest`` (README "Streaming") additionally opens ``POST /ingest``:
+arriving points route through the predict path, duplicates/near-duplicates
+(within ``absorb_eps`` of their cluster's density level) fold into
+per-cluster bubble summaries, a GLOSH-score drift detector
+(``drift_stat``/``drift_threshold``) watches for distribution shift, and on
+drift or ``refit_budget`` buffered novel rows a background re-fit publishes
+a new artifact under ``--model-dir`` that hot-swaps in atomically
+(``stream_reload=auto``; ``manual`` stages it for ``POST /swap``). SIGTERM
+drains in-flight requests before exiting.
 """
 
 from __future__ import annotations
@@ -114,6 +126,15 @@ def _pop_path_flag(argv: list[str], flag: str) -> str | None:
         else:
             i += 1
     return value
+
+
+def _pop_bool_flag(argv: list[str], flag: str) -> bool:
+    """Extract a bare ``--flag`` switch from argv (in place)."""
+    present = False
+    while flag in argv:
+        argv.remove(flag)
+        present = True
+    return present
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -484,6 +505,8 @@ def _main_serve(argv: list[str], argv_full: list[str]) -> int:
         port = _pop_path_flag(argv, "--port")
         trace_out = _pop_path_flag(argv, "--trace-out")
         report_out = _pop_path_flag(argv, "--report")
+        model_dir = _pop_path_flag(argv, "--model-dir")
+        ingest = _pop_bool_flag(argv, "--ingest")
         params = HDBSCANParams.from_args(argv)
         port = int(port) if port is not None else 8799
     except ValueError as e:
@@ -510,12 +533,22 @@ def _main_serve(argv: list[str], argv_full: list[str]) -> int:
             host=host,
             port=port,
             tracer=tracer,
+            ingest=ingest,
+            params=params,
+            model_dir=model_dir,
         )
+        mode = ""
+        if ingest:
+            mode = (
+                f", ingest on ({params.stream_drift_stat} drift @ "
+                f"{params.stream_drift_threshold}, {params.stream_reload} "
+                f"reload)"
+            )
         print(
             f"hdbscan-tpu serve: http://{server.host}:{server.port} "
             f"(model {model_path}, {model.n_train} train points, "
             f"{server.predictor.backend} backend, buckets "
-            f"{server.predictor.buckets})",
+            f"{server.predictor.buckets}{mode})",
             file=sys.stderr,
         )
         server.serve_forever()

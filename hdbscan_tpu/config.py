@@ -268,6 +268,30 @@ class HDBSCANParams:
     #: bucket is AOT-warmed at server start, so steady-state serving
     #: recompiles nothing.
     predict_max_batch: int = 256
+    #: Streaming ingest (``serve --ingest`` / ``hdbscan_tpu/stream``):
+    #: near-duplicate absorb slack — an arriving point is folded into its
+    #: cluster's bubble summary when its attachment mutual-reachability
+    #: level is within ``(1 + frac)`` of the cluster's ``eps_min`` density
+    #: level (0 absorbs only probability-1.0 rows + exact duplicates).
+    stream_absorb_eps_frac: float = 0.25
+    #: Drift statistic over the streaming GLOSH-score histogram vs the
+    #: fit-time baseline: "psi" (Population Stability Index) or "ks"
+    #: (Kolmogorov-Smirnov distance over the same bins).
+    stream_drift_stat: str = "psi"
+    #: Drift flag level for ``stream_drift_stat`` (and the assignment-rate
+    #: PSI). The baseline histogram is the *training rows'* GLOSH scores,
+    #: and fresh in-distribution draws score systematically higher than the
+    #: rows the model was fit on, so the textbook PSI scale (0.2 =
+    #: significant) does not transfer: in-distribution streams read ~0.3-0.5
+    #: here while genuine shift reads an order of magnitude above (see
+    #: tests/e2e/test_stream_e2e.py). 2.0 separates the two regimes.
+    stream_drift_threshold: float = 2.0
+    #: Novel-row budget: a background re-fit also triggers once this many
+    #: non-absorbed rows are buffered, drift or not.
+    stream_refit_budget: int = 2048
+    #: What happens when a re-fit publishes an artifact: "auto" hot-swaps it
+    #: in (blue/green), "manual" stages it for an operator ``POST /swap``.
+    stream_reload: str = "auto"
     # Output file names derived from the input path (main/Main.java:516-526):
 
     def __post_init__(self):
@@ -342,6 +366,31 @@ class HDBSCANParams:
             raise ValueError("rpf_rescan_rounds must be >= 0")
         if self.predict_max_batch < 1:
             raise ValueError("predict_max_batch must be >= 1")
+        if self.stream_absorb_eps_frac < 0:
+            raise ValueError(
+                "stream_absorb_eps_frac must be >= 0, "
+                f"got {self.stream_absorb_eps_frac!r}"
+            )
+        if self.stream_drift_stat not in ("psi", "ks"):
+            raise ValueError(
+                "stream_drift_stat must be 'psi' or 'ks', "
+                f"got {self.stream_drift_stat!r}"
+            )
+        if not self.stream_drift_threshold > 0:
+            raise ValueError(
+                "stream_drift_threshold must be > 0, "
+                f"got {self.stream_drift_threshold!r}"
+            )
+        if self.stream_refit_budget < 1:
+            raise ValueError(
+                "stream_refit_budget must be >= 1, "
+                f"got {self.stream_refit_budget!r}"
+            )
+        if self.stream_reload not in ("auto", "manual"):
+            raise ValueError(
+                "stream_reload must be 'auto' or 'manual', "
+                f"got {self.stream_reload!r}"
+            )
         if self.boundary_quality > 0 and self.dedup_points:
             raise ValueError(
                 "boundary_quality and dedup_points are mutually exclusive "
@@ -430,6 +479,11 @@ FLAG_FIELDS = {
     "compile_cache": ("compile_cache", str),
     "predict_backend": ("predict_backend", str),
     "predict_batch": ("predict_max_batch", int),
+    "absorb_eps": ("stream_absorb_eps_frac", float),
+    "drift_stat": ("stream_drift_stat", str),
+    "drift_threshold": ("stream_drift_threshold", float),
+    "refit_budget": ("stream_refit_budget", int),
+    "stream_reload": ("stream_reload", str),
     "max_samples": ("max_samples", int),
     "compat_cf": ("compat_cf_int_math", _bool),
 }

@@ -12,8 +12,10 @@ Four layers (README "Serving"):
 - ``serve/batcher.py`` — :class:`MicroBatcher` coalescing concurrent
   requests into padded power-of-two buckets (zero steady-state recompiles
   after AOT warmup);
-- ``serve/server.py`` — stdlib HTTP ``/predict`` + ``/healthz`` with
-  ``predict_batch`` trace events and latency percentiles in the run report.
+- ``serve/server.py`` — stdlib HTTP ``/predict`` + ``/healthz`` (plus
+  ``/ingest`` + ``/swap`` in streaming mode — ``hdbscan_tpu/stream``,
+  README "Streaming") with blue/green model-handle swaps, ``predict_batch``
+  trace events and latency percentiles in the run report.
 """
 
 from hdbscan_tpu.serve.artifact import MODEL_SCHEMA, ClusterModel  # noqa: F401
@@ -24,3 +26,4 @@ from hdbscan_tpu.serve.predict import (  # noqa: F401
     membership_vectors,
     outlier_scores,
 )
+from hdbscan_tpu.serve.server import ClusterServer  # noqa: F401

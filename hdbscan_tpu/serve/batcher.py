@@ -43,6 +43,7 @@ class MicroBatcher:
         self.max_rows = int(max_rows or predictor.max_bucket)
         self._q: queue.Queue = queue.Queue()
         self._closed = False
+        self._close_lock = threading.Lock()  # orders submit() vs close()
         self._batches = 0
         self._rows = 0
         self._worker = threading.Thread(
@@ -56,13 +57,18 @@ class MicroBatcher:
         """Enqueue one request; the Future resolves to this request's
         ``(labels, probabilities, outlier_scores)`` slice of the coalesced
         dispatch."""
-        if self._closed:
-            raise RuntimeError("MicroBatcher is closed")
         X = np.asarray(X, np.float64)
         if X.ndim == 1:
             X = X[None, :]
         fut: Future = Future()
-        self._q.put((X, fut))
+        # The close lock orders this put against close()'s sentinel: every
+        # accepted future lands ahead of the sentinel in the FIFO queue, so
+        # the worker's drain-until-sentinel loop resolves all of them —
+        # close() never abandons an in-flight request.
+        with self._close_lock:
+            if self._closed:
+                raise RuntimeError("MicroBatcher is closed")
+            self._q.put((X, fut))
         return fut
 
     def predict(self, X):
@@ -130,16 +136,54 @@ class MicroBatcher:
             self._dispatch(batch)
             if stop:
                 break
+        self._drain()
+
+    def _drain(self) -> None:
+        """Dispatch everything still queued ahead of the close sentinel (the
+        linger window in :meth:`_collect` can expire with items left), in
+        ``max_rows``-sized batches, so shutdown completes every accepted
+        future instead of abandoning it."""
+        pending = []
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                pending.append(item)
+        while pending:
+            batch, rows = [], 0
+            while pending and rows < self.max_rows:
+                batch.append(pending.pop(0))
+                rows += len(batch[-1][0])
+            self._dispatch(batch)
 
     # -- lifecycle ---------------------------------------------------------
 
     def close(self, timeout: float | None = 5.0) -> None:
-        """Stop accepting requests, flush what's queued, join the worker."""
-        if self._closed:
-            return
-        self._closed = True
-        self._q.put(None)
+        """Stop accepting requests, flush what's queued, join the worker.
+        Every future accepted before close is resolved (graceful drain); if
+        the worker cannot finish within ``timeout`` the leftovers fail with
+        a ``RuntimeError`` rather than hanging their callers forever."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._q.put(None)
         self._worker.join(timeout=timeout)
+        if not self._worker.is_alive():
+            return
+        # Worker wedged (device fault mid-dispatch): fail what's left so no
+        # caller blocks forever on an unresolvable future.
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                item[1].set_exception(
+                    RuntimeError("MicroBatcher closed before dispatch")
+                )
 
     def __enter__(self) -> "MicroBatcher":
         return self

@@ -317,6 +317,10 @@ class Predictor:
             for p in range(_MIN_BUCKET.bit_length() - 1, self.max_bucket.bit_length())
         ]
         self._batch_seq = 0
+        # Distinguishes predictors sharing one trace file (blue/green swaps
+        # build a fresh Predictor per model generation): check_trace
+        # enforces monotonic batch_seq per (process, predictor).
+        self._pred_id = f"{id(self) & 0xFFFFFF:06x}"
 
         c1 = len(model.parent)
         anc = _ancestor_table(model.parent)
@@ -480,6 +484,7 @@ class Predictor:
                     rows=int(b),
                     batch_seq=self._batch_seq,
                     backend=self.backend,
+                    pred=self._pred_id,
                     wall_s=round(wall, 6),
                 )
             self._batch_seq += 1

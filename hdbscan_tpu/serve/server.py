@@ -4,36 +4,80 @@ Endpoints:
 
 - ``POST /predict`` — body ``{"points": [[...], ...]}`` (optionally
   ``"membership": true``); responds ``{"labels", "probabilities",
-  "outlier_scores"}`` (plus ``"membership"`` + ``"selected_ids"`` when
-  requested). Plain predicts route through the
+  "outlier_scores", "generation"}`` (plus ``"membership"`` +
+  ``"selected_ids"`` when requested). Plain predicts route through the
   :class:`~hdbscan_tpu.serve.batcher.MicroBatcher`, so concurrent clients
   coalesce into shared bucket dispatches.
+- ``POST /ingest`` — streaming mode only: predicts the points, absorbs
+  duplicates/near-duplicates into bubble summaries, updates the drift
+  sketches, and (on a drift flag or point budget) kicks off a background
+  re-fit. See ``hdbscan_tpu/stream/``.
+- ``POST /swap`` — apply a staged re-fit artifact (``stream_reload=manual``)
+  or an explicit ``{"path": ...}`` artifact: the blue/green hot swap.
 - ``GET /healthz`` — model summary, backend, warmed buckets, batcher
-  coalescing stats, uptime.
+  coalescing stats, stream/swap state, uptime.
+
+Blue/green serving: every model lives in an immutable ``_ModelHandle``
+(model + warmed predictor + its own MicroBatcher + generation number).
+A request pins the handle it started with — ``self._handle`` is read once
+— and a swap is a single reference assignment under a lock, so in-flight
+requests finish on the model they started on and new requests see the new
+one; nothing is dropped and no request mixes models. The old handle's
+batcher is then drain-closed (every accepted future completes — the
+graceful-shutdown guarantee in batcher.py). Swaps are guarded by the
+artifact digest check (``ClusterModel.load``) plus a fingerprint-field
+match against the served model, and emit ``model_swap`` trace events with
+a per-server monotonic generation (validated by scripts/check_trace.py).
 
 ``http.server.ThreadingHTTPServer`` only — no new dependencies; the device
 is still single-dispatcher because every handler thread funnels into the
-batcher's worker (or the predictor's internal lock for membership calls).
-Latency observability comes from the ``predict_batch`` trace events the
-predictor emits; the CLI ``serve`` command turns those into p50/p95/p99 in
-the run report (``utils/telemetry.predict_latency_section``).
+handle's batcher worker (or the predictor's internal lock for membership
+calls). ``SIGTERM``/``close()`` drains in-flight work before exiting.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import signal
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from hdbscan_tpu.serve.artifact import _FINGERPRINT_FIELDS, ClusterModel
 from hdbscan_tpu.serve.batcher import MicroBatcher
 from hdbscan_tpu.serve.predict import Predictor
 
 #: Refuse request bodies above this size (64 MiB ~ a 1M x 8-dim f64 batch);
 #: a streaming client should chunk instead of shipping one giant body.
 MAX_BODY_BYTES = 64 << 20
+
+#: Bounded retries for the swap race: a request that pinned a handle whose
+#: batcher closed before its submit landed just re-pins the current handle.
+_PIN_RETRIES = 8
+
+
+class _ModelHandle:
+    """One served model generation: artifact + warmed predictor + batcher.
+
+    Immutable once built — a swap builds a fresh handle and replaces the
+    server's reference; it never mutates a live one.
+    """
+
+    __slots__ = ("model", "predictor", "batcher", "generation", "warmup_info")
+
+    def __init__(self, model, predictor, batcher, generation, warmup_info):
+        self.model = model
+        self.predictor = predictor
+        self.batcher = batcher
+        self.generation = generation
+        self.warmup_info = warmup_info
+
+    @property
+    def digest(self) -> str | None:
+        return self.model.fingerprint.get("data")
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -58,25 +102,40 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._json(200, self.server.cluster_server.health())
 
+    def _read_payload(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        if length > MAX_BODY_BYTES:
+            raise ValueError(f"body exceeds {MAX_BODY_BYTES} bytes")
+        return json.loads(self.rfile.read(length).decode()) if length else {}
+
     def do_POST(self):  # noqa: N802 - http.server API
-        if self.path.split("?")[0] != "/predict":
-            self._json(404, {"error": f"unknown path {self.path!r}"})
-            return
+        path = self.path.split("?")[0]
+        srv = self.server.cluster_server
         try:
-            length = int(self.headers.get("Content-Length", 0))
-            if length > MAX_BODY_BYTES:
-                self._json(413, {"error": f"body exceeds {MAX_BODY_BYTES} bytes"})
-                return
-            payload = json.loads(self.rfile.read(length).decode())
-            points = np.asarray(payload["points"], np.float64)
-            membership = bool(payload.get("membership", False))
-        except (KeyError, ValueError, TypeError, json.JSONDecodeError) as e:
+            payload = self._read_payload()
+        except (ValueError, TypeError, json.JSONDecodeError) as e:
             self._json(400, {"error": f"bad request: {e}"})
             return
         try:
-            out = self.server.cluster_server.predict(points, membership)
-        except ValueError as e:  # shape/dim mismatches are client errors
+            if path == "/predict":
+                points = np.asarray(payload["points"], np.float64)
+                out = srv.predict(points, bool(payload.get("membership", False)))
+            elif path == "/ingest":
+                points = np.asarray(payload["points"], np.float64)
+                out = srv.ingest(points)
+            elif path == "/swap":
+                out = srv.swap(payload.get("path"))
+            else:
+                self._json(404, {"error": f"unknown path {self.path!r}"})
+                return
+        except KeyError as e:
+            self._json(400, {"error": f"bad request: missing {e}"})
+            return
+        except ValueError as e:  # shape/dim/guard mismatches are client errors
             self._json(400, {"error": str(e)})
+            return
+        except RuntimeError as e:  # mode errors (ingest off, nothing staged)
+            self._json(409, {"error": str(e)})
             return
         except Exception as e:  # noqa: BLE001 - surface, don't crash the server
             self._json(500, {"error": f"{type(e).__name__}: {e}"})
@@ -89,6 +148,17 @@ class ClusterServer:
 
     Construction warms every bucket (AOT), so the first real request already
     hits a compiled program; ``port=0`` binds an ephemeral port (tests).
+
+    ``ingest=True`` turns on the streaming subsystem: ``/ingest`` routes
+    arriving points through the predict path into an
+    :class:`~hdbscan_tpu.stream.IngestBuffer`, a
+    :class:`~hdbscan_tpu.stream.DriftDetector` watches the GLOSH-score and
+    assignment-rate distributions, and a :class:`~hdbscan_tpu.stream.Refitter`
+    re-fits in the background on drift or point budget, publishing
+    generation-numbered artifacts under ``model_dir`` that hot-swap in
+    (``stream_reload="auto"``) or stage for ``POST /swap``
+    (``"manual"``). Stream knobs come from ``params``
+    (:class:`~hdbscan_tpu.config.HDBSCANParams` ``stream_*`` fields).
     """
 
     def __init__(
@@ -102,28 +172,129 @@ class ClusterServer:
         tracer=None,
         warmup: bool = True,
         verbose: bool = False,
+        ingest: bool = False,
+        params=None,
+        model_dir: str | None = None,
     ):
-        self.model = model
-        self.predictor = Predictor(
-            model, backend=backend, max_batch=max_batch, tracer=tracer
-        )
-        self.warmup_info = self.predictor.warmup() if warmup else None
-        self.batcher = MicroBatcher(self.predictor, linger_s=linger_s)
+        self.tracer = tracer
+        self._backend_req = backend
+        self._max_batch = max_batch
+        self._linger_s = linger_s
+        self._warmup = warmup
+        self._swap_lock = threading.Lock()
+        self._closed = False
+        self._swap_count = 0
+        self.last_swap: dict | None = None
+        self.pending: dict | None = None  # staged artifact (manual reload)
+        # Distinguishes servers sharing one trace file: check_trace enforces
+        # monotonic swap generations per (process, server).
+        self._server_id = f"{os.getpid():x}.{id(self) & 0xFFFFFF:06x}"
+        self._handle = self._build_handle(model, generation=1)
+
+        self.ingest_enabled = bool(ingest)
+        self._params = params
+        if self.ingest_enabled:
+            self._init_stream(params, model_dir)
+
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.cluster_server = self
         self._httpd.verbose = verbose
         self.host, self.port = self._httpd.server_address[:2]
         self._t0 = time.monotonic()
         self._thread: threading.Thread | None = None
-        self._closed = False
+        self._serving = False  # a serve_forever loop is (or was) running
+
+    # -- stream wiring -----------------------------------------------------
+
+    def _init_stream(self, params, model_dir) -> None:
+        from hdbscan_tpu.stream import DriftDetector, IngestBuffer, Refitter
+
+        def knob(name, default):
+            return getattr(params, name, default) if params is not None else default
+
+        self.reload_mode = knob("stream_reload", "auto")
+        self._refit_budget = int(knob("stream_refit_budget", 2048))
+        self._absorb_frac = float(knob("stream_absorb_eps_frac", 0.25))
+        self._drift_stat = knob("stream_drift_stat", "psi")
+        self._drift_threshold = float(knob("stream_drift_threshold", 2.0))
+        self.model_dir = model_dir or "stream_models"
+        self._ingest_lock = threading.Lock()
+        self.buffer = IngestBuffer(self.model, absorb_eps_frac=self._absorb_frac)
+        self.drift = DriftDetector(
+            *DriftDetector.baseline_from_model(self.model, self._handle.predictor),
+            stat=self._drift_stat,
+            threshold=self._drift_threshold,
+            tracer=self.tracer,
+        )
+        refit_params = self._refit_params(params)
+        self.refitter = Refitter(
+            refit_params,
+            self.model_dir,
+            tracer=self.tracer,
+            on_publish=self._on_publish,
+        )
+
+    def _refit_params(self, params):
+        """Re-fit params: caller's knobs where given, but the fingerprint
+        fields pinned to the served model's so the swap guard passes."""
+        from hdbscan_tpu.config import HDBSCANParams
+
+        base = params if params is not None else HDBSCANParams()
+        return base.replace(**dict(self.model.params))
+
+    # -- handles -----------------------------------------------------------
+
+    def _build_handle(self, model, generation: int) -> _ModelHandle:
+        backend = self._backend_req
+        if backend == "rpforest" and model.rpf is None:
+            backend = "auto"  # re-fit artifacts ship without a forest
+        predictor = Predictor(
+            model, backend=backend, max_batch=self._max_batch, tracer=self.tracer
+        )
+        warmup_info = predictor.warmup() if self._warmup else None
+        batcher = MicroBatcher(predictor, linger_s=self._linger_s)
+        return _ModelHandle(model, predictor, batcher, generation, warmup_info)
+
+    @property
+    def model(self):
+        return self._handle.model
+
+    @property
+    def predictor(self):
+        return self._handle.predictor
+
+    @property
+    def batcher(self):
+        return self._handle.batcher
+
+    @property
+    def generation(self) -> int:
+        return self._handle.generation
+
+    @property
+    def warmup_info(self):
+        return self._handle.warmup_info
 
     # -- request paths -----------------------------------------------------
 
     def predict(self, points: np.ndarray, membership: bool = False) -> dict:
+        for _ in range(_PIN_RETRIES):
+            handle = self._handle  # pin: this request never mixes models
+            try:
+                return self._predict_on(handle, points, membership)
+            except RuntimeError as e:
+                # The pinned handle's batcher closed under us (swap landed
+                # between the pin and the submit) — re-pin and retry; no
+                # request is dropped across a swap.
+                if "closed" not in str(e) or self._closed:
+                    raise
+        raise RuntimeError("predict retries exhausted during model swaps")
+
+    def _predict_on(self, handle: _ModelHandle, points, membership: bool) -> dict:
         if membership:
             # Membership needs the 4-output kernel variant; it bypasses the
             # batcher and relies on the predictor's internal dispatch lock.
-            labels, prob, score, mvec = self.predictor.predict(
+            labels, prob, score, mvec = handle.predictor.predict(
                 points, with_membership=True
             )
             return {
@@ -131,30 +302,208 @@ class ClusterServer:
                 "probabilities": [round(p, 6) for p in prob.tolist()],
                 "outlier_scores": [round(s, 6) for s in score.tolist()],
                 "membership": np.round(mvec, 6).tolist(),
-                "selected_ids": self.model.selected_ids.tolist(),
+                "selected_ids": handle.model.selected_ids.tolist(),
+                "generation": handle.generation,
             }
-        labels, prob, score = self.batcher.predict(points)
+        labels, prob, score = handle.batcher.predict(points)
         return {
             "labels": labels.tolist(),
             "probabilities": [round(p, 6) for p in prob.tolist()],
             "outlier_scores": [round(s, 6) for s in score.tolist()],
+            "generation": handle.generation,
         }
 
-    def health(self) -> dict:
+    def ingest(self, points: np.ndarray) -> dict:
+        """Streaming entry: predict → absorb/buffer → drift check → maybe
+        kick a background re-fit. Returns per-batch routing + drift info."""
+        if not self.ingest_enabled:
+            raise RuntimeError("server started without ingest mode")
+        t0 = time.perf_counter()
+        points = np.asarray(points, np.float64)
+        if points.ndim == 1:
+            points = points[None, :]
+        scored = False
+        for _ in range(_PIN_RETRIES):
+            handle = self._handle
+            try:
+                labels, prob, score = handle.batcher.predict(points)
+            except RuntimeError as e:
+                if "closed" not in str(e) or self._closed:
+                    raise
+                continue
+            scored = True
+            if handle is self._handle:
+                break
+            # A swap landed mid-predict: the buffer/drift state now keys to
+            # the new model, so this batch's scores are stale — redo on the
+            # current handle rather than polluting the fresh sketches.
+        if not scored:
+            raise RuntimeError("ingest retries exhausted during model swaps")
+        with self._ingest_lock:
+            absorbed, buffered = self.buffer.absorb(points, labels, prob)
+            self.drift.update(labels, score)
+            check = self.drift.check(generation=handle.generation)
+            trigger = None
+            if check["drifted"]:
+                trigger = "drift"
+            elif self.buffer.buffered_rows >= self._refit_budget:
+                trigger = "budget"
+            refit_started = False
+            if trigger and self.pending is None and not self.refitter.busy:
+                pool = self.buffer.refit_points(
+                    originals=min(self.model.n_train, 8192)
+                )
+                refit_started = self.refitter.request(pool, trigger)
+        if self.tracer is not None:
+            self.tracer(
+                "stream_ingest",
+                rows=int(len(points)),
+                absorbed=int(absorbed),
+                buffered=int(buffered),
+                generation=int(handle.generation),
+                wall_s=round(time.perf_counter() - t0, 6),
+            )
         return {
+            "rows": int(len(points)),
+            "absorbed": int(absorbed),
+            "buffered": int(buffered),
+            "generation": int(handle.generation),
+            "drift": check,
+            "refit_started": bool(refit_started),
+        }
+
+    # -- blue/green swap ---------------------------------------------------
+
+    def _on_publish(self, path: str, model, reason: str) -> None:
+        """Refitter callback (worker thread): hot-swap, or stage for
+        ``POST /swap`` in manual reload mode."""
+        staged = {"path": path, "reason": reason, "n_train": int(model.n_train)}
+        if getattr(self, "reload_mode", "auto") == "manual":
+            self.pending = staged
+            return
+        try:
+            self.swap_model(model, reason=reason, path=path)
+        except Exception as exc:  # guard failure: keep serving the old model
+            self.last_swap = {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
+
+    def swap(self, path: str | None = None) -> dict:
+        """HTTP-facing swap: explicit artifact ``path``, else the staged
+        re-fit publication."""
+        if path is None:
+            if self.pending is None:
+                raise RuntimeError("no staged artifact to swap in")
+            path = self.pending["path"]
+        return self.swap_model(path, reason="manual")
+
+    def swap_model(self, model_or_path, reason: str = "manual",
+                   path: str | None = None) -> dict:
+        """Atomically replace the served model (blue/green).
+
+        Accepts a :class:`ClusterModel` or an artifact path. Path loads run
+        the artifact's schema + sha256 digest checks (``ClusterModel.load``
+        refuses corrupt or mismatched files); either way the fingerprint
+        fields must match the served model — a swap may change the data, not
+        the clustering contract. The expensive part (predictor build +
+        warmup) happens on the old model's watch; the swap itself is one
+        reference assignment under the lock, and in-flight requests finish
+        on the handle they pinned. Old batcher drains afterwards.
+        """
+        if isinstance(model_or_path, (str, os.PathLike)):
+            path = str(model_or_path)
+            new_model = ClusterModel.load(path)  # schema + digest guard
+        else:
+            new_model = model_or_path
+        old_model = self._handle.model
+        for f in _FINGERPRINT_FIELDS:
+            if new_model.params.get(f) != old_model.params.get(f):
+                raise ValueError(
+                    f"swap fingerprint mismatch on {f!r}: incoming "
+                    f"{new_model.params.get(f)!r} != served "
+                    f"{old_model.params.get(f)!r} — refusing to swap"
+                )
+        new_handle = self._build_handle(new_model, generation=0)  # warm first
+        with self._swap_lock:
+            if self._closed:
+                raise RuntimeError("server is closed")
+            old = self._handle
+            new_handle.generation = old.generation + 1
+            t0 = time.perf_counter()
+            self._handle = new_handle  # the swap: one reference assignment
+            pause_s = time.perf_counter() - t0
+            self._swap_count += 1
+        if self.tracer is not None:
+            self.tracer(
+                "model_swap",
+                generation=int(new_handle.generation),
+                digest=str(new_handle.digest),
+                n_train=int(new_model.n_train),
+                reason=str(reason),
+                server=self._server_id,
+                pause_s=round(pause_s, 9),
+                wall_s=round(pause_s, 9),
+            )
+        old.batcher.close()  # graceful: every in-flight future completes
+        if self.ingest_enabled:
+            with self._ingest_lock:
+                self.buffer.reset(new_model)
+                self.drift.rebaseline(
+                    *type(self.drift).baseline_from_model(
+                        new_model, new_handle.predictor
+                    )
+                )
+                self.pending = None
+        info = {
+            "ok": True,
+            "generation": int(new_handle.generation),
+            "n_train": int(new_model.n_train),
+            "digest": str(new_handle.digest),
+            "reason": str(reason),
+            "path": path,
+            "pause_s": round(pause_s, 9),
+        }
+        self.last_swap = info
+        return info
+
+    # -- health ------------------------------------------------------------
+
+    def health(self) -> dict:
+        handle = self._handle
+        out = {
             "status": "ok",
-            "model": self.model.summary(),
-            "backend": self.predictor.backend,
-            "buckets": list(self.predictor.buckets),
-            "warmup": self.warmup_info,
-            "batcher": self.batcher.stats,
+            "model": handle.model.summary(),
+            "backend": handle.predictor.backend,
+            "buckets": list(handle.predictor.buckets),
+            "warmup": handle.warmup_info,
+            "batcher": handle.batcher.stats,
+            "generation": handle.generation,
+            "swaps": self._swap_count,
             "uptime_s": round(time.monotonic() - self._t0, 3),
         }
+        if self.last_swap is not None:
+            out["last_swap"] = self.last_swap
+        if self.ingest_enabled:
+            stats = self.buffer.stats()
+            out["stream"] = {
+                "rows_seen": stats["rows_seen"],
+                "absorbed_exact": stats["absorbed_exact"],
+                "absorbed_near": stats["absorbed_near"],
+                "buffered": stats["buffered"],
+                "bubbles": len(stats["bubbles"]),
+                "drift_rows": self.drift.rows,
+                "drift_checks": self.drift.checks,
+                "refits_ok": self.refitter.refits_ok,
+                "refits_failed": self.refitter.refits_failed,
+                "refit_busy": self.refitter.busy,
+                "reload": self.reload_mode,
+                "pending": self.pending,
+            }
+        return out
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "ClusterServer":
         """Serve on a daemon thread (tests / embedding); returns self."""
+        self._serving = True
         self._thread = threading.Thread(
             target=self._httpd.serve_forever,
             name="predict-http",
@@ -164,8 +513,19 @@ class ClusterServer:
         return self
 
     def serve_forever(self) -> None:
-        """Serve on the calling thread until interrupted (CLI path)."""
+        """Serve on the calling thread until interrupted (CLI path).
+        ``SIGTERM`` triggers the same graceful drain as ``close()``."""
         try:
+            signal.signal(
+                signal.SIGTERM,
+                lambda *_: threading.Thread(
+                    target=self.close, name="sigterm-close"
+                ).start(),
+            )
+        except ValueError:
+            pass  # not the main thread (embedded) — close() still works
+        try:
+            self._serving = True
             self._httpd.serve_forever()
         except KeyboardInterrupt:
             pass
@@ -173,14 +533,20 @@ class ClusterServer:
             self.close()
 
     def close(self) -> None:
-        if self._closed:
-            return
-        self._closed = True
-        self._httpd.shutdown()
+        """Graceful shutdown: stop accepting, finish in-flight requests
+        (batcher drain resolves every accepted future), then release."""
+        with self._swap_lock:
+            if self._closed:
+                return
+            self._closed = True
+        if self._serving:  # shutdown() blocks unless a serve loop is live
+            self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
-        self.batcher.close()
+        self._handle.batcher.close()
+        if self.ingest_enabled:
+            self.refitter.join(timeout=0.5)  # daemon thread; don't block long
 
     def __enter__(self) -> "ClusterServer":
         return self

@@ -1,0 +1,30 @@
+"""Streaming ingest subsystem: online maintenance of a served model.
+
+The serve/ stack is read-only — a fit is distilled once into a
+:class:`~hdbscan_tpu.serve.artifact.ClusterModel` and predictions never feed
+back. This package closes the loop for a continuously arriving point stream
+(ROADMAP item 3, the "millions of users, heavy traffic" scenario), three
+pieces layered on the predict path:
+
+- ``stream/buffer.py`` — :class:`IngestBuffer`: arriving points route
+  through the served predict path; exact duplicates of training rows and
+  near-duplicates (attachment mutual-reachability level within a
+  configurable fraction of their cluster's own density level) are absorbed
+  into per-cluster **bubble summaries** (count / linear sum / squared sum —
+  the MR-HDBSCAN* data-bubble CF triple, ``core/bubbles.py`` /
+  ``core/dedup.py`` conventions) instead of being stored as raw rows; only
+  genuinely novel points are buffered.
+- ``stream/drift.py`` — :class:`DriftDetector`: a streaming histogram of
+  GLOSH outlier scores plus per-cluster assignment rates, compared against
+  the fit-time baseline with a PSI- or KS-style statistic; emits
+  ``drift_check`` trace events.
+- ``stream/refit.py`` — :class:`Refitter`: on a drift trigger or a buffered
+  point budget, re-fits in a background worker thread (novel buffer + a
+  reservoir of original training rows) and publishes a new schema-versioned
+  artifact for the server to hot-swap (``serve/server.py`` blue/green
+  handles — README "Streaming").
+"""
+
+from hdbscan_tpu.stream.buffer import IngestBuffer  # noqa: F401
+from hdbscan_tpu.stream.drift import DriftDetector  # noqa: F401
+from hdbscan_tpu.stream.refit import Refitter  # noqa: F401

@@ -1,0 +1,134 @@
+"""Refitter: background re-fit + schema-versioned artifact publication.
+
+When the server's drift detector flags shift (or the novelty buffer hits
+its point budget), the refitter runs a full fit over the re-fit pool —
+novel buffered rows + the stream reservoir + a sample of original training
+rows — on a daemon worker thread, so serving latency never sees fit wall.
+The result is distilled through the standard
+``HDBSCANResult.to_cluster_model`` path and saved as a generation-numbered
+``hdbscan-tpu-model/2`` artifact (atomic ``ClusterModel.save``:
+tempfile + ``os.replace`` + sha256 digests), then handed to ``on_publish``
+— in the server, that callback performs (or stages, in ``manual`` reload
+mode) the blue/green swap.
+
+At most one re-fit runs at a time: ``request`` returns ``False`` while a
+worker is active, and the caller (``ClusterServer.ingest``) also suppresses
+re-triggering while a published artifact awaits a manual swap.  A failed
+fit never touches the served model — the error is recorded on
+``last_error``, traced as ``model_refit`` with ``ok=False``, and serving
+continues on the old handle.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+__all__ = ["Refitter"]
+
+
+class Refitter:
+    """One-at-a-time background re-fit worker.
+
+    Parameters
+    ----------
+    params:
+        :class:`~hdbscan_tpu.config.HDBSCANParams` for the re-fit.  The
+        caller must keep the fingerprint fields (``min_points``,
+        ``min_cluster_size``, ``dist_function``) equal to the served
+        model's, or the server's swap guard will reject the artifact.
+    model_dir:
+        Directory for published artifacts (created on demand);
+        generation ``g`` lands at ``model_gen{g:04d}.npz``.
+    on_publish:
+        ``callback(path, model, reason)`` invoked on the worker thread
+        after a successful save.
+    fit_fn:
+        Override for the fit entry point (tests); defaults to
+        ``hdbscan_tpu.models.hdbscan.fit``.
+    """
+
+    def __init__(self, params, model_dir, tracer=None, on_publish=None, fit_fn=None):
+        self.params = params
+        self.model_dir = model_dir
+        self.tracer = tracer
+        self.on_publish = on_publish
+        self.fit_fn = fit_fn
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._publish_seq = 0
+        self.refits_ok = 0
+        self.refits_failed = 0
+        self.last_error: str | None = None
+        self.last_path: str | None = None
+
+    @property
+    def busy(self) -> bool:
+        with self._lock:
+            return self._thread is not None and self._thread.is_alive()
+
+    def request(self, points, reason: str) -> bool:
+        """Start a background re-fit over ``points`` (returns ``False`` if
+        one is already running)."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return False
+            self._publish_seq += 1
+            seq = self._publish_seq
+            self._thread = threading.Thread(
+                target=self._worker,
+                args=(points, str(reason), seq),
+                name=f"refit-{seq}",
+                daemon=True,
+            )
+            self._thread.start()
+        return True
+
+    def join(self, timeout: float | None = None) -> bool:
+        """Wait for the active re-fit (if any); True when idle."""
+        with self._lock:
+            t = self._thread
+        if t is not None:
+            t.join(timeout)
+        return not self.busy
+
+    def _worker(self, points, reason: str, seq: int) -> None:
+        t0 = time.perf_counter()
+        try:
+            if self.fit_fn is not None:
+                result = self.fit_fn(points, self.params)
+            else:
+                from hdbscan_tpu.models import hdbscan
+
+                result = hdbscan.fit(points, self.params)
+            model = result.to_cluster_model(points, self.params)
+            os.makedirs(self.model_dir, exist_ok=True)
+            path = os.path.join(self.model_dir, f"model_gen{seq:04d}.npz")
+            model.save(path)
+        except Exception as exc:  # never let a bad refit kill serving
+            self.last_error = f"{type(exc).__name__}: {exc}"
+            self.refits_failed += 1
+            if self.tracer is not None:
+                self.tracer(
+                    "model_refit",
+                    rows=int(len(points)),
+                    reason=reason,
+                    ok=False,
+                    error=self.last_error,
+                    wall_s=round(time.perf_counter() - t0, 6),
+                )
+            return
+        self.refits_ok += 1
+        self.last_path = path
+        if self.tracer is not None:
+            self.tracer(
+                "model_refit",
+                rows=int(len(points)),
+                reason=reason,
+                ok=True,
+                n_train=int(model.n_train),
+                wall_s=round(time.perf_counter() - t0, 6),
+            )
+        if self.on_publish is not None:
+            self.on_publish(path, model, reason)

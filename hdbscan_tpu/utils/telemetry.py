@@ -302,6 +302,9 @@ def build_report(
     mst_device = mst_device_section(tracer)
     if mst_device is not None:
         report["mst_device"] = mst_device
+    stream = stream_section(tracer)
+    if stream is not None:
+        report["stream"] = stream
     if memory is not None:
         report["memory"] = json_sanitize(memory)
     if per_host is not None:
@@ -401,6 +404,45 @@ def mst_device_section(tracer: Tracer) -> dict | None:
         ),
         "build_wall_s": round(sum(e.wall_s for e in builds), 6),
     }
+
+
+def stream_section(tracer: Tracer) -> dict | None:
+    """The run report's ``stream`` section: online-maintenance aggregates
+    (``hdbscan_tpu/stream`` + ``serve/server.py``). Totals every
+    ``stream_ingest`` event's row routing (``absorb_ratio`` = absorbed /
+    rows — how much of the stream the bubble summaries soaked up without
+    buffering), counts ``drift_check`` evaluations and how many flagged,
+    ``model_refit`` outcomes, and for ``model_swap`` the generation reached
+    plus the max in-lock pause (the blue/green "zero pause" claim, made a
+    number). None when the run never ingested."""
+    ingest = [e for e in tracer.events if e.name == "stream_ingest"]
+    if not ingest:
+        return None
+    rows = sum(int(e.fields.get("rows", 0)) for e in ingest)
+    absorbed = sum(int(e.fields.get("absorbed", 0)) for e in ingest)
+    checks = [e for e in tracer.events if e.name == "drift_check"]
+    refits = [e for e in tracer.events if e.name == "model_refit"]
+    swaps = [e for e in tracer.events if e.name == "model_swap"]
+    section = {
+        "ingest_batches": len(ingest),
+        "rows": int(rows),
+        "absorbed": int(absorbed),
+        "absorb_ratio": round(absorbed / rows, 6) if rows else 0.0,
+        "ingest_wall_s": round(sum(e.wall_s for e in ingest), 6),
+        "drift_checks": len(checks),
+        "drift_flags": int(sum(1 for e in checks if e.fields.get("drifted"))),
+        "refits": len(refits),
+        "refits_ok": int(sum(1 for e in refits if e.fields.get("ok"))),
+    }
+    if swaps:
+        section["swaps"] = len(swaps)
+        section["generation"] = int(
+            max(int(e.fields.get("generation", 0)) for e in swaps)
+        )
+        section["swap_pause_max_s"] = round(
+            max(float(e.fields.get("pause_s", e.wall_s)) for e in swaps), 9
+        )
+    return section
 
 
 def predict_latency_section(tracer: Tracer) -> dict | None:

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import sys
+import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
@@ -108,6 +109,11 @@ class Tracer:
     """
 
     def __init__(self, stream=None, sinks=None, counters=None):
+        # Serving emits from many threads at once (HTTP handlers, the
+        # batcher worker, the background refitter): one lock makes the
+        # counter deltas, the in-memory event order, and the sink write
+        # order (JsonlSink's per-line seq) mutually consistent.
+        self._emit_lock = threading.Lock()
         self.events: list[TraceEvent] = []
         self._sinks = list(sinks or [])
         if stream is not None:
@@ -139,15 +145,16 @@ class Tracer:
             self._emit(TraceEvent(name, time.monotonic() - t0, fields))
 
     def _emit(self, ev: TraceEvent) -> None:
-        for key, fn in self._counters.items():
-            cur = fn()
-            delta = cur - self._counter_last[key]
-            self._counter_last[key] = cur
-            if delta:
-                ev.fields[key] = delta
-        self.events.append(ev)
-        for s in self._sinks:
-            s.emit(ev)
+        with self._emit_lock:
+            for key, fn in self._counters.items():
+                cur = fn()
+                delta = cur - self._counter_last[key]
+                self._counter_last[key] = cur
+                if delta:
+                    ev.fields[key] = delta
+            self.events.append(ev)
+            for s in self._sinks:
+                s.emit(ev)
 
     def total(self, name: str) -> float:
         """Summed wall seconds of all events with this stage name."""

@@ -21,7 +21,9 @@ strictly increasing per (process, device). Host finalize events
 merge forest, ``vectorized``/``reference`` for the tree stages). Serving
 events (``serve/predict.py``, README "Serving") add three: every
 ``predict_batch`` event must carry a power-of-two ``bucket``, ``rows`` in
-``[1, bucket]``, and a per-process strictly increasing ``batch_seq``.
+``[1, bucket]``, and a strictly increasing ``batch_seq`` per
+(process, predictor) — blue/green swaps start a fresh predictor whose
+sequence restarts, but each predictor's dispatch order is total.
 Device MST events (``core/mst_device.py``, README "Device-resident
 finalize") add three schemas: ``mst_round`` must carry an integer
 ``round >= 0``, ``components >= 1`` and ``edges_added >= 0``; ``host_sync``
@@ -35,7 +37,17 @@ neighbors") add three schemas: ``knn_index_build`` must carry positive
 integer ``trees``/``depth``/``leaf_size``/``n`` with ``max_leaf <=
 leaf_size``; ``knn_index_query`` positive ``n``/``k``/``trees`` and, when
 sampled, ``recall_at_k`` in [0, 1]; ``knn_index_rescan`` an integer
-``round`` in ``[0, rescan_rounds)`` and a non-negative ``improved``. Given
+``round`` in ``[0, rescan_rounds)`` and a non-negative ``improved``.
+Streaming events (``hdbscan_tpu/stream``, README "Streaming") add four
+schemas: ``stream_ingest`` must carry positive ``rows`` with non-negative
+``absorbed``/``buffered`` summing to ``rows`` and a positive model
+``generation``; ``drift_check`` a ``stat`` in {psi, ks}, finite
+non-negative ``value``/``assign_psi``, positive ``threshold``, integer
+``rows >= 0`` and a boolean ``drifted``; ``model_refit`` a boolean ``ok``
+and positive ``rows``; ``model_swap`` a positive ``generation`` that
+STRICTLY INCREASES per (process, server) — the blue/green contract that a
+server process never swaps backwards or repeats a generation — plus a
+string ``digest`` and positive ``n_train``. Given
 a report (``utils/telemetry.REPORT_SCHEMA``), additionally cross-checks
 that the report's per-phase wall totals equal the trace's per-stage wall
 sums within 1e-6, and — when the report carries a ``predict_latency``
@@ -91,8 +103,9 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
     errors: list[str] = []
     last_seq: dict = {}  # per-process strictly-increasing seq check
     last_dev_seq: dict = {}  # per-(process, device) seq for ring wall events
-    last_batch_seq: dict = {}  # per-process batch_seq for predict_batch
+    last_batch_seq: dict = {}  # per-(process, predictor) predict_batch seq
     sync_counts: dict = {}  # per-process [host_syncs, device forest builds]
+    last_swap_gen: dict = {}  # per-(process, server) model_swap generation
     with open(path, encoding="utf-8") as f:
         for lineno, line in enumerate(f, 1):
             line = line.strip()
@@ -181,13 +194,17 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
                         f"'batch_seq'"
                     )
                 else:
-                    prev = last_batch_seq.get(proc)
+                    # Keyed per (process, predictor): a blue/green swap
+                    # builds a fresh Predictor whose batch_seq restarts at
+                    # 0, but each predictor's own dispatch order is total.
+                    bkey = (proc, ev.get("pred"))
+                    prev = last_batch_seq.get(bkey)
                     if prev is not None and bseq <= prev:
                         errors.append(
                             f"{path}:{lineno}: batch_seq {bseq} not "
                             f"increasing (prev {prev})"
                         )
-                    last_batch_seq[proc] = bseq
+                    last_batch_seq[bkey] = bseq
             # Approximate-neighbor invariants (ops/rpforest.py): the three
             # knn_index_* events each pin their geometry fields.
             if stage in ("knn_index_build", "knn_index_query", "knn_index_rescan"):
@@ -200,6 +217,24 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
                 if stage != "mst_round":
                     counts = sync_counts.setdefault(proc, [0, 0])
                     counts[0 if stage == "host_sync" else 1] += 1
+            # Streaming invariants (hdbscan_tpu/stream + serve/server.py):
+            # ingest row accounting, drift-check schema, and the blue/green
+            # contract — swap generations strictly increase per server.
+            if stage in ("stream_ingest", "drift_check", "model_refit",
+                         "model_swap"):
+                errors += _check_stream(path, lineno, stage, ev)
+                if stage == "model_swap":
+                    gen = ev.get("generation")
+                    if _pos_int(gen):
+                        key = (proc, ev.get("server"))
+                        prev = last_swap_gen.get(key)
+                        if prev is not None and gen <= prev:
+                            errors.append(
+                                f"{path}:{lineno}: model_swap generation "
+                                f"{gen} not increasing (prev {prev}) for "
+                                f"server {ev.get('server')!r}"
+                            )
+                        last_swap_gen[key] = gen
             # Per-device wall events: each device's timeline must be ordered.
             device = ev.get("device")
             if isinstance(device, int) and isinstance(seq, int):
@@ -305,6 +340,74 @@ def _check_mst_device(path: str, lineno: int, stage: str, ev: dict) -> list[str]
                 f"{where} nodes={nodes!r} inconsistent with fallback={fallback}"
                 f" (want nodes == -1 exactly on fallback)"
             )
+    return errors
+
+
+def _check_stream(path: str, lineno: int, stage: str, ev: dict) -> list[str]:
+    """The four streaming event schemas (hdbscan_tpu/stream,
+    serve/server.py). The cross-event monotonic-generation check for
+    ``model_swap`` lives in the main loop (it needs per-server state)."""
+    errors: list[str] = []
+    where = f"{path}:{lineno}: {stage}"
+    if stage == "stream_ingest":
+        rows = ev.get("rows")
+        absorbed = ev.get("absorbed")
+        buffered = ev.get("buffered")
+        if not _pos_int(rows):
+            errors.append(f"{where} rows={rows!r} not a positive int")
+        elif not _nonneg_int(absorbed) or not _nonneg_int(buffered):
+            errors.append(
+                f"{where} absorbed={absorbed!r}/buffered={buffered!r} not "
+                f"non-negative ints"
+            )
+        elif absorbed + buffered != rows:
+            errors.append(
+                f"{where} absorbed={absorbed} + buffered={buffered} != "
+                f"rows={rows} — every ingested row is exactly one of the two"
+            )
+        if not _pos_int(ev.get("generation")):
+            errors.append(
+                f"{where} generation={ev.get('generation')!r} not a "
+                f"positive int"
+            )
+    elif stage == "drift_check":
+        if ev.get("stat") not in ("psi", "ks"):
+            errors.append(f"{where} stat={ev.get('stat')!r} not in (psi, ks)")
+        for key in ("value", "assign_psi"):
+            val = ev.get(key)
+            if (
+                not isinstance(val, (int, float))
+                or isinstance(val, bool)
+                or not math.isfinite(float(val))
+                or float(val) < 0
+            ):
+                errors.append(
+                    f"{where} {key}={val!r} not a finite non-negative number"
+                )
+        thr = ev.get("threshold")
+        if not isinstance(thr, (int, float)) or isinstance(thr, bool) or not (
+            float(thr) > 0
+        ):
+            errors.append(f"{where} threshold={thr!r} not a positive number")
+        if not _nonneg_int(ev.get("rows")):
+            errors.append(f"{where} rows={ev.get('rows')!r} not a non-negative int")
+        if not isinstance(ev.get("drifted"), bool):
+            errors.append(f"{where} drifted={ev.get('drifted')!r} not a bool")
+    elif stage == "model_refit":
+        if not isinstance(ev.get("ok"), bool):
+            errors.append(f"{where} ok={ev.get('ok')!r} not a bool")
+        if not _pos_int(ev.get("rows")):
+            errors.append(f"{where} rows={ev.get('rows')!r} not a positive int")
+    else:  # model_swap
+        if not _pos_int(ev.get("generation")):
+            errors.append(
+                f"{where} generation={ev.get('generation')!r} not a "
+                f"positive int"
+            )
+        if not isinstance(ev.get("digest"), str) or not ev.get("digest"):
+            errors.append(f"{where} lacks a string 'digest'")
+        if not _pos_int(ev.get("n_train")):
+            errors.append(f"{where} n_train={ev.get('n_train')!r} not a positive int")
     return errors
 
 

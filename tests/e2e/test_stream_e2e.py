@@ -1,0 +1,158 @@
+"""End-to-end streaming acceptance: a 10k-point stream with an injected
+distribution shift flows into a served 5k-fit model; the drift detector
+flags the shift, a background re-fit publishes a generation-2 artifact,
+and the blue/green hot-swap lands with zero failed and zero mixed-model
+requests under concurrent /predict load. Post-swap quality is checked as
+ARI on shifted data against a from-scratch fit over the same distribution,
+and the whole trace passes scripts/check_trace.py."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+from hdbscan_tpu import HDBSCANParams
+from hdbscan_tpu.models import hdbscan, mr_hdbscan
+from hdbscan_tpu.serve import ClusterModel, approximate_predict
+from hdbscan_tpu.serve.server import ClusterServer
+from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+from hdbscan_tpu.utils.tracing import JsonlSink, Tracer
+from scripts import check_trace
+
+#: Three fit-time blobs plus one the stream drifts onto.
+CENTERS = np.asarray([(0.0, 0.0, 0.0), (6.0, 6.0, 6.0), (0.0, 8.0, 0.0)])
+NOVEL = np.asarray((10.0, -6.0, 5.0))
+SPREAD = 0.25
+
+
+def _blobs(rng, n, centers):
+    """n points spread over ``centers`` round-robin; returns (pts, truth)."""
+    centers = np.atleast_2d(np.asarray(centers, float))
+    truth = np.arange(n) % len(centers)
+    return centers[truth] + rng.normal(0, SPREAD, (n, 3)), truth
+
+
+def _post(base, path, obj):
+    req = urllib.request.Request(
+        base + path, json.dumps(obj).encode(),
+        {"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def test_stream_drift_refit_hot_swap(tmp_path):
+    rng = np.random.default_rng(42)
+    params = HDBSCANParams(
+        min_points=8,
+        min_cluster_size=100,
+        processing_units=2048,
+        stream_refit_budget=4000,  # high: drift, not budget, should trigger
+    )
+    train, _ = _blobs(rng, 5000, CENTERS)
+    model = hdbscan.fit(train, params).to_cluster_model(train, params)
+
+    trace = str(tmp_path / "trace.jsonl")
+    tracer = Tracer(sinks=[JsonlSink(trace)])
+    srv = ClusterServer(
+        model, max_batch=64, port=0, tracer=tracer,
+        ingest=True, params=params, model_dir=str(tmp_path / "models"),
+    ).start()
+    base = f"http://{srv.host}:{srv.port}"
+
+    # concurrent /predict load for the whole stream + swap window ---------
+    errors, gens = [], []
+    stop = threading.Event()
+
+    def hammer():
+        lrng = np.random.default_rng(threading.get_ident() % 2**32)
+        while not stop.is_set():
+            pts, _ = _blobs(lrng, 8, CENTERS)
+            try:
+                out = _post(base, "/predict", {"points": pts.tolist()})
+                gens.append(out["generation"])  # one generation per response
+            except Exception as e:  # noqa: BLE001 - the assertion is ==[]
+                errors.append(repr(e))
+            time.sleep(0.05)
+
+    load = [threading.Thread(target=hammer, daemon=True) for _ in range(2)]
+    for t in load:
+        t.start()
+
+    try:
+        # the 10k stream: 4000 in-distribution, then 6000 shifted ---------
+        streamed_shifted = []
+        rows = absorbed = 0
+        for chunk in range(20):
+            if chunk < 8:
+                pts, _ = _blobs(rng, 500, CENTERS)
+            else:
+                pts, _ = _blobs(rng, 500, NOVEL)
+                streamed_shifted.append(pts)
+            out = _post(base, "/ingest", {"points": pts.tolist()})
+            assert out["rows"] == 500
+            assert out["absorbed"] + out["buffered"] == out["rows"]
+            rows += out["rows"]
+            absorbed += out["absorbed"]
+        assert rows == 10_000
+        shifted = np.concatenate(streamed_shifted)
+
+        # drift must flag and the swap must land --------------------------
+        deadline = time.monotonic() + 300
+        while srv.generation < 2 and time.monotonic() < deadline:
+            time.sleep(0.5)
+        assert srv.generation == 2, (
+            f"no hot-swap within budget: health={srv.health()}"
+        )
+        for _ in range(3):  # post-swap traffic definitely sees generation 2
+            pts, _ = _blobs(rng, 8, NOVEL)
+            assert _post(base, "/predict", {"points": pts.tolist()})[
+                "generation"
+            ] == 2
+    finally:
+        stop.set()
+        for t in load:
+            t.join(timeout=30)
+        srv.close()
+        tracer.close()
+
+    # zero failed, zero mixed-model requests ------------------------------
+    assert errors == []
+    assert set(gens) == {1, 2}  # traffic observed on both sides of the swap
+    first2 = gens.index(2)
+    assert all(g == 2 for g in gens[first2:])  # generations never regress
+
+    # post-swap quality: ARI on shifted data vs a from-scratch fit --------
+    eval_pts, truth = _blobs(
+        np.random.default_rng(7), 1200, np.vstack([CENTERS, NOVEL[None]])
+    )
+    swapped = srv.model  # generation-2 artifact now being served
+    swap_labels, _ = approximate_predict(swapped, eval_pts)
+    scratch_pool = np.concatenate([train, shifted])
+    scratch = mr_hdbscan.fit(scratch_pool, params)
+    scratch_model = ClusterModel.from_fit_result(scratch, scratch_pool, params)
+    scratch_labels, _ = approximate_predict(scratch_model, eval_pts)
+    ari_swap = adjusted_rand_index(swap_labels, truth, noise_as_singletons=True)
+    ari_scratch = adjusted_rand_index(
+        scratch_labels, truth, noise_as_singletons=True
+    )
+    assert ari_swap >= 0.95 * ari_scratch, (ari_swap, ari_scratch)
+    assert ari_swap > 0.5
+
+    # the trace tells the same story and passes the validator -------------
+    events, trace_errors = check_trace.validate_trace(trace)
+    assert not trace_errors, trace_errors
+    stages = {e["stage"] for e in events}
+    assert {"stream_ingest", "drift_check", "model_refit",
+            "model_swap", "predict_batch"} <= stages
+    ingests = [e for e in events if e["stage"] == "stream_ingest"]
+    assert sum(e["rows"] for e in ingests) == 10_000
+    assert any(e["drifted"] for e in events if e["stage"] == "drift_check")
+    refits = [e for e in events if e["stage"] == "model_refit"]
+    assert refits and all(e["ok"] for e in refits)
+    swaps = [e for e in events if e["stage"] == "model_swap"]
+    assert [e["generation"] for e in swaps] == [2]
+    assert swaps[0]["reason"] in ("drift", "budget")
+    assert swaps[0]["pause_s"] < 0.1  # the swap is a pointer assignment

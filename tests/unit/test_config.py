@@ -16,6 +16,8 @@ from hdbscan_tpu.config import HDBSCANParams
         ("tree_backend", "gpu", ("auto", "reference", "vectorized")),
         ("predict_backend", "onnx", ("auto", "xla", "fused", "rpforest")),
         ("knn_index", "annoy", ("auto", "exact", "rpforest")),
+        ("stream_drift_stat", "chi2", ("psi", "ks")),
+        ("stream_reload", "eager", ("auto", "manual")),
     ],
 )
 def test_backend_flags_validate_eagerly(field, bad, allowed):
@@ -41,6 +43,33 @@ def test_rpforest_knob_ranges(field, bad):
         HDBSCANParams(**{field: bad})
 
 
+@pytest.mark.parametrize(
+    "field,bad",
+    [
+        ("stream_absorb_eps_frac", -0.1),
+        ("stream_drift_threshold", 0.0),
+        ("stream_drift_threshold", -1.0),
+        ("stream_refit_budget", 0),
+    ],
+)
+def test_stream_knob_ranges(field, bad):
+    with pytest.raises(ValueError, match=field) as exc:
+        HDBSCANParams(**{field: bad})
+    assert repr(bad) in str(exc.value)
+
+
+def test_valid_stream_values_construct():
+    for stat in ("psi", "ks"):
+        assert HDBSCANParams(stream_drift_stat=stat).stream_drift_stat == stat
+    for reload in ("auto", "manual"):
+        assert HDBSCANParams(stream_reload=reload).stream_reload == reload
+    p = HDBSCANParams(
+        stream_absorb_eps_frac=0.0, stream_drift_threshold=0.5,
+        stream_refit_budget=1,
+    )
+    assert p.stream_absorb_eps_frac == 0.0
+
+
 def test_valid_backend_values_construct():
     for knn_index in ("auto", "exact", "rpforest"):
         p = HDBSCANParams(
@@ -64,5 +93,10 @@ def test_flag_parsing_roundtrip():
         ("rpf_trees", "rpf_trees", int),
         ("rpf_leaf_size", "rpf_leaf_size", int),
         ("rpf_rescan", "rpf_rescan_rounds", int),
+        ("absorb_eps", "stream_absorb_eps_frac", float),
+        ("drift_stat", "stream_drift_stat", str),
+        ("drift_threshold", "stream_drift_threshold", float),
+        ("refit_budget", "stream_refit_budget", int),
+        ("stream_reload", "stream_reload", str),
     ):
         assert FLAG_FIELDS.get(flag) == (field, conv)

@@ -3,6 +3,7 @@ round-trips, approximate_predict semantics, the zero-recompile bucket
 contract, and the micro-batcher."""
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -289,6 +290,52 @@ def test_batcher_rejects_after_close(fitted):
         mb.submit(np.zeros((1, 3)))
 
 
+def test_batcher_close_drains_queued_requests(fitted):
+    # Graceful shutdown: every future accepted before close() resolves —
+    # the old behavior abandoned items that raced the close sentinel.
+    *_, model = fitted
+    pred = Predictor(model, max_batch=8)
+    pred.warmup()
+    mb = MicroBatcher(pred, linger_s=0.0)
+    futs = [mb.submit(np.zeros((1, 3))) for _ in range(40)]
+    mb.close()
+    for f in futs:
+        labels, prob, score = f.result(timeout=10)  # hangs forever pre-fix
+        assert labels.shape == (1,)
+
+
+def test_batcher_close_races_concurrent_submitters(fitted):
+    # submit() threads race close(): every submit either raises RuntimeError
+    # (rejected at the door) or returns a future that RESOLVES. No future
+    # may hang.
+    *_, model = fitted
+    for _ in range(5):
+        pred = Predictor(model, max_batch=8)
+        pred.warmup()
+        mb = MicroBatcher(pred, linger_s=0.001)
+        accepted, rejected = [], []
+        start = threading.Barrier(9)
+
+        def worker():
+            start.wait()
+            for _ in range(10):
+                try:
+                    accepted.append(mb.submit(np.zeros((1, 3))))
+                except RuntimeError:
+                    rejected.append(1)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        start.wait()
+        mb.close()
+        for t in threads:
+            t.join(timeout=30)
+        for f in accepted:
+            assert f.result(timeout=10)[0].shape == (1,)
+        assert len(accepted) + len(rejected) == 80
+
+
 def test_to_cluster_model_methods(fitted):
     data, params, result, _ = fitted
     model = result.to_cluster_model(data, params)
@@ -377,3 +424,151 @@ def test_rpf_zero_recompiles_after_warmup(fitted_rpf):
         pred.predict(np.zeros((rows, model.data.shape[1])))
     pred.predict(np.zeros((4, model.data.shape[1])), with_membership=True)
     assert counter() - before == 0
+
+
+# -- blue/green swap (serve/server.py) --------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fitted_b(fitted):
+    """A second fit with the SAME fingerprint params but different data —
+    a swap-compatible artifact: (data, params, result, model)."""
+    _, params, *_ = fitted
+    rng = np.random.default_rng(31)
+    data, _ = make_blobs(rng, n=350, d=3, centers=3, spread=0.2)
+    result = hdbscan.fit(data, params)
+    return data, params, result, ClusterModel.from_fit_result(result, data, params)
+
+
+def _server(model, **kw):
+    from hdbscan_tpu.serve.server import ClusterServer
+
+    kw.setdefault("max_batch", 16)
+    kw.setdefault("port", 0)
+    return ClusterServer(model, **kw)
+
+
+def test_predict_response_carries_generation(fitted):
+    *_, model = fitted
+    with _server(model) as srv:
+        out = srv.predict(model.data[:3])
+        assert out["generation"] == 1 == srv.generation
+        out = srv.predict(model.data[:3], membership=True)
+        assert out["generation"] == 1
+
+
+def test_swap_replaces_model_and_bumps_generation(fitted, fitted_b):
+    *_, model = fitted
+    data_b, _, result_b, model_b = fitted_b
+    with _server(model) as srv:
+        info = srv.swap_model(model_b, reason="test")
+        assert info["ok"] and info["generation"] == 2
+        assert srv.model is model_b and srv.generation == 2
+        out = srv.predict(data_b)
+        fit_labels = np.asarray(result_b.labels)
+        mask = fit_labels > 0
+        np.testing.assert_array_equal(np.asarray(out["labels"])[mask],
+                                      fit_labels[mask])
+        assert srv.health()["swaps"] == 1
+
+
+def test_swap_under_concurrent_predict_load(fitted, fitted_b):
+    # The blue/green guarantee: zero failed and zero mixed-model requests
+    # while the handle is replaced — every response carries the generation
+    # it was computed on, and the drained old batcher never abandons one.
+    *_, model = fitted
+    *_, model_b = fitted_b
+    with _server(model) as srv:
+        errors, gens = [], [[] for _ in range(6)]
+        stop = threading.Event()
+
+        def hammer(seen):
+            rng = np.random.default_rng(threading.get_ident() % 2**32)
+            while not stop.is_set():
+                try:
+                    out = srv.predict(rng.normal(0, 3, (4, 3)))
+                    seen.append(out["generation"])
+                except Exception as e:  # noqa: BLE001
+                    errors.append(repr(e))
+
+        threads = [
+            threading.Thread(target=hammer, args=(seen,)) for seen in gens
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        srv.swap_model(model_b, reason="load-test")
+        time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert errors == []
+        flat = [g for seen in gens for g in seen]
+        assert set(flat) == {1, 2}  # traffic on both sides of the swap
+        # per-thread monotonic: a client never sees the old model again
+        # after a response from the new one (requests pin, never regress)
+        for seen in gens:
+            assert seen == sorted(seen)
+
+
+def test_swap_rejects_fingerprint_mismatch(fitted):
+    data, params, result, model = fitted
+    other_params = params.replace(min_points=params.min_points + 3)
+    other = ClusterModel.from_fit_result(
+        hdbscan.fit(data, other_params), data, other_params
+    )
+    with _server(model, warmup=False) as srv:
+        with pytest.raises(ValueError, match="fingerprint"):
+            srv.swap_model(other)
+        assert srv.generation == 1 and srv.model is model
+
+
+def test_swap_rejects_corrupt_artifact_mid_swap(tmp_path, fitted, fitted_b):
+    # Digest-mismatch rejection: a corrupted artifact on disk must not
+    # reach the serving path; the old handle keeps serving.
+    *_, model = fitted
+    *_, model_b = fitted_b
+    path = model_b.save(str(tmp_path / "next.npz"))
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files}
+    arrays["data"] = arrays["data"] + 1e-3
+    with open(path, "wb") as f:
+        np.savez_compressed(f, **arrays)
+    with _server(model, warmup=False) as srv:
+        with pytest.raises(ValueError, match="corrupt"):
+            srv.swap_model(path)
+        assert srv.generation == 1
+        assert srv.predict(model.data[:2])["generation"] == 1
+
+
+def test_server_serves_v1_artifact_and_swaps_to_v2(tmp_path, fitted, fitted_b):
+    # Back-compat through the NEW server path: a schema /1 artifact loads
+    # and serves, then hot-swaps to a /2 artifact loaded from disk.
+    import dataclasses
+
+    *_, model = fitted
+    *_, model_b = fitted_b
+    v1 = dataclasses.replace(model, schema="hdbscan-tpu-model/1", rpf=None)
+    p1 = v1.save(str(tmp_path / "v1.npz"))
+    p2 = model_b.save(str(tmp_path / "v2.npz"))
+    loaded = ClusterModel.load(p1)
+    assert loaded.schema == "hdbscan-tpu-model/1"
+    with _server(loaded) as srv:
+        assert srv.predict(model.data[:4])["generation"] == 1
+        info = srv.swap_model(p2)  # load-under-swap from disk
+        assert info["generation"] == 2
+        assert srv.model.schema == "hdbscan-tpu-model/2"
+        assert srv.predict(model.data[:4])["generation"] == 2
+
+
+def test_server_close_is_graceful_and_idempotent(fitted):
+    *_, model = fitted
+    srv = _server(model).start()
+    out = srv.predict(model.data[:2])
+    assert out["generation"] == 1
+    srv.close()
+    srv.close()  # idempotent
+    with pytest.raises(RuntimeError):
+        srv.batcher.submit(model.data[:1])
+    with pytest.raises(RuntimeError, match="closed"):
+        srv.swap_model(model)

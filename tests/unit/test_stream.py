@@ -1,0 +1,357 @@
+"""Unit tests for the streaming ingest subsystem (hdbscan_tpu/stream/):
+bubble absorption, drift detection, the background refitter, and the
+stream_ingest/drift_check/model_swap trace schemas in check_trace."""
+
+import json
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.stream import DriftDetector, IngestBuffer, Refitter
+from hdbscan_tpu.stream.buffer import BubbleSummary
+
+
+def _fake_model(data):
+    """IngestBuffer only touches ``model.data`` (and the refit pool reads it
+    again) — a namespace stands in for a ClusterModel in pure-numpy tests."""
+    return types.SimpleNamespace(data=np.asarray(data, np.float64))
+
+
+def _grid(n, d=3, scale=1.0):
+    rng = np.random.default_rng(0)
+    return rng.normal(0, scale, (n, d))
+
+
+# -- BubbleSummary ----------------------------------------------------------
+
+
+def test_bubble_summary_cf_triple():
+    b = BubbleSummary(2)
+    rows = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    b.add(rows[:2])
+    b.add(rows[2:])
+    assert b.count == 3
+    np.testing.assert_allclose(b.linear_sum, rows.sum(axis=0))
+    np.testing.assert_allclose(b.squared_sum, np.square(rows).sum(axis=0))
+    np.testing.assert_allclose(b.centroid, rows.mean(axis=0))
+    # RMS distance to the centroid, straight from the CF triple
+    want = np.sqrt(np.mean(np.sum((rows - rows.mean(axis=0)) ** 2, axis=1)))
+    assert b.radius == pytest.approx(want)
+    d = b.as_dict()
+    assert d["count"] == 3 and len(d["linear_sum"]) == 2
+
+
+def test_bubble_summary_empty():
+    b = BubbleSummary(3)
+    assert b.radius == 0.0
+    assert np.all(np.isnan(b.centroid))
+
+
+# -- IngestBuffer -----------------------------------------------------------
+
+
+def test_buffer_absorbs_exact_duplicates_regardless_of_probability():
+    train = _grid(50)
+    buf = IngestBuffer(_fake_model(train), absorb_eps_frac=0.25)
+    # training rows re-arrive with label 0 / prob 0 (noise attachments):
+    # the bitwise duplicate check must still absorb them.
+    dup = train[:10]
+    absorbed, buffered = buf.absorb(
+        dup, np.zeros(10, np.int64), np.zeros(10)
+    )
+    assert (absorbed, buffered) == (10, 0)
+    assert buf.absorbed_exact == 10 and buf.absorbed_near == 0
+    assert buf.buffered_rows == 0
+    assert buf.bubbles[0].count == 10
+
+
+def test_buffer_near_duplicate_threshold_is_eps_fraction():
+    # prob = eps_min / eps_q, so absorb(eps_q <= (1+frac)*eps_min) is
+    # exactly prob >= 1/(1+frac).
+    train = _grid(20)
+    buf = IngestBuffer(_fake_model(train), absorb_eps_frac=0.25)
+    pts = _grid(4, scale=5.0) + 100  # distinct from training rows
+    labels = np.array([3, 3, 3, 0], np.int64)
+    prob = np.array([0.81, 0.79, 1.0, 1.0])  # threshold = 1/1.25 = 0.8
+    absorbed, buffered = buf.absorb(pts, labels, prob)
+    assert (absorbed, buffered) == (2, 2)  # 0.81 and 1.0 with label>0
+    assert buf.absorbed_near == 2
+    assert buf.bubbles[3].count == 2
+    assert 0 not in buf.bubbles  # label-0 prob is never a near-dup signal
+
+
+def test_buffer_zero_frac_absorbs_only_probability_one():
+    train = _grid(20)
+    buf = IngestBuffer(_fake_model(train), absorb_eps_frac=0.0)
+    pts = _grid(3) + 50
+    absorbed, _ = buf.absorb(
+        pts, np.array([1, 1, 1], np.int64), np.array([0.999, 1.0, 0.5])
+    )
+    assert absorbed == 1
+
+
+def test_buffer_refit_pool_dedups_and_mixes_sources():
+    train = _grid(30)
+    buf = IngestBuffer(_fake_model(train), absorb_eps_frac=0.25,
+                       reservoir_size=8)
+    novel = _grid(12) + 10
+    # submit the same novel batch twice: second pass buffers them again,
+    # but the refit pool must dedup bitwise
+    for _ in range(2):
+        buf.absorb(novel, np.zeros(12, np.int64), np.zeros(12))
+    assert buf.buffered_rows == 24
+    pool = buf.refit_points(originals=5)
+    keys = {row.tobytes() for row in np.ascontiguousarray(pool)}
+    assert len(keys) == len(pool)  # no duplicates
+    train_keys = {row.tobytes() for row in np.ascontiguousarray(train)}
+    assert sum(k in train_keys for k in keys) == 5  # the originals sample
+    novel_keys = {row.tobytes() for row in np.ascontiguousarray(novel)}
+    assert novel_keys <= keys  # every novel row survives
+
+
+def test_buffer_reservoir_is_bounded():
+    train = _grid(10)
+    buf = IngestBuffer(_fake_model(train), reservoir_size=16)
+    for i in range(10):
+        pts = _grid(50) + i
+        buf.absorb(pts, np.zeros(50, np.int64), np.zeros(50))
+    assert buf.stats()["reservoir"] == 16
+    assert buf.rows_seen == 500
+
+
+def test_buffer_reset_rekeys_to_new_model():
+    old = _grid(10)
+    new = _grid(10) + 99
+    buf = IngestBuffer(_fake_model(old))
+    buf.absorb(old[:5], np.zeros(5, np.int64), np.zeros(5))
+    assert buf.absorbed_exact == 5
+    buf.reset(_fake_model(new))
+    assert buf.rows_seen == 0 and buf.buffered_rows == 0
+    # old training rows are no longer exact duplicates; new ones are
+    a, _ = buf.absorb(old[:5], np.zeros(5, np.int64), np.zeros(5))
+    assert a == 0
+    a, _ = buf.absorb(new[:5], np.zeros(5, np.int64), np.zeros(5))
+    assert a == 5
+
+
+def test_buffer_rejects_dim_mismatch():
+    buf = IngestBuffer(_fake_model(_grid(10, d=3)))
+    with pytest.raises(ValueError, match="dims"):
+        buf.absorb(np.zeros((2, 4)), np.zeros(2, np.int64), np.zeros(2))
+
+
+# -- DriftDetector ----------------------------------------------------------
+
+
+def _scores(rng, n, loc):
+    return np.clip(rng.normal(loc, 0.08, n), 0, 1)
+
+
+def test_drift_quiet_on_matching_distribution():
+    rng = np.random.default_rng(1)
+    base = _scores(rng, 2000, 0.3)
+    labels = rng.integers(1, 4, 2000)
+    det = DriftDetector(base, labels, stat="psi", threshold=2.0, min_rows=256)
+    det.update(rng.integers(1, 4, 1000), _scores(rng, 1000, 0.3))
+    out = det.check()
+    assert out["drifted"] is False
+    assert out["value"] < 0.5
+
+
+@pytest.mark.parametrize("stat", ["psi", "ks"])
+def test_drift_flags_score_shift(stat):
+    rng = np.random.default_rng(2)
+    det = DriftDetector(
+        _scores(rng, 2000, 0.2), rng.integers(1, 4, 2000),
+        stat=stat, threshold=0.5 if stat == "ks" else 2.0, min_rows=256,
+    )
+    det.update(rng.integers(1, 4, 1000), _scores(rng, 1000, 0.85))
+    out = det.check()
+    assert out["stat"] == stat
+    assert out["drifted"] is True
+
+
+def test_drift_flags_assignment_shift_with_stable_scores():
+    rng = np.random.default_rng(3)
+    base_scores = _scores(rng, 2000, 0.3)
+    det = DriftDetector(base_scores, rng.integers(1, 4, 2000),
+                        threshold=2.0, min_rows=256)
+    # same score distribution, but every row lands on an unseen label
+    det.update(np.full(1000, 99, np.int64), _scores(rng, 1000, 0.3))
+    out = det.check()
+    assert out["value"] < 0.5  # scores alone look fine
+    assert out["assign_psi"] > 2.0 and out["drifted"] is True
+
+
+def test_drift_min_rows_gate():
+    rng = np.random.default_rng(4)
+    det = DriftDetector(_scores(rng, 500, 0.2), rng.integers(1, 3, 500),
+                        threshold=0.1, min_rows=256)
+    det.update(rng.integers(1, 3, 100), _scores(rng, 100, 0.9))
+    assert det.check()["drifted"] is False  # 100 < min_rows
+    det.update(rng.integers(1, 3, 200), _scores(rng, 200, 0.9))
+    assert det.check()["drifted"] is True
+
+
+def test_drift_rebaseline_clears_stream_state():
+    rng = np.random.default_rng(5)
+    det = DriftDetector(_scores(rng, 500, 0.2), rng.integers(1, 3, 500),
+                        threshold=0.5, min_rows=10)
+    det.update(rng.integers(1, 3, 500), _scores(rng, 500, 0.9))
+    assert det.check()["drifted"] is True
+    shifted = _scores(rng, 500, 0.9)
+    det.rebaseline(shifted, rng.integers(1, 3, 500))
+    assert det.rows == 0
+    det.update(rng.integers(1, 3, 500), _scores(rng, 500, 0.9))
+    assert det.check()["drifted"] is False
+
+
+def test_drift_rejects_bad_stat_and_threshold():
+    with pytest.raises(ValueError, match="'psi'"):
+        DriftDetector([0.1], [1], stat="chi2")
+    with pytest.raises(ValueError, match="threshold"):
+        DriftDetector([0.1], [1], threshold=0.0)
+
+
+def test_drift_check_emits_trace_event():
+    from hdbscan_tpu.utils.tracing import Tracer
+
+    rng = np.random.default_rng(6)
+    tracer = Tracer()
+    det = DriftDetector(_scores(rng, 300, 0.3), rng.integers(1, 3, 300),
+                        tracer=tracer)
+    det.update(rng.integers(1, 3, 300), _scores(rng, 300, 0.3))
+    det.check(generation=7)
+    evs = [e for e in tracer.events if e.name == "drift_check"]
+    assert len(evs) == 1
+    f = evs[0].fields
+    assert f["generation"] == 7 and f["stat"] == "psi"
+    assert isinstance(f["drifted"], bool) and f["rows"] == 300
+
+
+# -- Refitter ---------------------------------------------------------------
+
+
+class _FakeResult:
+    def __init__(self, points):
+        self.points = points
+
+    def to_cluster_model(self, data, params):
+        model = types.SimpleNamespace(n_train=len(data))
+        model.save = lambda path: open(path, "w").write("artifact") or path
+        return model
+
+
+def test_refitter_publishes_in_background(tmp_path):
+    published = []
+    started = threading.Event()
+    release = threading.Event()
+
+    def fit_fn(points, params):
+        started.set()
+        assert release.wait(timeout=10)
+        return _FakeResult(points)
+
+    ref = Refitter(params=None, model_dir=str(tmp_path), fit_fn=fit_fn,
+                   on_publish=lambda p, m, r: published.append((p, m, r)))
+    assert ref.request(np.zeros((10, 2)), "drift") is True
+    assert started.wait(timeout=10)
+    assert ref.busy
+    assert ref.request(np.zeros((5, 2)), "budget") is False  # one at a time
+    release.set()
+    assert ref.join(timeout=10)
+    assert ref.refits_ok == 1 and ref.refits_failed == 0
+    (path, model, reason), = published
+    assert reason == "drift" and model.n_train == 10
+    assert path.endswith("model_gen0001.npz")
+    # idle again: a new request is accepted and numbers the next generation
+    assert ref.request(np.zeros((4, 2)), "drift") is True
+    assert ref.join(timeout=10)
+    assert published[-1][0].endswith("model_gen0002.npz")
+
+
+def test_refitter_failure_keeps_serving(tmp_path):
+    from hdbscan_tpu.utils.tracing import Tracer
+
+    tracer = Tracer()
+
+    def fit_fn(points, params):
+        raise RuntimeError("fit exploded")
+
+    ref = Refitter(params=None, model_dir=str(tmp_path), fit_fn=fit_fn,
+                   tracer=tracer, on_publish=lambda *a: pytest.fail(
+                       "failed refit must not publish"))
+    assert ref.request(np.zeros((3, 2)), "drift")
+    assert ref.join(timeout=10)
+    assert ref.refits_failed == 1 and ref.refits_ok == 0
+    assert "fit exploded" in ref.last_error
+    evs = [e for e in tracer.events if e.name == "model_refit"]
+    assert len(evs) == 1 and evs[0].fields["ok"] is False
+
+
+# -- trace schemas (scripts/check_trace.py) ---------------------------------
+
+
+def _write_trace(tmp_path, events):
+    path = tmp_path / "trace.jsonl"
+    with open(path, "w") as f:
+        for i, ev in enumerate(events):
+            rec = {"schema": "hdbscan-tpu-trace/1", "seq": i, "wall_s": 0.0}
+            rec.update(ev)
+            f.write(json.dumps(rec) + "\n")
+    return str(path)
+
+
+def _validate(path):
+    from scripts import check_trace
+
+    return check_trace.validate_trace(path)[1]
+
+
+def test_check_trace_accepts_stream_events(tmp_path):
+    path = _write_trace(tmp_path, [
+        {"stage": "stream_ingest", "rows": 10, "absorbed": 4, "buffered": 6,
+         "generation": 1},
+        {"stage": "drift_check", "stat": "psi", "value": 0.4,
+         "assign_psi": 0.1, "threshold": 2.0, "rows": 10, "drifted": False},
+        {"stage": "model_refit", "ok": True, "rows": 10},
+        {"stage": "model_swap", "generation": 2, "digest": "abc",
+         "n_train": 10, "server": "s1"},
+        {"stage": "model_swap", "generation": 3, "digest": "abc",
+         "n_train": 10, "server": "s1"},
+        {"stage": "model_swap", "generation": 2, "digest": "def",
+         "n_train": 10, "server": "s2"},  # other server: own sequence
+    ])
+    assert _validate(path) == []
+
+
+def test_check_trace_rejects_bad_ingest_accounting(tmp_path):
+    path = _write_trace(tmp_path, [
+        {"stage": "stream_ingest", "rows": 10, "absorbed": 4, "buffered": 5,
+         "generation": 1},
+    ])
+    errors = _validate(path)
+    assert len(errors) == 1 and "absorbed" in errors[0]
+
+
+def test_check_trace_rejects_nonmonotonic_swap_generation(tmp_path):
+    path = _write_trace(tmp_path, [
+        {"stage": "model_swap", "generation": 3, "digest": "a", "n_train": 5,
+         "server": "s1"},
+        {"stage": "model_swap", "generation": 3, "digest": "b", "n_train": 5,
+         "server": "s1"},
+    ])
+    errors = _validate(path)
+    assert len(errors) == 1 and "not increasing" in errors[0]
+
+
+def test_check_trace_rejects_bad_drift_check(tmp_path):
+    path = _write_trace(tmp_path, [
+        {"stage": "drift_check", "stat": "chi2", "value": -1.0,
+         "assign_psi": 0.0, "threshold": 0.0, "rows": 1, "drifted": "yes"},
+    ])
+    errors = _validate(path)
+    assert len(errors) == 4  # stat, value, threshold, drifted
