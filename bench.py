@@ -38,6 +38,14 @@ Secondary rows in the same JSON line:
   near-manifold traffic, and the blue/green swap pause p50/p99 over repeated
   hot swaps. ``--stream-synthetic`` runs ONLY this leg on synthetic blobs
   (for hosts without the Skin dataset).
+
+``bench.py slo [--quick] [--trace-out PATH] [--report PATH]`` runs the SLO
+load-harness leg alone (README "Observability"): synthetic fit → live HTTP
+server → closed-loop sustained + open-loop Poisson load via
+``benchmarks/loadgen.py`` → ``/metrics`` scraped twice and validated with
+``scripts/check_metrics.py`` → one JSON line with nearest-rank
+p50/p99/p999 latency, rows/s, the histogram-vs-raw p99 cross-check, and
+the target-vs-attainment verdict against ``SLO_TARGETS``.
 """
 
 from __future__ import annotations
@@ -115,14 +123,13 @@ def stream_leg(model, params, query_sampler, tracer, swaps=8, chunks=20,
     return fields
 
 
-def _stream_synthetic() -> None:
-    """The stream leg alone, on synthetic blobs — for containers without
-    the Skin dataset (BENCH_r07 precedent). Prints one JSON line."""
+def _synthetic_model():
+    """Shared 5k 3-blob fixture for the synthetic serving legs
+    (``--stream-synthetic`` and ``slo``): fit a model and build the
+    near-manifold query sampler. Returns
+    ``(data, model, params, sampler, fit_wall, n)``."""
     from hdbscan_tpu.config import HDBSCANParams
     from hdbscan_tpu.models import hdbscan
-    from hdbscan_tpu.utils.tracing import Tracer
-
-    import jax
 
     rng = np.random.default_rng(0)
     centers = np.asarray([(0.0, 0.0, 0.0), (6.0, 6.0, 6.0), (0.0, 8.0, 0.0)])
@@ -141,6 +148,17 @@ def _stream_synthetic() -> None:
         jitter[:: 4] = 0.0  # every 4th row is a bitwise training duplicate
         return q + jitter
 
+    return data, model, params, sampler, fit_wall, n
+
+
+def _stream_synthetic() -> None:
+    """The stream leg alone, on synthetic blobs — for containers without
+    the Skin dataset (BENCH_r07 precedent). Prints one JSON line."""
+    from hdbscan_tpu.utils.tracing import Tracer
+
+    import jax
+
+    _, model, params, sampler, fit_wall, n = _synthetic_model()
     tracer = Tracer()
     fields = stream_leg(model, params, sampler, tracer)
     print(
@@ -159,6 +177,149 @@ def _stream_synthetic() -> None:
     )
 
 
+#: SLO targets for the ``slo`` leg — conservative round numbers chosen
+#: ~10-25x above a measured healthy CPU-smoke run (p50 9 ms / p99 18 ms /
+#: ~7k rows/s on the 5k synthetic model; a TPU host only gets faster), so
+#: a miss means the serving path regressed by an order of magnitude, not
+#: that the host was busy.
+SLO_TARGETS = {
+    "p50_s": {"max": 0.1},
+    "p99_s": {"max": 0.5},
+    "rows_per_s": {"min": 500.0},
+    "error_rate": {"max": 0.0},
+}
+
+
+def _slo(argv: list[str]) -> None:
+    """The SLO load-harness leg (README "Observability"): synthetic fit →
+    live HTTP server → closed-loop sustained load + open-loop Poisson
+    secondary → /metrics scraped twice and validated → one JSON line with
+    nearest-rank p50/p99/p999, rows/s, the histogram-vs-raw p99
+    cross-check, and the target-vs-attainment SLO verdict.
+
+    ``bench.py slo [--quick] [--trace-out PATH] [--report PATH]``
+    """
+    import urllib.request
+
+    import jax
+
+    from benchmarks import loadgen
+    from hdbscan_tpu.cli import _pop_path_flag
+    from hdbscan_tpu.serve.server import ClusterServer
+    from hdbscan_tpu.utils import telemetry
+    from hdbscan_tpu.utils.tracing import JsonlSink, Tracer
+    from scripts import check_metrics
+
+    argv_full = ["slo", *argv]
+    trace_out = _pop_path_flag(argv, "--trace-out")
+    report_out = _pop_path_flag(argv, "--report")
+    duration, warmup = 8.0, 1.0
+    if "--quick" in argv:
+        argv.remove("--quick")
+        duration, warmup = 2.0, 0.5
+    if argv:
+        raise SystemExit(f"bench.py slo: unknown arguments {argv!r}")
+
+    _, model, _, sampler, fit_wall, n = _synthetic_model()
+    sinks = [JsonlSink(trace_out, static={"process": 0})] if trace_out else []
+    tracer = Tracer(sinks=sinks)
+    srv = ClusterServer(model, max_batch=256, port=0, tracer=tracer).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        submit = loadgen.http_predict_submitter(base, sampler)
+        # Closed loop: 4 workers back-to-back at the mixed batch sizes —
+        # the server at its natural saturation for that concurrency.
+        closed = loadgen.run_load(
+            submit, mode="closed", concurrency=4,
+            batch_mix=loadgen.DEFAULT_MIX, duration_s=duration,
+            warmup_s=warmup,
+        )
+        with urllib.request.urlopen(base + "/metrics") as resp:
+            scrape1 = resp.read().decode()
+        # Open loop at half the closed-loop arrival rate: Poisson arrivals
+        # with latency charged from the scheduled arrival time, so the
+        # secondary row is coordinated-omission-aware.
+        rate = max(10.0, 0.5 * closed.requests / max(closed.wall_s, 1e-9))
+        opened = loadgen.run_load(
+            submit, mode="open", concurrency=4, rate_rps=rate,
+            duration_s=duration / 2, warmup_s=warmup / 2,
+        )
+        with urllib.request.urlopen(base + "/metrics") as resp:
+            scrape2 = resp.read().decode()
+    finally:
+        srv.close()
+    tracer.close()
+
+    parsed1, errs1 = check_metrics.validate_exposition(scrape1, "scrape1")
+    parsed2, errs2 = check_metrics.validate_exposition(scrape2, "scrape2")
+    merrs = errs1 + errs2 + check_metrics.check_monotonic(parsed1, parsed2)
+    for err in merrs:
+        print(f"[bench] slo metrics FAIL: {err}", file=sys.stderr)
+
+    pct = closed.percentiles()
+    observed = {
+        "p50_s": pct["p50_s"],
+        "p99_s": pct["p99_s"],
+        "rows_per_s": closed.rows_per_s(),
+        "error_rate": closed.errors / max(closed.errors + closed.requests, 1),
+    }
+    verdict = telemetry.slo_verdict(observed, SLO_TARGETS)
+    open_pct = opened.percentiles()
+    print(
+        f"[bench] slo closed: {closed.requests} reqs "
+        f"p50={pct['p50_s'] * 1e3:.2f}ms p99={pct['p99_s'] * 1e3:.2f}ms "
+        f"p999={pct['p999_s'] * 1e3:.2f}ms rows/s={closed.rows_per_s()} "
+        f"errors={closed.errors}; open@{rate:.0f}rps: {opened.requests} reqs "
+        f"p99={(open_pct['p99_s'] or 0) * 1e3:.2f}ms; "
+        f"slo_ok={verdict['ok']} metrics_errors={len(merrs)}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "serve_slo_p99_ms_synthetic_5k",
+                "value": round(pct["p99_s"] * 1e3, 3),
+                "unit": "ms",
+                "n_train": n,
+                "fit_wall_s": round(fit_wall, 3),
+                "slo_mode": "closed",
+                "slo_duration_s": duration,
+                "slo_concurrency": 4,
+                "slo_batch_mix": [list(kv) for kv in loadgen.DEFAULT_MIX],
+                "slo_requests": closed.requests,
+                "slo_errors": closed.errors,
+                "slo_rows_per_s": closed.rows_per_s(),
+                "slo_p50_ms": round(pct["p50_s"] * 1e3, 3),
+                "slo_p999_ms": round(pct["p999_s"] * 1e3, 3),
+                "slo_hist_p99_ms": round(pct["p99_hist_s"] * 1e3, 3),
+                "slo_hist_p99_consistent": closed.quantiles_consistent(0.99),
+                "open_rate_rps": round(rate, 1),
+                "open_requests": opened.requests,
+                "open_p50_ms": round((open_pct["p50_s"] or 0) * 1e3, 3),
+                "open_p99_ms": round((open_pct["p99_s"] or 0) * 1e3, 3),
+                "metrics_scrape_errors": len(merrs),
+                "slo_ok": verdict["ok"],
+                "slo_targets": verdict["targets"],
+                "platform": jax.devices()[0].platform,
+                "cpu_smoke": jax.devices()[0].platform != "tpu",
+            }
+        )
+    )
+
+    if report_out is not None:
+        telemetry.write_report(
+            report_out,
+            telemetry.build_report(
+                tracer,
+                manifest=telemetry.run_manifest(
+                    None,
+                    argv=argv_full,
+                    extra={"entrypoint": "bench.py slo", "n_train": n},
+                ),
+            ),
+        )
+
+
 def main(argv: list[str] | None = None) -> None:
     import jax
 
@@ -171,6 +332,9 @@ def main(argv: list[str] | None = None) -> None:
 
     argv = list(sys.argv[1:] if argv is None else argv)
     argv_full = list(argv)
+    if argv and argv[0] == "slo":
+        _slo(argv[1:])
+        return
     if "--stream-synthetic" in argv:
         argv.remove("--stream-synthetic")
         if argv:

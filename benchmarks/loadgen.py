@@ -1,0 +1,359 @@
+"""Open/closed-loop load generator for the serving path (stdlib-only core).
+
+Drives a ``submit(k) -> rows`` callable — typically
+:func:`http_predict_submitter` posting mixed-size batches to a running
+``ClusterServer`` — under one of two arrival disciplines:
+
+``closed``
+    N workers issue requests back-to-back: a worker's next request starts
+    the moment its previous response lands. Measures the server at its
+    natural saturation for that concurrency; latency is response time.
+``open``
+    Requests arrive on a Poisson process at ``rate_rps`` regardless of how
+    fast responses come back, dispatched onto a bounded worker pool.
+    Latency is measured from the *scheduled arrival time*, not dispatch —
+    so queueing delay caused by a slow server counts against it
+    (coordinated-omission-aware, the classic closed-loop blind spot).
+
+Warmup exclusion: samples taken during the first ``warmup_s`` seconds (or
+the first ``warmup_requests`` requests, whichever bound is given) are
+issued but not recorded, so JIT compilation and connection setup never
+pollute the percentiles.
+
+Every recorded latency lands both in a raw list and in a
+``utils.metrics.Histogram`` with the serving latency buckets; the result
+exposes nearest-rank p50/p99/p999 computed BOTH ways plus
+:func:`hist_quantile_close`, which asserts the histogram-derived quantile
+sits within one bucket width of the raw one — the accuracy contract the
+``bench.py slo`` leg and the tier-1 e2e pin.
+
+A tiny CLI is included for ad-hoc runs against a live server::
+
+    python -m benchmarks.loadgen http://127.0.0.1:8787 --mode closed \
+        --duration 5 --concurrency 4 --mix 1:0.5,16:0.3,64:0.2
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import math
+import random
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+from hdbscan_tpu.utils.metrics import (
+    DEFAULT_LATENCY_BUCKETS,
+    Histogram,
+    MetricsRegistry,
+)
+
+__all__ = [
+    "LoadResult",
+    "run_load",
+    "http_predict_submitter",
+    "nearest_rank",
+    "bucket_width_at",
+    "hist_quantile_close",
+]
+
+#: Default mixed-batch workload: mostly singletons, some medium, some large
+#: — exercises several pow2 buckets and the batcher's coalescing window.
+DEFAULT_MIX = ((1, 0.5), (16, 0.3), (64, 0.2))
+
+
+def nearest_rank(sorted_vals, q: float):
+    """Nearest-rank quantile over an already-sorted list (None if empty).
+
+    Same formula as ``utils.telemetry.latency_percentiles`` and
+    ``utils.metrics.Histogram.quantile``: index ``ceil(q*n) - 1``.
+    """
+    n = len(sorted_vals)
+    if n == 0:
+        return None
+    return sorted_vals[min(n - 1, max(0, math.ceil(q * n) - 1))]
+
+
+def bucket_width_at(edges, value: float) -> float:
+    """Width of the histogram bucket that ``value`` falls into.
+
+    The first bucket spans ``(0, edges[0]]``; values beyond the last edge
+    land in the +Inf bucket, whose width is infinite (the cross-check is
+    vacuous there — the histogram can only answer "bigger than the last
+    edge").
+    """
+    i = bisect.bisect_left(edges, value)
+    if i >= len(edges):
+        return math.inf
+    return edges[i] - (edges[i - 1] if i > 0 else 0.0)
+
+
+def hist_quantile_close(hist: Histogram, raw_sorted, q: float) -> bool:
+    """True when the histogram-derived quantile is within one bucket width
+    of the raw nearest-rank quantile (the loadgen accuracy contract)."""
+    raw_q = nearest_rank(raw_sorted, q)
+    hist_q = hist.quantile(q)
+    if raw_q is None or hist_q is None:
+        return raw_q is None and hist_q is None
+    return abs(hist_q - raw_q) <= bucket_width_at(hist.buckets, raw_q)
+
+
+@dataclass
+class LoadResult:
+    """Outcome of one :func:`run_load` run (post-warmup samples only)."""
+
+    mode: str
+    latencies: list = field(default_factory=list)  # seconds, arrival order
+    hist: Histogram | None = None
+    requests: int = 0  # recorded (post-warmup) requests
+    warmup_requests: int = 0  # issued but excluded
+    rows: int = 0  # rows across recorded requests
+    errors: int = 0
+    wall_s: float = 0.0  # measurement window (warmup excluded)
+
+    def percentiles(self) -> dict:
+        """Raw nearest-rank and histogram-derived p50/p99/p999 + mean/max."""
+        walls = sorted(self.latencies)
+        n = len(walls)
+        out = {
+            "count": n,
+            "mean_s": round(sum(walls) / n, 6) if n else None,
+            "max_s": round(walls[-1], 6) if n else None,
+        }
+        for q, key in ((0.50, "p50"), (0.99, "p99"), (0.999, "p999")):
+            raw = nearest_rank(walls, q)
+            out[f"{key}_s"] = round(raw, 6) if raw is not None else None
+            hq = self.hist.quantile(q) if self.hist is not None else None
+            out[f"{key}_hist_s"] = round(hq, 6) if hq is not None else None
+        return out
+
+    def rows_per_s(self) -> float:
+        return round(self.rows / self.wall_s, 3) if self.wall_s > 0 else 0.0
+
+    def quantiles_consistent(self, q: float = 0.99) -> bool:
+        """The one-bucket-width accuracy contract at quantile ``q``."""
+        if self.hist is None:
+            return False
+        return hist_quantile_close(self.hist, sorted(self.latencies), q)
+
+
+def _pick_sizes(batch_mix, seed: int):
+    """Deterministic weighted batch-size chooser (one RNG, lock-guarded)."""
+    sizes = [int(s) for s, _ in batch_mix]
+    weights = [float(w) for _, w in batch_mix]
+    if not sizes or any(s < 1 for s in sizes) or any(w <= 0 for w in weights):
+        raise ValueError(f"bad batch_mix {batch_mix!r}")
+    rng = random.Random(seed)
+    lock = threading.Lock()
+
+    def pick() -> int:
+        with lock:
+            return rng.choices(sizes, weights=weights, k=1)[0]
+
+    return pick
+
+
+def run_load(
+    submit,
+    *,
+    mode: str = "closed",
+    concurrency: int = 4,
+    batch_mix=DEFAULT_MIX,
+    duration_s: float | None = None,
+    requests: int | None = None,
+    warmup_s: float = 0.0,
+    warmup_requests: int = 0,
+    rate_rps: float | None = None,
+    seed: int = 0,
+) -> LoadResult:
+    """Drive ``submit(batch_size) -> rows`` under load and collect latency.
+
+    Exactly one of ``duration_s`` / ``requests`` bounds the measured
+    window (both given = both respected, first hit wins). ``open`` mode
+    additionally requires ``rate_rps``. Raises on submit() exceptions
+    being swallowed — errors are counted, never recorded as latencies.
+    """
+    if mode not in ("closed", "open"):
+        raise ValueError(f"mode must be 'closed' or 'open', got {mode!r}")
+    if duration_s is None and requests is None:
+        raise ValueError("one of duration_s / requests is required")
+    if mode == "open" and not rate_rps:
+        raise ValueError("open mode requires rate_rps")
+    if concurrency < 1:
+        raise ValueError(f"concurrency must be >= 1, got {concurrency!r}")
+
+    pick = _pick_sizes(batch_mix, seed)
+    hist = MetricsRegistry().histogram(
+        "loadgen_latency_seconds",
+        "Request latency observed by the load generator.",
+        buckets=DEFAULT_LATENCY_BUCKETS,
+    )
+    result = LoadResult(mode=mode, hist=hist)
+    lock = threading.Lock()
+    issued = [0]  # total requests issued (warmup included)
+    t_start = time.perf_counter()
+    warmup_until = t_start + float(warmup_s)
+    deadline = (
+        None if duration_s is None else warmup_until + float(duration_s)
+    )
+
+    def budget_take() -> bool:
+        """Claim one request slot; False once every bound is exhausted."""
+        now = time.perf_counter()
+        if deadline is not None and now >= deadline:
+            return False
+        with lock:
+            if requests is not None and issued[0] >= warmup_requests + requests:
+                return False
+            issued[0] += 1
+        return True
+
+    def record(t_sched: float, t_done: float, rows, err: bool) -> None:
+        in_warmup = t_sched < warmup_until
+        with lock:
+            if in_warmup:
+                result.warmup_requests += 1
+                return
+            if not in_warmup and warmup_requests:
+                # request-count warmup: first warmup_requests recorded
+                # arrivals are excluded even without a time window
+                if result.warmup_requests < warmup_requests:
+                    result.warmup_requests += 1
+                    return
+            if err:
+                result.errors += 1
+                return
+            lat = t_done - t_sched
+            result.latencies.append(lat)
+            result.requests += 1
+            result.rows += int(rows)
+        hist.observe(lat)  # Histogram has its own lock
+
+    def one_request(t_sched: float) -> None:
+        size = pick()
+        try:
+            rows = submit(size)
+            err = False
+        except Exception:
+            rows, err = 0, True
+        record(t_sched, time.perf_counter(), rows, err)
+
+    if mode == "closed":
+
+        def worker() -> None:
+            while budget_take():
+                one_request(time.perf_counter())
+
+        threads = [
+            threading.Thread(target=worker, name=f"loadgen-{i}", daemon=True)
+            for i in range(concurrency)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    else:
+        # Open loop: Poisson arrivals at rate_rps; latency runs from the
+        # SCHEDULED arrival, so server-induced queueing delay is charged to
+        # the server even when the dispatch pool briefly backs up.
+        arrival_rng = random.Random(seed + 1)
+        with ThreadPoolExecutor(max_workers=concurrency) as pool:
+            next_at = time.perf_counter()
+            futures = []
+            while True:
+                now = time.perf_counter()
+                if next_at > now:
+                    time.sleep(next_at - now)
+                if not budget_take():
+                    break
+                futures.append(pool.submit(one_request, next_at))
+                next_at += arrival_rng.expovariate(float(rate_rps))
+            for f in futures:
+                f.result()
+
+    t_end = time.perf_counter()
+    result.wall_s = round(t_end - max(t_start, min(warmup_until, t_end)), 6)
+    return result
+
+
+def http_predict_submitter(base_url: str, sampler, timeout: float = 30.0):
+    """Build a ``submit(k) -> rows`` posting ``{"points": sampler(k)}`` to
+    ``POST /predict``. ``sampler(k)`` returns a (k, dim) array-like."""
+    url = base_url.rstrip("/") + "/predict"
+
+    def submit(k: int) -> int:
+        points = sampler(k)
+        body = json.dumps(
+            {"points": [list(map(float, row)) for row in points]}
+        ).encode()
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"}
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            out = json.loads(resp.read())
+        return len(out["labels"])
+
+    return submit
+
+
+def _parse_mix(text: str):
+    return tuple(
+        (int(part.split(":")[0]), float(part.split(":")[1]))
+        for part in text.split(",")
+    )
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    import numpy as np
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("base_url")
+    ap.add_argument("--mode", choices=("closed", "open"), default="closed")
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--duration", type=float, default=5.0)
+    ap.add_argument("--warmup", type=float, default=1.0)
+    ap.add_argument("--rate", type=float, default=50.0, help="open-loop rps")
+    ap.add_argument("--mix", type=_parse_mix, default=DEFAULT_MIX)
+    ap.add_argument("--dim", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    rng = np.random.default_rng(args.seed)
+
+    def sampler(k):
+        return rng.normal(0.0, 3.0, size=(k, args.dim))
+
+    result = run_load(
+        http_predict_submitter(args.base_url, sampler),
+        mode=args.mode,
+        concurrency=args.concurrency,
+        batch_mix=args.mix,
+        duration_s=args.duration,
+        warmup_s=args.warmup,
+        rate_rps=args.rate if args.mode == "open" else None,
+        seed=args.seed,
+    )
+    print(
+        json.dumps(
+            {
+                "mode": result.mode,
+                "requests": result.requests,
+                "errors": result.errors,
+                "rows_per_s": result.rows_per_s(),
+                "wall_s": result.wall_s,
+                "latency": result.percentiles(),
+                "hist_p99_consistent": result.quantiles_consistent(0.99),
+            },
+            indent=2,
+        )
+    )
+    return 1 if result.errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -71,17 +71,26 @@ invocation still means ``fit`` (the reference-compatible form above)::
         [predict_backend=...] [predict_batch=N] [--trace-out PATH] \
         [--report PATH] [--ingest] [--model-dir DIR] \
         [absorb_eps=F] [drift_stat={psi,ks}] [drift_threshold=F] \
-        [refit_budget=N] [stream_reload={auto,manual}]
+        [refit_budget=N] [stream_reload={auto,manual}] [trace_max_events=N]
 
 ``fit --model-out`` persists the fitted clustering as one atomic
 schema-versioned ``.npz`` (``serve/artifact.ClusterModel``); ``predict``
 classifies new points against it (labels, membership probabilities, GLOSH
 outlier scores — ``serve/predict.approximate_predict``); ``serve`` starts a
-stdlib HTTP server (``POST /predict``, ``GET /healthz``) with micro-batched
-dispatch. Both serving commands AOT-warm every power-of-two batch bucket so
-steady state recompiles nothing, emit per-batch ``predict_batch`` trace
-events, and report p50/p95/p99 latency in the run report
-(``predict_latency``).
+stdlib HTTP server (``POST /predict``, ``GET /healthz``, ``GET /metrics``)
+with micro-batched dispatch. Both serving commands AOT-warm every
+power-of-two batch bucket so steady state recompiles nothing, emit
+per-batch ``predict_batch`` trace events, and report p50/p95/p99/p999
+latency in the run report (``predict_latency``). The server additionally
+exposes a Prometheus text exposition at ``GET /metrics``
+(``utils/metrics.py``; validate with ``scripts/check_metrics.py``) and,
+when tracing, emits one ``request_span`` event per successful
+``/predict``/``/ingest`` request decomposing its wall into parse /
+queue-wait / batch-assembly / device-predict / respond segments
+(``request_spans`` report section; ``scripts/check_trace.py`` validates
+the schema). ``trace_max_events=N`` bounds the tracer's in-memory event
+list for long-running serves (0 = unbounded; the JSONL trace file always
+gets every event).
 
 ``serve --ingest`` (README "Streaming") additionally opens ``POST /ingest``:
 arriving points route through the predict path, duplicates/near-duplicates
@@ -250,6 +259,7 @@ def _main_fit(argv: list[str]) -> int:
         stream=sys.stderr if os.environ.get("HDBSCAN_TPU_TRACE") else None,
         sinks=sinks,
         counters=counters,
+        max_events=params.trace_max_events,
     )
     mem_start = None
     if report_out is not None:
@@ -379,11 +389,16 @@ def _main_fit(argv: list[str]) -> int:
     return 0
 
 
-def _serving_tracer(trace_out: str | None, report_out: str | None):
+def _serving_tracer(
+    trace_out: str | None, report_out: str | None, max_events: int | None = None
+):
     """Telemetry wiring for the single-process serving commands — same
     sinks/counters contract as the fit driver (predict_batch events carry
     per-phase jit_compiles deltas, so the zero-steady-state-recompile claim
-    is checkable from the trace alone)."""
+    is checkable from the trace alone). ``max_events``
+    (``params.trace_max_events``) bounds the in-memory event list so a
+    long-running serve process cannot grow without limit — the JSONL sink
+    still streams every event to disk."""
     import os
 
     from hdbscan_tpu.utils.tracing import JsonlSink, Tracer
@@ -403,6 +418,7 @@ def _serving_tracer(trace_out: str | None, report_out: str | None):
         stream=sys.stderr if os.environ.get("HDBSCAN_TPU_TRACE") else None,
         sinks=sinks,
         counters=counters,
+        max_events=max_events,
     )
 
 
@@ -442,7 +458,7 @@ def _main_predict(argv: list[str], argv_full: list[str]) -> int:
     from hdbscan_tpu.serve.predict import Predictor
     from hdbscan_tpu.utils.io import load_points
 
-    tracer = _serving_tracer(trace_out, report_out)
+    tracer = _serving_tracer(trace_out, report_out, params.trace_max_events)
     try:
         t0 = time.monotonic()
         try:
@@ -519,7 +535,7 @@ def _main_serve(argv: list[str], argv_full: list[str]) -> int:
     from hdbscan_tpu.serve.artifact import ClusterModel
     from hdbscan_tpu.serve.server import ClusterServer
 
-    tracer = _serving_tracer(trace_out, report_out)
+    tracer = _serving_tracer(trace_out, report_out, params.trace_max_events)
     try:
         try:
             model = ClusterModel.load(model_path)

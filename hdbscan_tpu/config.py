@@ -292,6 +292,13 @@ class HDBSCANParams:
     #: What happens when a re-fit publishes an artifact: "auto" hot-swaps it
     #: in (blue/green), "manual" stages it for an operator ``POST /swap``.
     stream_reload: str = "auto"
+    #: Bound on the Tracer's in-memory event list (0 = unbounded). Sinks
+    #: (the on-disk JSONL trace) always see every event; the bound only
+    #: rings the in-memory view so a long-running ``serve --ingest``
+    #: process — one predict_batch + stream_ingest + request_span per
+    #: request, forever — cannot grow without limit. Dropped events are
+    #: counted (``Tracer.events_dropped``) and noted in the summary.
+    trace_max_events: int = 100_000
     # Output file names derived from the input path (main/Main.java:516-526):
 
     def __post_init__(self):
@@ -391,6 +398,11 @@ class HDBSCANParams:
                 "stream_reload must be 'auto' or 'manual', "
                 f"got {self.stream_reload!r}"
             )
+        if self.trace_max_events < 0:
+            raise ValueError(
+                "trace_max_events must be >= 0 (0 = unbounded), "
+                f"got {self.trace_max_events!r}"
+            )
         if self.boundary_quality > 0 and self.dedup_points:
             raise ValueError(
                 "boundary_quality and dedup_points are mutually exclusive "
@@ -484,6 +496,7 @@ FLAG_FIELDS = {
     "drift_threshold": ("stream_drift_threshold", float),
     "refit_budget": ("stream_refit_budget", int),
     "stream_reload": ("stream_reload", str),
+    "trace_max_events": ("trace_max_events", int),
     "max_samples": ("max_samples", int),
     "compat_cf": ("compat_cf_int_math", _bool),
 }

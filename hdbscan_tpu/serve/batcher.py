@@ -53,10 +53,16 @@ class MicroBatcher:
 
     # -- client side -------------------------------------------------------
 
-    def submit(self, X) -> Future:
+    def submit(self, X, meta: dict | None = None) -> Future:
         """Enqueue one request; the Future resolves to this request's
         ``(labels, probabilities, outlier_scores)`` slice of the coalesced
-        dispatch."""
+        dispatch.
+
+        ``meta``, when given, is filled by the worker before the Future
+        resolves (the resolution is the happens-before edge) with the span
+        attribution the server's ``request_span`` event needs: perf_counter
+        marks ``t_assembled``/``t_dispatch``/``t_done``, the dispatched
+        ``bucket``, the ``coalesced`` peer count, and ``batch_rows``."""
         X = np.asarray(X, np.float64)
         if X.ndim == 1:
             X = X[None, :]
@@ -68,12 +74,12 @@ class MicroBatcher:
         with self._close_lock:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
-            self._q.put((X, fut))
+            self._q.put((X, fut, meta))
         return fut
 
-    def predict(self, X):
+    def predict(self, X, meta: dict | None = None):
         """Blocking convenience wrapper around :meth:`submit`."""
-        return self.submit(X).result()
+        return self.submit(X, meta).result()
 
     @property
     def stats(self) -> dict:
@@ -105,22 +111,41 @@ class MicroBatcher:
         return batch, False
 
     def _dispatch(self, batch) -> None:
-        xs = [x for x, _ in batch]
-        futs = [f for _, f in batch]
+        t_assembled = time.perf_counter()  # linger window closed; batch fixed
+        xs = [x for x, _, _ in batch]
+        futs = [f for _, f, _ in batch]
         try:
             x_all = np.concatenate(xs)
         except ValueError as e:  # mixed dims inside one window
             for f in futs:
                 f.set_exception(ValueError(f"incompatible request shapes: {e}"))
             return
+        t_dispatch = time.perf_counter()
         try:
             labels, prob, score = self.predictor.predict(x_all)
         except Exception as e:  # noqa: BLE001 - fan the failure out
             for f in futs:
                 f.set_exception(e)
             return
+        t_done = time.perf_counter()
         self._batches += 1
         self._rows += len(x_all)
+        bucket = self.predictor.bucket_for(
+            min(len(x_all), self.predictor.max_bucket)
+        )
+        # Fill every caller's meta BEFORE resolving any future: the waiting
+        # handler thread reads its meta only after .result() returns, so
+        # resolution order is the publication barrier.
+        for _, _, m in batch:
+            if m is not None:
+                m.update(
+                    t_assembled=t_assembled,
+                    t_dispatch=t_dispatch,
+                    t_done=t_done,
+                    bucket=bucket,
+                    coalesced=len(batch),
+                    batch_rows=len(x_all),
+                )
         a = 0
         for x, f in zip(xs, futs):
             b = a + len(x)

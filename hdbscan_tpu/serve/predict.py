@@ -292,14 +292,31 @@ class Predictor:
       dtype: device scan dtype (f32 default, matching the fit scans).
       tracer: optional ``utils/tracing.Tracer`` — every dispatched bucket
         emits a ``predict_batch`` event (bucket, rows, batch_seq, wall_s).
+      metrics: optional ``utils/metrics.MetricsRegistry`` — every dispatched
+        bucket observes the batch-size and device-wall histograms served by
+        ``GET /metrics`` (warmup dispatches are excluded: they go through
+        ``_dispatch`` directly, not this path).
     """
 
     def __init__(
         self, model, backend: str = "auto", max_batch: int = 256,
-        dtype=np.float32, tracer=None,
+        dtype=np.float32, tracer=None, metrics=None,
     ):
         self.model = model
         self.tracer = tracer
+        self._m_batch_rows = self._m_device_s = None
+        if metrics is not None:
+            from hdbscan_tpu.utils.metrics import DEFAULT_SIZE_BUCKETS
+
+            self._m_batch_rows = metrics.histogram(
+                "hdbscan_tpu_predict_batch_rows",
+                "Rows per dispatched device batch (post-coalescing).",
+                buckets=DEFAULT_SIZE_BUCKETS,
+            )
+            self._m_device_s = metrics.histogram(
+                "hdbscan_tpu_predict_device_seconds",
+                "Device wall per dispatched batch (H2D + compute + D2H).",
+            )
         self.dtype = dtype
         self.backend, self._interpret = _resolve_backend(backend, model, dtype)
         n = model.n_train
@@ -488,6 +505,9 @@ class Predictor:
                     wall_s=round(wall, 6),
                 )
             self._batch_seq += 1
+            if self._m_batch_rows is not None:
+                self._m_batch_rows.observe(b)
+                self._m_device_s.observe(wall)
             outs.append(tuple(np.asarray(f)[:b] for f in fetched))
         label = np.concatenate([o[0] for o in outs]).astype(np.int64)
         prob = np.concatenate([o[1] for o in outs]).astype(np.float64)

@@ -15,7 +15,24 @@ Endpoints:
 - ``POST /swap`` — apply a staged re-fit artifact (``stream_reload=manual``)
   or an explicit ``{"path": ...}`` artifact: the blue/green hot swap.
 - ``GET /healthz`` — model summary, backend, warmed buckets, batcher
-  coalescing stats, stream/swap state, uptime.
+  coalescing stats, stream/swap state, uptime, per-route request/error
+  counts and the current in-flight count (snapshotted from the metrics
+  registry).
+- ``GET /metrics`` — Prometheus text exposition (``utils/metrics.py``):
+  request totals by route/status, in-flight gauge, request-latency and
+  batch-size histograms, swap/refit/drift counters, ingest absorb
+  counters. ``scripts/check_metrics.py`` validates the output.
+
+Per-request spans: every successful ``/predict``/``/ingest`` request gets
+a process-unique request id (echoed as ``X-Request-Id``) and, when a
+tracer is attached, a ``request_span`` trace event decomposing its wall
+into parse / queue-wait / batch-assembly / device-predict / respond
+segments, with rows, pow2 bucket, coalesced-peer count and model
+generation attributed. The segment timestamps are contiguous
+``perf_counter`` marks threaded through the batcher via a per-request
+``meta`` dict (filled by the worker before the Future resolves), so the
+five segments telescope exactly to the span wall —
+``scripts/check_trace.py`` enforces the sum within 1e-6.
 
 Blue/green serving: every model lives in an immutable ``_ModelHandle``
 (model + warmed predictor + its own MicroBatcher + generation number).
@@ -37,6 +54,7 @@ calls). ``SIGTERM``/``close()`` drains in-flight work before exiting.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import signal
@@ -49,6 +67,7 @@ import numpy as np
 from hdbscan_tpu.serve.artifact import _FINGERPRINT_FIELDS, ClusterModel
 from hdbscan_tpu.serve.batcher import MicroBatcher
 from hdbscan_tpu.serve.predict import Predictor
+from hdbscan_tpu.utils.metrics import MetricsRegistry
 
 #: Refuse request bodies above this size (64 MiB ~ a 1M x 8-dim f64 batch);
 #: a streaming client should chunk instead of shipping one giant body.
@@ -57,6 +76,11 @@ MAX_BODY_BYTES = 64 << 20
 #: Bounded retries for the swap race: a request that pinned a handle whose
 #: batcher closed before its submit landed just re-pins the current handle.
 _PIN_RETRIES = 8
+
+#: Process-wide request-id sequence: ids stay unique even when several
+#: servers share one process and one trace file (check_trace enforces
+#: per-process request_span id uniqueness).
+_REQUEST_IDS = itertools.count(1)
 
 
 class _ModelHandle:
@@ -88,19 +112,48 @@ class _Handler(BaseHTTPRequestHandler):
         if getattr(self.server, "verbose", False):
             super().log_message(fmt, *args)
 
-    def _json(self, code: int, obj: dict) -> None:
+    def _json(self, code: int, obj: dict, headers: dict | None = None) -> None:
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _text(self, code: int, text: str) -> None:
+        body = text.encode("utf-8")
+        self.send_response(code)
+        self.send_header(
+            "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+        )
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
 
     def do_GET(self):  # noqa: N802 - http.server API
-        if self.path.split("?")[0] != "/healthz":
-            self._json(404, {"error": f"unknown path {self.path!r}"})
-            return
-        self._json(200, self.server.cluster_server.health())
+        route = self.path.split("?")[0]
+        srv = self.server.cluster_server
+        known = route in ("/healthz", "/metrics")
+        t0 = time.perf_counter()
+        srv._m_in_flight.inc()
+        code = 500
+        try:
+            if route == "/healthz":
+                code = 200
+                self._json(code, srv.health())
+            elif route == "/metrics":
+                code = 200
+                self._text(code, srv.render_metrics())
+            else:
+                code = 404
+                self._json(code, {"error": f"unknown path {self.path!r}"})
+        finally:
+            srv._m_in_flight.dec()
+            srv._observe_request(
+                route if known else "other", code, time.perf_counter() - t0
+            )
 
     def _read_payload(self) -> dict:
         length = int(self.headers.get("Content-Length", 0))
@@ -111,36 +164,69 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):  # noqa: N802 - http.server API
         path = self.path.split("?")[0]
         srv = self.server.cluster_server
+        known = path in ("/predict", "/ingest", "/swap")
+        t0 = time.perf_counter()
+        srv._m_in_flight.inc()
+        code = 500
+        span = None
         try:
-            payload = self._read_payload()
-        except (ValueError, TypeError, json.JSONDecodeError) as e:
-            self._json(400, {"error": f"bad request: {e}"})
-            return
-        try:
-            if path == "/predict":
-                points = np.asarray(payload["points"], np.float64)
-                out = srv.predict(points, bool(payload.get("membership", False)))
-            elif path == "/ingest":
-                points = np.asarray(payload["points"], np.float64)
-                out = srv.ingest(points)
-            elif path == "/swap":
-                out = srv.swap(payload.get("path"))
-            else:
-                self._json(404, {"error": f"unknown path {self.path!r}"})
+            try:
+                payload = self._read_payload()
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                code = 400
+                self._json(code, {"error": f"bad request: {e}"})
                 return
-        except KeyError as e:
-            self._json(400, {"error": f"bad request: missing {e}"})
-            return
-        except ValueError as e:  # shape/dim/guard mismatches are client errors
-            self._json(400, {"error": str(e)})
-            return
-        except RuntimeError as e:  # mode errors (ingest off, nothing staged)
-            self._json(409, {"error": str(e)})
-            return
-        except Exception as e:  # noqa: BLE001 - surface, don't crash the server
-            self._json(500, {"error": f"{type(e).__name__}: {e}"})
-            return
-        self._json(200, out)
+            # meta is filled across threads (batcher worker) with the span
+            # timestamps; the Future resolution inside predict/ingest is the
+            # happens-before edge that publishes it back to this thread.
+            meta: dict = {}
+            rid = srv.next_request_id()
+            try:
+                if path == "/predict":
+                    points = np.asarray(payload["points"], np.float64)
+                    meta["t_parse"] = time.perf_counter()
+                    out = srv.predict(
+                        points, bool(payload.get("membership", False)), meta=meta
+                    )
+                    rows = len(out["labels"])
+                elif path == "/ingest":
+                    points = np.asarray(payload["points"], np.float64)
+                    meta["t_parse"] = time.perf_counter()
+                    out = srv.ingest(points, meta=meta)
+                    rows = out["rows"]
+                elif path == "/swap":
+                    out = srv.swap(payload.get("path"))
+                    rows = 0
+                else:
+                    code = 404
+                    self._json(code, {"error": f"unknown path {self.path!r}"})
+                    return
+            except KeyError as e:
+                code = 400
+                self._json(code, {"error": f"bad request: missing {e}"})
+                return
+            except ValueError as e:  # shape/dim/guard mismatches: client errors
+                code = 400
+                self._json(code, {"error": str(e)})
+                return
+            except RuntimeError as e:  # mode errors (ingest off, nothing staged)
+                code = 409
+                self._json(code, {"error": str(e)})
+                return
+            except Exception as e:  # noqa: BLE001 - surface, don't crash
+                code = 500
+                self._json(code, {"error": f"{type(e).__name__}: {e}"})
+                return
+            code = 200
+            self._json(code, out, headers={"X-Request-Id": rid})
+            if path in ("/predict", "/ingest"):
+                span = (path, rid, rows, int(out.get("generation", 0)), meta)
+        finally:
+            t_end = time.perf_counter()
+            srv._m_in_flight.dec()
+            srv._observe_request(path if known else "other", code, t_end - t0)
+            if span is not None:
+                srv._emit_request_span(*span, t0=t0, t_end=t_end)
 
 
 class ClusterServer:
@@ -189,7 +275,39 @@ class ClusterServer:
         # Distinguishes servers sharing one trace file: check_trace enforces
         # monotonic swap generations per (process, server).
         self._server_id = f"{os.getpid():x}.{id(self) & 0xFFFFFF:06x}"
+
+        # Metrics registry must exist before the first handle: the predictor
+        # observes its batch histograms through it.
+        self.metrics = MetricsRegistry()
+        self._m_requests = self.metrics.counter(
+            "hdbscan_tpu_requests_total",
+            "HTTP requests by route and status code.",
+            labelnames=("route", "status"),
+        )
+        self._m_in_flight = self.metrics.gauge(
+            "hdbscan_tpu_requests_in_flight",
+            "HTTP requests currently being handled.",
+        )
+        self._m_latency = self.metrics.histogram(
+            "hdbscan_tpu_request_latency_seconds",
+            "End-to-end HTTP request wall by route.",
+            labelnames=("route",),
+        )
+        self._m_swaps = self.metrics.counter(
+            "hdbscan_tpu_model_swaps_total",
+            "Blue/green model swaps applied.",
+        )
+        self._m_generation = self.metrics.gauge(
+            "hdbscan_tpu_model_generation",
+            "Generation number of the served model handle.",
+        )
+        self._m_uptime = self.metrics.gauge(
+            "hdbscan_tpu_uptime_seconds",
+            "Seconds since server construction.",
+        )
+
         self._handle = self._build_handle(model, generation=1)
+        self._m_generation.set(1.0)
 
         self.ingest_enabled = bool(ingest)
         self._params = params
@@ -219,7 +337,20 @@ class ClusterServer:
         self._drift_threshold = float(knob("stream_drift_threshold", 2.0))
         self.model_dir = model_dir or "stream_models"
         self._ingest_lock = threading.Lock()
-        self.buffer = IngestBuffer(self.model, absorb_eps_frac=self._absorb_frac)
+        self._m_drift_checks = self.metrics.counter(
+            "hdbscan_tpu_drift_checks_total", "Drift detector checks run."
+        )
+        self._m_drift_flags = self.metrics.counter(
+            "hdbscan_tpu_drift_flags_total", "Drift checks that flagged shift."
+        )
+        self._m_refit_kicks = self.metrics.counter(
+            "hdbscan_tpu_refit_kicks_total",
+            "Background re-fits kicked from the ingest path, by trigger.",
+            labelnames=("trigger",),
+        )
+        self.buffer = IngestBuffer(
+            self.model, absorb_eps_frac=self._absorb_frac, metrics=self.metrics
+        )
         self.drift = DriftDetector(
             *DriftDetector.baseline_from_model(self.model, self._handle.predictor),
             stat=self._drift_stat,
@@ -232,6 +363,7 @@ class ClusterServer:
             self.model_dir,
             tracer=self.tracer,
             on_publish=self._on_publish,
+            metrics=self.metrics,
         )
 
     def _refit_params(self, params):
@@ -249,7 +381,8 @@ class ClusterServer:
         if backend == "rpforest" and model.rpf is None:
             backend = "auto"  # re-fit artifacts ship without a forest
         predictor = Predictor(
-            model, backend=backend, max_batch=self._max_batch, tracer=self.tracer
+            model, backend=backend, max_batch=self._max_batch,
+            tracer=self.tracer, metrics=self.metrics,
         )
         warmup_info = predictor.warmup() if self._warmup else None
         batcher = MicroBatcher(predictor, linger_s=self._linger_s)
@@ -277,26 +410,88 @@ class ClusterServer:
 
     # -- request paths -----------------------------------------------------
 
-    def predict(self, points: np.ndarray, membership: bool = False) -> dict:
+    def next_request_id(self) -> str:
+        """Process-unique request id (pid + process-wide sequence)."""
+        return f"{os.getpid()}-{next(_REQUEST_IDS)}"
+
+    def _observe_request(self, route: str, status: int, wall: float) -> None:
+        self._m_requests.inc(route=route, status=str(status))
+        self._m_latency.observe(wall, route=route)
+
+    def _emit_request_span(
+        self, route, rid, rows, generation, meta, t0, t_end
+    ) -> None:
+        """Emit one ``request_span`` trace event for a successful
+        ``/predict``/``/ingest`` request. The five segments are contiguous
+        perf_counter diffs (clamped monotone into [t0, t_end]) so they
+        telescope exactly to the span wall; 9-decimal rounding keeps the
+        telescoped sum inside check_trace's 1e-6 tolerance, which 6
+        decimals would not."""
+        if self.tracer is None:
+            return
+        t_parse = min(max(t0, meta.get("t_parse", t0)), t_end)
+        t_asm = min(max(t_parse, meta.get("t_assembled", t_parse)), t_end)
+        t_disp = min(max(t_asm, meta.get("t_dispatch", t_asm)), t_end)
+        t_done = min(max(t_disp, meta.get("t_done", t_disp)), t_end)
+        bucket = meta.get("bucket")
+        if not bucket:  # defensive: never emit a non-pow2 bucket
+            pred = self._handle.predictor
+            bucket = pred.bucket_for(min(max(int(rows), 1), pred.max_bucket))
+        self.tracer(
+            "request_span",
+            request_id=rid,
+            route=route,
+            rows=int(rows),
+            bucket=int(bucket),
+            coalesced=int(meta.get("coalesced", 1)),
+            generation=int(generation),
+            parse_s=round(t_parse - t0, 9),
+            queue_s=round(t_asm - t_parse, 9),
+            assemble_s=round(t_disp - t_asm, 9),
+            predict_s=round(t_done - t_disp, 9),
+            respond_s=round(t_end - t_done, 9),
+            wall_s=round(t_end - t0, 9),
+        )
+
+    def predict(
+        self, points: np.ndarray, membership: bool = False,
+        meta: dict | None = None,
+    ) -> dict:
         for _ in range(_PIN_RETRIES):
             handle = self._handle  # pin: this request never mixes models
             try:
-                return self._predict_on(handle, points, membership)
+                return self._predict_on(handle, points, membership, meta)
             except RuntimeError as e:
                 # The pinned handle's batcher closed under us (swap landed
                 # between the pin and the submit) — re-pin and retry; no
-                # request is dropped across a swap.
+                # request is dropped across a swap. (The retry's dispatch
+                # overwrites the meta timestamps, so a span still describes
+                # the attempt that actually served the rows.)
                 if "closed" not in str(e) or self._closed:
                     raise
         raise RuntimeError("predict retries exhausted during model swaps")
 
-    def _predict_on(self, handle: _ModelHandle, points, membership: bool) -> dict:
+    def _predict_on(
+        self, handle: _ModelHandle, points, membership: bool,
+        meta: dict | None = None,
+    ) -> dict:
         if membership:
             # Membership needs the 4-output kernel variant; it bypasses the
-            # batcher and relies on the predictor's internal dispatch lock.
+            # batcher and relies on the predictor's internal dispatch lock —
+            # no queue wait and no coalescing, so the span meta collapses
+            # queue/assemble to zero-width here.
+            if meta is not None:
+                t = time.perf_counter()
+                meta["t_assembled"] = meta["t_dispatch"] = t
             labels, prob, score, mvec = handle.predictor.predict(
                 points, with_membership=True
             )
+            if meta is not None:
+                meta["t_done"] = time.perf_counter()
+                meta["coalesced"] = 1
+                meta["bucket"] = handle.predictor.bucket_for(
+                    min(len(labels), handle.predictor.max_bucket)
+                )
             return {
                 "labels": labels.tolist(),
                 "probabilities": [round(p, 6) for p in prob.tolist()],
@@ -305,7 +500,7 @@ class ClusterServer:
                 "selected_ids": handle.model.selected_ids.tolist(),
                 "generation": handle.generation,
             }
-        labels, prob, score = handle.batcher.predict(points)
+        labels, prob, score = handle.batcher.predict(points, meta=meta)
         return {
             "labels": labels.tolist(),
             "probabilities": [round(p, 6) for p in prob.tolist()],
@@ -313,7 +508,7 @@ class ClusterServer:
             "generation": handle.generation,
         }
 
-    def ingest(self, points: np.ndarray) -> dict:
+    def ingest(self, points: np.ndarray, meta: dict | None = None) -> dict:
         """Streaming entry: predict → absorb/buffer → drift check → maybe
         kick a background re-fit. Returns per-batch routing + drift info."""
         if not self.ingest_enabled:
@@ -326,7 +521,7 @@ class ClusterServer:
         for _ in range(_PIN_RETRIES):
             handle = self._handle
             try:
-                labels, prob, score = handle.batcher.predict(points)
+                labels, prob, score = handle.batcher.predict(points, meta=meta)
             except RuntimeError as e:
                 if "closed" not in str(e) or self._closed:
                     raise
@@ -343,6 +538,9 @@ class ClusterServer:
             absorbed, buffered = self.buffer.absorb(points, labels, prob)
             self.drift.update(labels, score)
             check = self.drift.check(generation=handle.generation)
+            self._m_drift_checks.inc()
+            if check["drifted"]:
+                self._m_drift_flags.inc()
             trigger = None
             if check["drifted"]:
                 trigger = "drift"
@@ -354,6 +552,8 @@ class ClusterServer:
                     originals=min(self.model.n_train, 8192)
                 )
                 refit_started = self.refitter.request(pool, trigger)
+                if refit_started:
+                    self._m_refit_kicks.inc(trigger=trigger)
         if self.tracer is not None:
             self.tracer(
                 "stream_ingest",
@@ -431,6 +631,8 @@ class ClusterServer:
             self._handle = new_handle  # the swap: one reference assignment
             pause_s = time.perf_counter() - t0
             self._swap_count += 1
+        self._m_swaps.inc()
+        self._m_generation.set(float(new_handle.generation))
         if self.tracer is not None:
             self.tracer(
                 "model_swap",
@@ -464,10 +666,29 @@ class ClusterServer:
         self.last_swap = info
         return info
 
-    # -- health ------------------------------------------------------------
+    # -- health / metrics --------------------------------------------------
+
+    def render_metrics(self) -> str:
+        """Prometheus text exposition for ``GET /metrics``. Live-state
+        gauges (uptime, served generation) refresh at scrape time; all
+        counters and histograms accumulate at their event sites."""
+        self._m_uptime.set(round(time.monotonic() - self._t0, 3))
+        self._m_generation.set(float(self._handle.generation))
+        return self.metrics.render()
 
     def health(self) -> dict:
         handle = self._handle
+        # Per-route request/error counts + current in-flight, snapshotted
+        # from the metrics registry (the /metrics counters, folded over
+        # status: >= 400 counts as an error).
+        requests: dict = {}
+        for labels, value in self._m_requests.samples():
+            row = requests.setdefault(
+                labels["route"], {"requests": 0, "errors": 0}
+            )
+            row["requests"] += int(value)
+            if int(labels["status"]) >= 400:
+                row["errors"] += int(value)
         out = {
             "status": "ok",
             "model": handle.model.summary(),
@@ -478,6 +699,8 @@ class ClusterServer:
             "generation": handle.generation,
             "swaps": self._swap_count,
             "uptime_s": round(time.monotonic() - self._t0, 3),
+            "requests": requests,
+            "in_flight": int(self._m_in_flight.value()),
         }
         if self.last_swap is not None:
             out["last_swap"] = self.last_swap

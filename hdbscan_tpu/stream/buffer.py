@@ -98,6 +98,11 @@ class IngestBuffer:
         re-fits (0 disables it).
     seed:
         Reservoir RNG seed.
+    metrics:
+        Optional ``utils/metrics.MetricsRegistry``; :meth:`absorb` counts
+        ingested and absorbed rows into the ``hdbscan_tpu_ingest_*``
+        counters the ``GET /metrics`` absorb-ratio panels are built from
+        (counters survive :meth:`reset`, unlike the per-model stats).
     """
 
     def __init__(
@@ -106,10 +111,21 @@ class IngestBuffer:
         absorb_eps_frac: float = 0.25,
         reservoir_size: int = 4096,
         seed: int = 0,
+        metrics=None,
     ):
         if absorb_eps_frac < 0:
             raise ValueError(
                 f"absorb_eps_frac must be >= 0, got {absorb_eps_frac!r}"
+            )
+        self._m_rows = self._m_absorbed = None
+        if metrics is not None:
+            self._m_rows = metrics.counter(
+                "hdbscan_tpu_ingest_rows_total",
+                "Rows routed through the ingest buffer.",
+            )
+            self._m_absorbed = metrics.counter(
+                "hdbscan_tpu_ingest_absorbed_rows_total",
+                "Ingested rows absorbed as bubble mass (exact + near).",
             )
         self._lock = threading.Lock()
         self.absorb_eps_frac = float(absorb_eps_frac)
@@ -182,7 +198,12 @@ class IngestBuffer:
                 self._novel.append(novel.copy())
                 self._novel_rows += len(novel)
             self._reservoir_add(X)
-        return int(np.count_nonzero(absorbed)), int(len(novel))
+        n_absorbed = int(np.count_nonzero(absorbed))
+        if self._m_rows is not None:
+            self._m_rows.inc(len(X))
+            if n_absorbed:
+                self._m_absorbed.inc(n_absorbed)
+        return n_absorbed, int(len(novel))
 
     def _reservoir_add(self, X: np.ndarray) -> None:
         """Vitter algorithm R over every ingested row (caller holds lock)."""

@@ -49,12 +49,20 @@ class Refitter:
         ``hdbscan_tpu.models.hdbscan.fit``.
     """
 
-    def __init__(self, params, model_dir, tracer=None, on_publish=None, fit_fn=None):
+    def __init__(self, params, model_dir, tracer=None, on_publish=None,
+                 fit_fn=None, metrics=None):
         self.params = params
         self.model_dir = model_dir
         self.tracer = tracer
         self.on_publish = on_publish
         self.fit_fn = fit_fn
+        self._m_refits = None
+        if metrics is not None:
+            self._m_refits = metrics.counter(
+                "hdbscan_tpu_refits_total",
+                "Background re-fits by outcome.",
+                labelnames=("outcome",),
+            )
         self._lock = threading.Lock()
         self._thread: threading.Thread | None = None
         self._publish_seq = 0
@@ -109,6 +117,8 @@ class Refitter:
         except Exception as exc:  # never let a bad refit kill serving
             self.last_error = f"{type(exc).__name__}: {exc}"
             self.refits_failed += 1
+            if self._m_refits is not None:
+                self._m_refits.inc(outcome="error")
             if self.tracer is not None:
                 self.tracer(
                     "model_refit",
@@ -121,6 +131,8 @@ class Refitter:
             return
         self.refits_ok += 1
         self.last_path = path
+        if self._m_refits is not None:
+            self._m_refits.inc(outcome="ok")
         if self.tracer is not None:
             self.tracer(
                 "model_refit",

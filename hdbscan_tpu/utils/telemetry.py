@@ -305,6 +305,9 @@ def build_report(
     stream = stream_section(tracer)
     if stream is not None:
         report["stream"] = stream
+    spans = request_span_section(tracer)
+    if spans is not None:
+        report["request_spans"] = spans
     if memory is not None:
         report["memory"] = json_sanitize(memory)
     if per_host is not None:
@@ -313,7 +316,8 @@ def build_report(
 
 
 def latency_percentiles(walls: list[float] | tuple[float, ...]) -> dict:
-    """Nearest-rank p50/p95/p99 (plus count/mean/max) over per-batch walls.
+    """Nearest-rank p50/p95/p99/p999 (plus count/mean/max) over per-batch
+    walls.
 
     Nearest-rank (index ``ceil(q*n) - 1`` into the sorted walls) rather than
     interpolation so ``scripts/check_trace.py`` can recompute the exact same
@@ -335,8 +339,60 @@ def latency_percentiles(walls: list[float] | tuple[float, ...]) -> dict:
         "p50_s": round(rank(0.50), 6),
         "p95_s": round(rank(0.95), 6),
         "p99_s": round(rank(0.99), 6),
+        "p999_s": round(rank(0.999), 6),
         "max_s": round(ws[-1], 6),
     }
+
+
+def slo_verdict(observed: dict, targets: dict) -> dict:
+    """Target-vs-attainment verdict for the SLO bench leg.
+
+    ``targets`` maps a metric name in ``observed`` to a bound dict with
+    ``"max"`` (upper bound: latencies) and/or ``"min"`` (lower bound:
+    throughput). Returns per-metric rows ``{observed, max?/min?, ok}``
+    plus an overall ``ok`` — a metric missing from ``observed`` fails its
+    target rather than passing silently."""
+    rows: dict = {}
+    all_ok = True
+    for metric, bound in targets.items():
+        value = observed.get(metric)
+        row = {"observed": value}
+        ok = value is not None
+        if "max" in bound:
+            row["max"] = bound["max"]
+            ok = ok and value <= bound["max"]
+        if "min" in bound:
+            row["min"] = bound["min"]
+            ok = ok and value >= bound["min"]
+        row["ok"] = bool(ok)
+        all_ok = all_ok and ok
+        rows[metric] = row
+    return {"targets": rows, "ok": bool(all_ok)}
+
+
+def request_span_section(tracer: Tracer) -> dict | None:
+    """The run report's ``request_spans`` section: per-request serving
+    aggregates over every ``request_span`` event — span-wall percentiles,
+    rows served, the per-segment wall decomposition (parse / queue /
+    assemble / predict / respond totals), and the mean coalesced-peer
+    count. None when the run emitted no spans (section omitted)."""
+    spans = [e for e in tracer.events if e.name == "request_span"]
+    if not spans:
+        return None
+    section = latency_percentiles([e.wall_s for e in spans])
+    rows = sum(int(e.fields.get("rows", 0)) for e in spans)
+    wall = sum(e.wall_s for e in spans)
+    section["rows"] = rows
+    if wall > 0:
+        section["rows_per_s"] = round(rows / wall, 1)
+    section["segments_s"] = {
+        seg: round(sum(float(e.fields.get(seg, 0.0)) for e in spans), 6)
+        for seg in ("parse_s", "queue_s", "assemble_s", "predict_s", "respond_s")
+    }
+    section["coalesced_mean"] = round(
+        sum(int(e.fields.get("coalesced", 1)) for e in spans) / len(spans), 3
+    )
+    return section
 
 
 def knn_index_section(tracer: Tracer) -> dict | None:

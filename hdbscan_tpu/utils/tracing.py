@@ -106,15 +106,28 @@ class Tracer:
         (``utils/telemetry.compile_counter``): phase events are emitted at
         the END of their phase, so compiles-since-last-event land on the
         phase that triggered them.
+      max_events: bound on the in-memory ``events`` list (None or 0 =
+        unbounded). A long-running ``serve --ingest`` process emits one
+        ``predict_batch`` + one ``stream_ingest`` + one ``request_span``
+        per request forever; the bound turns ``events`` into a ring that
+        drops the OLDEST events in chunks (``events_dropped`` counts them).
+        Sinks are unaffected — every event still streams to every sink, so
+        the on-disk JSONL artifact stays complete; only the in-memory view
+        (``summary()``, report aggregation) becomes a recent-window view
+        once the bound trips.
     """
 
-    def __init__(self, stream=None, sinks=None, counters=None):
+    def __init__(self, stream=None, sinks=None, counters=None, max_events=None):
         # Serving emits from many threads at once (HTTP handlers, the
         # batcher worker, the background refitter): one lock makes the
         # counter deltas, the in-memory event order, and the sink write
         # order (JsonlSink's per-line seq) mutually consistent.
         self._emit_lock = threading.Lock()
         self.events: list[TraceEvent] = []
+        self.max_events = int(max_events) if max_events else None
+        if self.max_events is not None and self.max_events < 1:
+            raise ValueError(f"max_events must be >= 1 (or 0/None), got {max_events!r}")
+        self.events_dropped = 0
         self._sinks = list(sinks or [])
         if stream is not None:
             self._sinks.append(LogfmtSink(stream))
@@ -153,6 +166,14 @@ class Tracer:
                 if delta:
                     ev.fields[key] = delta
             self.events.append(ev)
+            if self.max_events is not None and len(self.events) > self.max_events:
+                # Trim the oldest ~1/8 of the window in one slice so the
+                # front-of-list deletion cost amortizes to O(1) per emit
+                # instead of O(n) on every event once the ring is full.
+                drop = len(self.events) - self.max_events + max(1, self.max_events // 8)
+                drop = min(drop, len(self.events) - 1)
+                del self.events[:drop]
+                self.events_dropped += drop
             for s in self._sinks:
                 s.emit(ev)
 
@@ -175,7 +196,13 @@ class Tracer:
             agg[e.name][0] += 1
             agg[e.name][1] += e.wall_s
         rows = sorted(agg.items(), key=lambda kv: -kv[1][1])
-        return "\n".join(f"{name}: n={n} wall_s={w:.3f}" for name, (n, w) in rows)
+        lines = [f"{name}: n={n} wall_s={w:.3f}" for name, (n, w) in rows]
+        if self.events_dropped:
+            lines.append(
+                f"(ring buffer: {self.events_dropped} oldest events dropped, "
+                f"max_events={self.max_events}; totals cover the retained window)"
+            )
+        return "\n".join(lines)
 
 
 def stderr_tracer() -> Tracer:

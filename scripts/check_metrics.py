@@ -1,0 +1,300 @@
+#!/usr/bin/env python3
+"""Stdlib-only validator for the ``GET /metrics`` Prometheus exposition.
+
+Usage::
+
+    python scripts/check_metrics.py SCRAPE1.txt [SCRAPE2.txt]
+
+With one file: validates the exposition grammar (HELP/TYPE comment lines,
+sample lines with escaped label values, finite sample values, no duplicate
+series) and the histogram invariants (cumulative non-decreasing ``_bucket``
+series ordered by ``le``, a ``+Inf`` bucket present and equal to
+``_count``, finite ``_sum``, integral non-negative counts).
+
+With two files (two scrapes of the same server, second taken later): also
+checks counter monotonicity — every counter-type sample and every
+histogram ``_bucket``/``_count``/``_sum`` sample present in the first
+scrape must still exist in the second with a value no smaller (this repo's
+histograms observe non-negative values, so ``_sum`` is monotone too).
+Gauges are exempt (in-flight and generation go up AND down).
+
+Exit 0 when clean; prints one ``FAIL:`` line per violation and exits 1
+otherwise. Deliberately dependency-free so it runs anywhere the server
+does, mirroring ``scripts/check_trace.py``.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import sys
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)\s*$"
+)
+
+#: Histogram samples derive from the family name with these suffixes.
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _parse_labels(text: str):
+    """Parse ``k1="v1",k2="v2"`` with Prometheus escapes; None on error."""
+    labels = {}
+    i, n = 0, len(text)
+    while i < n:
+        while i < n and text[i] in ", ":
+            i += 1
+        if i >= n:
+            break
+        eq = text.find("=", i)
+        if eq < 0:
+            return None
+        name = text[i:eq].strip()
+        if not _LABEL_NAME_RE.match(name) or name in labels:
+            return None
+        i = eq + 1
+        if i >= n or text[i] != '"':
+            return None
+        i += 1
+        out = []
+        closed = False
+        while i < n:
+            c = text[i]
+            if c == "\\":
+                if i + 1 >= n:
+                    return None
+                out.append({"n": "\n", "\\": "\\", '"': '"'}.get(text[i + 1], text[i + 1]))
+                i += 2
+            elif c == '"':
+                i += 1
+                closed = True
+                break
+            else:
+                out.append(c)
+                i += 1
+        if not closed:
+            return None
+        labels[name] = "".join(out)
+    return labels
+
+
+def _family_of(sample_name: str, types: dict) -> str | None:
+    """Map a sample name to its declared TYPE family (histogram samples
+    carry a suffix on top of the family name)."""
+    if sample_name in types:
+        return sample_name
+    for suffix in _HIST_SUFFIXES:
+        if sample_name.endswith(suffix):
+            base = sample_name[: -len(suffix)]
+            if types.get(base) == "histogram":
+                return base
+    return None
+
+
+def parse_exposition(text: str, where: str = "metrics"):
+    """Parse one scrape. Returns ``(parsed, errors)`` where parsed is
+    ``{"types": {family: type}, "helps": {family: help},
+    "samples": {(sample_name, sorted_label_items): float}}``."""
+    types: dict = {}
+    helps: dict = {}
+    samples: dict = {}
+    errors: list = []
+    for ln, raw in enumerate(text.splitlines(), 1):
+        line = raw.rstrip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] == "TYPE":
+                name, kind = parts[2], parts[3] if len(parts) > 3 else ""
+                if not _NAME_RE.match(name):
+                    errors.append(f"{where}:{ln}: bad TYPE metric name {name!r}")
+                elif kind not in ("counter", "gauge", "histogram", "summary", "untyped"):
+                    errors.append(f"{where}:{ln}: bad TYPE kind {kind!r}")
+                elif name in types:
+                    errors.append(f"{where}:{ln}: duplicate TYPE for {name!r}")
+                else:
+                    types[name] = kind
+            elif len(parts) >= 3 and parts[1] == "HELP":
+                helps[parts[2]] = parts[3] if len(parts) > 3 else ""
+            # other comments are legal and ignored
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            errors.append(f"{where}:{ln}: unparseable sample line {line!r}")
+            continue
+        name, label_text, value_text = m.group(1), m.group(2), m.group(3)
+        labels = _parse_labels(label_text) if label_text else {}
+        if labels is None:
+            errors.append(f"{where}:{ln}: bad label block in {line!r}")
+            continue
+        try:
+            value = float(value_text)
+        except ValueError:
+            errors.append(f"{where}:{ln}: bad sample value {value_text!r}")
+            continue
+        if not math.isfinite(value):
+            errors.append(f"{where}:{ln}: non-finite sample value in {line!r}")
+            continue
+        key = (name, tuple(sorted(labels.items())))
+        if key in samples:
+            errors.append(f"{where}:{ln}: duplicate series {line!r}")
+            continue
+        samples[key] = value
+        if _family_of(name, types) is None:
+            errors.append(
+                f"{where}:{ln}: sample {name!r} has no preceding TYPE declaration"
+            )
+    return {"types": types, "helps": helps, "samples": samples}, errors
+
+
+def _check_histograms(parsed, where: str) -> list:
+    """Per (family, non-le labels) series: buckets cumulative and ordered,
+    +Inf present and equal to _count, counts integral, _sum present."""
+    errors: list = []
+    types, samples = parsed["types"], parsed["samples"]
+    hist_families = [n for n, k in types.items() if k == "histogram"]
+    for fam in hist_families:
+        # group _bucket samples by their non-le label set
+        groups: dict = {}
+        for (name, label_items), value in samples.items():
+            if name != fam + "_bucket":
+                continue
+            labels = dict(label_items)
+            le = labels.pop("le", None)
+            if le is None:
+                errors.append(f"{where}: {fam}_bucket series missing le label")
+                continue
+            groups.setdefault(tuple(sorted(labels.items())), []).append((le, value))
+        count_keys = {
+            label_items
+            for (name, label_items) in samples
+            if name == fam + "_count"
+        }
+        sum_keys = {
+            label_items for (name, label_items) in samples if name == fam + "_sum"
+        }
+        if not groups and (count_keys or sum_keys):
+            errors.append(f"{where}: {fam} has _count/_sum but no _bucket series")
+        for key, buckets in groups.items():
+            finite, inf_value = [], None
+            for le, value in buckets:
+                if value < 0 or value != int(value):
+                    errors.append(
+                        f"{where}: {fam}_bucket{dict(key)} le={le} has "
+                        f"non-integral/negative count {value}"
+                    )
+                if le == "+Inf":
+                    inf_value = value
+                    continue
+                try:
+                    finite.append((float(le), value))
+                except ValueError:
+                    errors.append(f"{where}: {fam}_bucket bad le {le!r}")
+            finite.sort()
+            prev = 0.0
+            for le, value in finite:
+                if value < prev:
+                    errors.append(
+                        f"{where}: {fam}_bucket{dict(key)} not cumulative at "
+                        f"le={le} ({value} < {prev})"
+                    )
+                prev = value
+            if inf_value is None:
+                errors.append(f"{where}: {fam}_bucket{dict(key)} missing +Inf bucket")
+                continue
+            if finite and inf_value < finite[-1][1]:
+                errors.append(
+                    f"{where}: {fam} +Inf bucket {inf_value} below last "
+                    f"finite bucket {finite[-1][1]}"
+                )
+            count = samples.get((fam + "_count", key))
+            if count is None:
+                errors.append(f"{where}: {fam}{dict(key)} missing _count sample")
+            elif count != inf_value:
+                errors.append(
+                    f"{where}: {fam}{dict(key)} _count {count} != +Inf "
+                    f"bucket {inf_value}"
+                )
+            if (fam + "_sum", key) not in samples:
+                errors.append(f"{where}: {fam}{dict(key)} missing _sum sample")
+    return errors
+
+
+def validate_exposition(text: str, where: str = "metrics"):
+    """Grammar + histogram-consistency validation of one scrape.
+    Returns ``(parsed, errors)``."""
+    parsed, errors = parse_exposition(text, where)
+    errors += _check_histograms(parsed, where)
+    return parsed, errors
+
+
+def _monotonic_families(parsed) -> set:
+    """Sample names whose values must not decrease between scrapes."""
+    names = set()
+    for fam, kind in parsed["types"].items():
+        if kind == "counter":
+            names.add(fam)
+        elif kind == "histogram":
+            names.update(fam + s for s in _HIST_SUFFIXES)
+    return names
+
+
+def check_monotonic(first, second, where: str = "scrapes") -> list:
+    """Counter monotonicity across two scrapes of the same server: every
+    counter/histogram series in the first scrape must persist in the second
+    with a value no smaller."""
+    errors: list = []
+    mono = _monotonic_families(second) | _monotonic_families(first)
+    for (name, label_items), v1 in sorted(first["samples"].items()):
+        if name not in mono:
+            continue
+        v2 = second["samples"].get((name, label_items))
+        if v2 is None:
+            errors.append(
+                f"{where}: series {name}{dict(label_items)} vanished between scrapes"
+            )
+        elif v2 < v1:
+            errors.append(
+                f"{where}: {name}{dict(label_items)} decreased between "
+                f"scrapes ({v1} -> {v2})"
+            )
+    return errors
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) < 1 or len(argv) > 2:
+        print(
+            "usage: check_metrics.py SCRAPE1.txt [SCRAPE2.txt]", file=sys.stderr
+        )
+        return 2
+    all_errors: list = []
+    parsed_list = []
+    for path in argv:
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+        parsed, errors = validate_exposition(text, where=path)
+        all_errors += errors
+        parsed_list.append(parsed)
+        print(
+            f"{path}: {len(parsed['samples'])} samples, "
+            f"{len(parsed['types'])} families, {len(errors)} errors"
+        )
+    if len(parsed_list) == 2:
+        mono_errors = check_monotonic(
+            parsed_list[0], parsed_list[1], where=f"{argv[0]} -> {argv[1]}"
+        )
+        all_errors += mono_errors
+        print(f"monotonicity: {len(mono_errors)} errors")
+    for err in all_errors:
+        print(f"FAIL: {err}")
+    if all_errors:
+        return 1
+    print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -47,12 +47,21 @@ non-negative ``value``/``assign_psi``, positive ``threshold``, integer
 and positive ``rows``; ``model_swap`` a positive ``generation`` that
 STRICTLY INCREASES per (process, server) — the blue/green contract that a
 server process never swaps backwards or repeats a generation — plus a
-string ``digest`` and positive ``n_train``. Given
+string ``digest`` and positive ``n_train``.
+Request spans (``serve/server.py``, README "Observability") add one more
+schema: every ``request_span`` must carry a ``route`` in
+``{/predict, /ingest}``, a non-empty string ``request_id`` that is UNIQUE
+per process (each HTTP request is spanned exactly once), ``rows >= 1``, a
+power-of-two ``bucket``, ``coalesced >= 1``, ``generation >= 1``, and five
+finite non-negative segment walls (``parse_s``/``queue_s``/``assemble_s``/
+``predict_s``/``respond_s``) that TELESCOPE: their sum equals ``wall_s``
+within 1e-6 — the contract that the decomposition accounts for every
+microsecond of request wall. Given
 a report (``utils/telemetry.REPORT_SCHEMA``), additionally cross-checks
 that the report's per-phase wall totals equal the trace's per-stage wall
 sums within 1e-6, and — when the report carries a ``predict_latency``
-section — that its nearest-rank p50/p95/p99 recompute exactly from the
-trace's ``predict_batch`` walls (same 1e-6 tolerance) — the round-trip
+section — that its nearest-rank p50/p95/p99/p999 recompute exactly from
+the trace's ``predict_batch`` walls (same 1e-6 tolerance) — the round-trip
 guarantees the tier-1 e2e tests pin.
 
 Exit code 0 = valid; 1 = any violation (all violations printed). Pure
@@ -106,6 +115,7 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
     last_batch_seq: dict = {}  # per-(process, predictor) predict_batch seq
     sync_counts: dict = {}  # per-process [host_syncs, device forest builds]
     last_swap_gen: dict = {}  # per-(process, server) model_swap generation
+    seen_request_ids: dict = {}  # per-process set of request_span ids
     with open(path, encoding="utf-8") as f:
         for lineno, line in enumerate(f, 1):
             line = line.strip()
@@ -235,6 +245,20 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
                                 f"server {ev.get('server')!r}"
                             )
                         last_swap_gen[key] = gen
+            # Request-span invariants (serve/server.py): per-event schema
+            # here; per-process request-id uniqueness needs cross-event
+            # state so it lives in this loop.
+            if stage == "request_span":
+                errors += _check_request_span(path, lineno, ev)
+                rid = ev.get("request_id")
+                if isinstance(rid, str) and rid:
+                    seen = seen_request_ids.setdefault(proc, set())
+                    if rid in seen:
+                        errors.append(
+                            f"{path}:{lineno}: request_span request_id "
+                            f"{rid!r} repeated within process {proc!r}"
+                        )
+                    seen.add(rid)
             # Per-device wall events: each device's timeline must be ordered.
             device = ev.get("device")
             if isinstance(device, int) and isinstance(seq, int):
@@ -411,6 +435,68 @@ def _check_stream(path: str, lineno: int, stage: str, ev: dict) -> list[str]:
     return errors
 
 
+#: The five telescoping segments of a request_span, in wall-clock order.
+SPAN_SEGMENTS = ("parse_s", "queue_s", "assemble_s", "predict_s", "respond_s")
+
+
+def _check_request_span(path: str, lineno: int, ev: dict) -> list[str]:
+    """The request_span schema (serve/server.py): route/id/shape fields
+    plus the telescoping contract — the five segments sum to ``wall_s``
+    within ``WALL_TOLERANCE``. Request-id uniqueness is checked in the
+    main loop (it needs per-process state)."""
+    errors: list[str] = []
+    where = f"{path}:{lineno}: request_span"
+    if ev.get("route") not in ("/predict", "/ingest"):
+        errors.append(
+            f"{where} route={ev.get('route')!r} not in (/predict, /ingest)"
+        )
+    rid = ev.get("request_id")
+    if not isinstance(rid, str) or not rid:
+        errors.append(f"{where} lacks a non-empty string 'request_id'")
+    if not _pos_int(ev.get("rows")):
+        errors.append(f"{where} rows={ev.get('rows')!r} not a positive int")
+    bucket = ev.get("bucket")
+    if not _pos_int(bucket) or (bucket & (bucket - 1)):
+        errors.append(f"{where} bucket={bucket!r} is not a power of two")
+    if not _pos_int(ev.get("coalesced")):
+        errors.append(
+            f"{where} coalesced={ev.get('coalesced')!r} not a positive int"
+        )
+    if not _pos_int(ev.get("generation")):
+        errors.append(
+            f"{where} generation={ev.get('generation')!r} not a positive int"
+        )
+    total = 0.0
+    segments_ok = True
+    for key in SPAN_SEGMENTS:
+        val = ev.get(key)
+        if (
+            not isinstance(val, (int, float))
+            or isinstance(val, bool)
+            or not math.isfinite(float(val))
+            or float(val) < 0
+        ):
+            errors.append(
+                f"{where} {key}={val!r} not a finite non-negative number"
+            )
+            segments_ok = False
+        else:
+            total += float(val)
+    wall = ev.get("wall_s")
+    if segments_ok and isinstance(wall, (int, float)) and not isinstance(
+        wall, bool
+    ):
+        if not math.isclose(
+            total, float(wall), rel_tol=0.0, abs_tol=WALL_TOLERANCE
+        ):
+            errors.append(
+                f"{where} segments sum {round(total, 9)} != wall_s {wall} "
+                f"(tol {WALL_TOLERANCE}) — the decomposition must account "
+                f"for the full request wall"
+            )
+    return errors
+
+
 def validate_report(
     path: str, trace_events: list[dict] | None = None
 ) -> tuple[dict, list[str]]:
@@ -494,6 +580,7 @@ def _check_predict_latency(
         "p50_s": walls[max(0, math.ceil(0.50 * n) - 1)],
         "p95_s": walls[max(0, math.ceil(0.95 * n) - 1)],
         "p99_s": walls[max(0, math.ceil(0.99 * n) - 1)],
+        "p999_s": walls[max(0, math.ceil(0.999 * n) - 1)],
         "max_s": walls[-1],
         "mean_s": sum(walls) / n,
     }

@@ -1,0 +1,198 @@
+"""Tier-1 observability round-trip (README "Observability"): a small fit
+is served over HTTP, the load generator drives closed-loop mixed-size
+traffic at it, ``/metrics`` is scraped twice with traffic in between, and
+EVERY artifact passes its validator — the exposition + monotonicity checks
+of ``scripts/check_metrics.py``, the ``request_span`` schema +
+telescoping-segments + unique-request-id checks of
+``scripts/check_trace.py``, the report round-trip, enriched ``/healthz``,
+and the histogram-vs-raw p99 one-bucket-width accuracy contract.
+
+The sustained duration-based variant rides the same harness marked
+``slow`` (excluded from tier-1).
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from benchmarks import loadgen
+from hdbscan_tpu import HDBSCANParams
+from hdbscan_tpu.models import hdbscan
+from hdbscan_tpu.serve.server import ClusterServer
+from hdbscan_tpu.utils import telemetry
+from hdbscan_tpu.utils.tracing import JsonlSink, Tracer
+from scripts import check_metrics, check_trace
+from tests.conftest import make_blobs
+
+MIX = ((1, 0.5), (7, 0.3), (24, 0.2))
+
+
+@pytest.fixture(scope="module")
+def served():
+    """One 300-pt fit + live HTTP server + a closed-loop load run, shared
+    by every assertion in the module: (base_url, result, scrapes, paths)."""
+    rng = np.random.default_rng(7)
+    data, _ = make_blobs(rng, n=300, d=3, centers=3)
+    params = HDBSCANParams(min_points=8, min_cluster_size=8)
+    model = hdbscan.fit(data, params).to_cluster_model(data, params)
+
+    import tempfile, os
+
+    tmp = tempfile.mkdtemp(prefix="slo_e2e_")
+    trace_path = os.path.join(tmp, "trace.jsonl")
+    report_path = os.path.join(tmp, "report.json")
+    tracer = Tracer(sinks=[JsonlSink(trace_path, static={"process": 0})])
+    srv = ClusterServer(model, max_batch=32, port=0, tracer=tracer).start()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def sampler(k):
+        return data[rng.integers(0, len(data), k)] + rng.normal(0, 0.02, (k, 3))
+
+    result = loadgen.run_load(
+        loadgen.http_predict_submitter(base, sampler),
+        mode="closed", concurrency=3, batch_mix=MIX,
+        requests=40, warmup_requests=4,
+    )
+    with urllib.request.urlopen(base + "/metrics") as resp:
+        scrape1 = resp.read().decode()
+        ctype = resp.headers.get("Content-Type", "")
+    # more traffic between the scrapes so monotone counters actually move
+    more = loadgen.run_load(
+        loadgen.http_predict_submitter(base, sampler),
+        mode="closed", concurrency=2, batch_mix=MIX, requests=10,
+    )
+    with urllib.request.urlopen(base + "/metrics") as resp:
+        scrape2 = resp.read().decode()
+    with urllib.request.urlopen(base + "/healthz") as resp:
+        health = json.loads(resp.read())
+    srv.close()
+    tracer.close()
+    telemetry.write_report(
+        report_path,
+        telemetry.build_report(tracer, manifest=telemetry.run_manifest(None)),
+    )
+    yield {
+        "base": base, "result": result, "more": more, "ctype": ctype,
+        "scrapes": (scrape1, scrape2), "health": health,
+        "trace": trace_path, "report": report_path,
+    }
+
+
+def test_load_ran_clean(served):
+    r = served["result"]
+    assert r.errors == 0
+    assert r.requests == 40 and r.warmup_requests == 4
+    assert r.rows >= 40  # mixed sizes, min 1 row each
+    assert served["more"].errors == 0
+
+
+def test_metrics_scrapes_pass_validator(served):
+    s1, s2 = served["scrapes"]
+    assert served["ctype"].startswith("text/plain")
+    p1, errs1 = check_metrics.validate_exposition(s1, "scrape1")
+    p2, errs2 = check_metrics.validate_exposition(s2, "scrape2")
+    assert errs1 == [] and errs2 == []
+    assert check_metrics.check_monotonic(p1, p2) == []
+    # the serving families are actually present with traffic in them
+    key = ("hdbscan_tpu_requests_total",
+           (("route", "/predict"), ("status", "200")))
+    assert p1["samples"][key] >= 44  # 40 recorded + 4 warmup
+    assert p2["samples"][key] >= p1["samples"][key] + 10
+    lat_count = [v for (n, _), v in p2["samples"].items()
+                 if n == "hdbscan_tpu_request_latency_seconds_count"]
+    assert sum(lat_count) >= 54
+    assert any(n == "hdbscan_tpu_predict_batch_rows_count"
+               for (n, _) in p2["samples"])
+
+
+def test_request_spans_pass_trace_validator(served):
+    events, errors = check_trace.validate_trace(served["trace"])
+    assert errors == []
+    spans = [e for e in events if e.get("stage") == "request_span"]
+    # every recorded + warmup request got exactly one span
+    assert len(spans) == 54
+    rids = {e["request_id"] for e in spans}
+    assert len(rids) == len(spans)
+    for e in spans:
+        segs = sum(e[k] for k in check_trace.SPAN_SEGMENTS)
+        assert abs(segs - e["wall_s"]) <= check_trace.WALL_TOLERANCE
+    # coalescing happened at least once under 3-way concurrency, or not —
+    # but the field is always a positive int and buckets are pow2
+    assert all(e["coalesced"] >= 1 and e["bucket"] >= 1 for e in spans)
+
+
+def test_report_round_trips_against_trace(served):
+    events, _ = check_trace.validate_trace(served["trace"])
+    report, errors = check_trace.validate_report(
+        served["report"], trace_events=events
+    )
+    assert errors == []
+    spans_section = report["request_spans"]
+    assert spans_section["count"] == 54
+    assert spans_section["rows_per_s"] > 0
+    assert set(spans_section["segments_s"]) == set(check_trace.SPAN_SEGMENTS)
+
+
+def test_healthz_enriched(served):
+    h = served["health"]
+    assert h["status"] == "ok"
+    assert h["uptime_s"] > 0
+    assert h["in_flight"] == 1  # the /healthz request itself, nothing else
+    pred = h["requests"]["/predict"]
+    assert pred["requests"] >= 54 and pred["errors"] == 0
+    # the /metrics scrape itself is counted under its route
+    assert h["requests"]["/metrics"]["requests"] >= 1
+
+
+def test_hist_p99_within_one_bucket_of_raw(served):
+    r = served["result"]
+    assert r.quantiles_consistent(0.99)
+    assert r.quantiles_consistent(0.5)
+    pct = r.percentiles()
+    assert pct["p50_s"] <= pct["p99_s"] <= pct["p999_s"] <= pct["max_s"]
+
+
+@pytest.mark.slow
+def test_sustained_slo_window():
+    """Duration-based sustained variant of the same harness (not tier-1):
+    8s closed-loop + open-loop Poisson secondary, SLO verdict attained."""
+    rng = np.random.default_rng(11)
+    data, _ = make_blobs(rng, n=300, d=3, centers=3)
+    params = HDBSCANParams(min_points=8, min_cluster_size=8)
+    model = hdbscan.fit(data, params).to_cluster_model(data, params)
+    srv = ClusterServer(model, max_batch=32, port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def sampler(k):
+        return data[rng.integers(0, len(data), k)] + rng.normal(0, 0.02, (k, 3))
+
+    try:
+        submit = loadgen.http_predict_submitter(base, sampler)
+        closed = loadgen.run_load(
+            submit, mode="closed", concurrency=4, batch_mix=MIX,
+            duration_s=8.0, warmup_s=1.0,
+        )
+        opened = loadgen.run_load(
+            submit, mode="open", concurrency=4, rate_rps=40.0,
+            duration_s=4.0, warmup_s=0.5,
+        )
+    finally:
+        srv.close()
+    assert closed.errors == 0 and opened.errors == 0
+    pct = closed.percentiles()
+    verdict = telemetry.slo_verdict(
+        {
+            "p99_s": pct["p99_s"],
+            "rows_per_s": closed.rows_per_s(),
+            "error_rate": 0.0,
+        },
+        {
+            "p99_s": {"max": 1.0},
+            "rows_per_s": {"min": 50.0},
+            "error_rate": {"max": 0.0},
+        },
+    )
+    assert verdict["ok"], verdict
+    assert closed.quantiles_consistent(0.99)

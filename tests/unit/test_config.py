@@ -70,6 +70,16 @@ def test_valid_stream_values_construct():
     assert p.stream_absorb_eps_frac == 0.0
 
 
+def test_trace_max_events_validates_eagerly():
+    """``trace_max_events`` rejects negatives at construction; 0 means
+    unbounded and any non-negative int constructs."""
+    with pytest.raises(ValueError, match="trace_max_events") as exc:
+        HDBSCANParams(trace_max_events=-1)
+    assert repr(-1) in str(exc.value)
+    assert HDBSCANParams(trace_max_events=0).trace_max_events == 0
+    assert HDBSCANParams(trace_max_events=500).trace_max_events == 500
+
+
 def test_valid_backend_values_construct():
     for knn_index in ("auto", "exact", "rpforest"):
         p = HDBSCANParams(
@@ -98,5 +108,6 @@ def test_flag_parsing_roundtrip():
         ("drift_threshold", "stream_drift_threshold", float),
         ("refit_budget", "stream_refit_budget", int),
         ("stream_reload", "stream_reload", str),
+        ("trace_max_events", "trace_max_events", int),
     ):
         assert FLAG_FIELDS.get(flag) == (field, conv)

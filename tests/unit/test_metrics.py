@@ -1,0 +1,234 @@
+"""The stdlib metrics registry (``utils/metrics.py``) and the Tracer ring
+buffer (``utils/tracing.py``, README "Observability").
+
+Pins the contracts the serving path and the validators rely on:
+
+- ``Histogram.quantile`` is nearest-rank within one bucket width of
+  ``np.percentile(..., method="inverted_cdf")`` over the raw samples —
+  including the empty/single-sample/overflow-bucket edges;
+- ``MetricsRegistry.render`` emits text that ``scripts/check_metrics.py``
+  parses with ZERO errors, and two successive renders with traffic in
+  between pass its counter-monotonicity check;
+- concurrent mutation from many threads (the HTTP handler pool, the
+  batcher worker, and the refitter all share one registry in the server)
+  loses no increments and never corrupts a mid-mutation render;
+- ``merge`` folds a second registry's state in exactly;
+- a bounded ``Tracer`` caps ``events`` at ``max_events``, counts the
+  drops, keeps every event flowing to sinks, and says so in ``summary``.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.utils.metrics import (
+    DEFAULT_LATENCY_BUCKETS,
+    MetricsRegistry,
+    log_buckets,
+)
+from scripts import check_metrics
+
+
+def _raw_nearest_rank(vals, q):
+    return float(np.percentile(np.asarray(vals), q * 100.0,
+                               method="inverted_cdf"))
+
+
+def _bucket_width_at(edges, value):
+    import bisect
+
+    i = bisect.bisect_left(edges, value)
+    if i >= len(edges):
+        return float("inf")
+    return edges[i] - (edges[i - 1] if i > 0 else 0.0)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("n", [1, 7, 100, 5000])
+def test_histogram_quantile_within_one_bucket_of_nearest_rank(seed, n):
+    rng = np.random.default_rng(seed)
+    # log-normal-ish latencies spanning several decades of the bucket grid
+    vals = rng.exponential(0.005, n) + rng.exponential(0.05, n) * (
+        rng.random(n) < 0.1
+    )
+    reg = MetricsRegistry()
+    h = reg.histogram("t_lat_seconds", "t", buckets=DEFAULT_LATENCY_BUCKETS)
+    for v in vals:
+        h.observe(float(v))
+    assert h.count() == n
+    assert h.total() == pytest.approx(float(vals.sum()))
+    for q in (0.5, 0.9, 0.99, 0.999, 1.0):
+        raw = _raw_nearest_rank(vals, q)
+        got = h.quantile(q)
+        width = _bucket_width_at(h.buckets, raw)
+        assert abs(got - raw) <= width, (q, got, raw, width)
+
+
+def test_histogram_quantile_edges():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_edge_seconds", "t", buckets=[0.1, 1.0])
+    # empty histogram has no quantiles
+    assert h.quantile(0.99) is None
+    # single sample: every quantile is that sample's bucket edge
+    h.observe(0.05)
+    assert h.quantile(0.5) == h.quantile(0.999) == 0.1
+    # overflow: samples beyond the last edge land in +Inf; the quantile
+    # answers with the max observed value, not infinity
+    h.observe(25.0)
+    h.observe(50.0)
+    assert h.quantile(1.0) == 50.0
+    with pytest.raises(ValueError):
+        h.quantile(0.0)
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+def test_render_is_valid_exposition_and_monotonic():
+    reg = MetricsRegistry()
+    c = reg.counter("t_requests_total", "reqs", labelnames=("route", "status"))
+    g = reg.gauge("t_in_flight", "inflight")
+    h = reg.histogram("t_latency_seconds", "lat", labelnames=("route",))
+    c.inc(route="/predict", status="200")
+    c.inc(2, route="/metrics", status="200")
+    g.set(3.0)
+    for v in (0.001, 0.02, 0.5):
+        h.observe(v, route="/predict")
+    parsed1, errors1 = check_metrics.validate_exposition(reg.render(), "r1")
+    assert errors1 == []
+    # traffic between scrapes; the gauge may DECREASE without violating
+    # monotonicity (check_metrics exempts gauges)
+    c.inc(route="/predict", status="200")
+    h.observe(0.2, route="/predict")
+    g.set(0.0)
+    parsed2, errors2 = check_metrics.validate_exposition(reg.render(), "r2")
+    assert errors2 == []
+    assert check_metrics.check_monotonic(parsed1, parsed2) == []
+    # a decreasing counter IS flagged
+    shrunk = dict(parsed2["samples"])
+    key = ("t_requests_total", (("route", "/predict"), ("status", "200")))
+    shrunk[key] = 0.0
+    bad = {**parsed2, "samples": shrunk}
+    assert check_metrics.check_monotonic(parsed1, bad)
+
+
+def test_label_escaping_round_trips_through_validator():
+    reg = MetricsRegistry()
+    c = reg.counter("t_weird_total", "w", labelnames=("path",))
+    nasty = 'a"b\\c\nd'
+    c.inc(path=nasty)
+    parsed, errors = check_metrics.validate_exposition(reg.render(), "r")
+    assert errors == []
+    (key,) = [k for k in parsed["samples"] if k[0] == "t_weird_total"]
+    assert dict(key[1])["path"] == nasty
+
+
+def test_registry_rejects_type_and_name_conflicts():
+    reg = MetricsRegistry()
+    c = reg.counter("t_thing_total", "t")
+    # same name + type + labels: the same object comes back
+    assert reg.counter("t_thing_total", "t") is c
+    with pytest.raises(ValueError):
+        reg.gauge("t_thing_total", "t")
+    with pytest.raises(ValueError):
+        reg.counter("t_thing_total", "t", labelnames=("route",))
+    with pytest.raises(ValueError):
+        reg.counter("9bad", "t")
+    with pytest.raises(ValueError):
+        reg.histogram("t_h_seconds", "t", buckets=[2.0, 1.0])
+    with pytest.raises(ValueError):
+        c.inc(-1.0)
+
+
+def test_concurrent_mutation_loses_nothing():
+    """HTTP pool + batcher + refitter all hammer one registry: counter
+    totals must be exact and a render taken mid-mutation must stay
+    parseable."""
+    reg = MetricsRegistry()
+    c = reg.counter("t_hits_total", "hits", labelnames=("route",))
+    g = reg.gauge("t_busy", "busy")
+    h = reg.histogram("t_work_seconds", "work")
+    n_threads, per_thread = 8, 500
+    render_errors = []
+    barrier = threading.Barrier(n_threads + 1)
+
+    def worker(i):
+        route = f"/r{i % 3}"
+        barrier.wait()
+        for j in range(per_thread):
+            c.inc(route=route)
+            g.inc()
+            h.observe(0.001 * (j % 7 + 1))
+            g.dec()
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    for _ in range(20):  # scrape while the traffic is in flight
+        _, errs = check_metrics.validate_exposition(reg.render(), "live")
+        render_errors += errs
+    for t in threads:
+        t.join()
+    assert render_errors == []
+    assert sum(int(v) for _, v in c.samples()) == n_threads * per_thread
+    assert h.count() == n_threads * per_thread
+    assert g.value() == 0.0
+    _, errs = check_metrics.validate_exposition(reg.render(), "final")
+    assert errs == []
+
+
+def test_merge_folds_state_exactly():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    edges = log_buckets(0.001, 2.0, 8)
+    ca = a.counter("t_n_total", "n", labelnames=("k",))
+    cb = b.counter("t_n_total", "n", labelnames=("k",))
+    ha = a.histogram("t_w_seconds", "w", buckets=edges)
+    hb = b.histogram("t_w_seconds", "w", buckets=edges)
+    ca.inc(3, k="x")
+    cb.inc(4, k="x")
+    cb.inc(1, k="y")
+    for v in (0.002, 0.01):
+        ha.observe(v)
+    for v in (0.004, 0.5):
+        hb.observe(v)
+    ca.merge(cb)
+    ha.merge(hb)
+    assert ca.value(k="x") == 7.0 and ca.value(k="y") == 1.0
+    assert ha.count() == 4
+    assert ha.total() == pytest.approx(0.002 + 0.01 + 0.004 + 0.5)
+    mismatched = a.histogram("t_other_seconds", "o", buckets=[1.0, 2.0])
+    with pytest.raises(ValueError):
+        ha.merge(mismatched)
+
+
+def test_tracer_ring_buffer_bounds_memory_not_sinks():
+    from hdbscan_tpu.utils.tracing import Tracer
+
+    sunk = []
+
+    class ListSink:
+        def emit(self, event):
+            sunk.append(event)
+
+        def close(self):
+            pass
+
+    t = Tracer(sinks=[ListSink()], max_events=100)
+    for i in range(1000):
+        t("stage_a", wall_s=0.001, i=i)
+    # in-memory window bounded; drops counted; the sink saw everything
+    assert len(t.events) <= 100
+    assert t.events_dropped == 1000 - len(t.events)
+    assert len(sunk) == 1000
+    # the retained window is the NEWEST events
+    assert t.events[-1].fields["i"] == 999
+    assert "ring buffer" in t.summary() and "dropped" in t.summary()
+    # unbounded default: nothing dropped, no note
+    t2 = Tracer()
+    for i in range(200):
+        t2("stage_a", wall_s=0.001)
+    assert len(t2.events) == 200 and t2.events_dropped == 0
+    assert "ring buffer" not in t2.summary()
+    with pytest.raises(ValueError):
+        Tracer(max_events=-5)
