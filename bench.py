@@ -46,6 +46,14 @@ server → closed-loop sustained + open-loop Poisson load via
 ``scripts/check_metrics.py`` → one JSON line with nearest-rank
 p50/p99/p999 latency, rows/s, the histogram-vs-raw p99 cross-check, and
 the target-vs-attainment verdict against ``SLO_TARGETS``.
+
+``bench.py chaos [--quick]`` runs the fault-tolerance leg (README "Fault
+tolerance"): the same synthetic server with a tiny bounded queue under
+injected slow/error/reset faults and overload — recording shed rate, p99
+latency of the requests that were served under fault, and the per-site
+fault counts — then a WAL recovery microbench (journal a 5k-row stream,
+abandon the server crash-style, time a fresh server's replay-to-serving
+wall). One JSON line.
 """
 
 from __future__ import annotations
@@ -320,6 +328,129 @@ def _slo(argv: list[str]) -> None:
         )
 
 
+def _chaos(argv: list[str]) -> None:
+    """The fault-tolerance leg (README "Fault tolerance"): shed rate and
+    p99-under-fault on an overloaded bounded-queue server with injected
+    faults, plus the WAL recovery wall. ``bench.py chaos [--quick]``."""
+    import shutil
+    import tempfile
+    import urllib.request
+
+    import jax
+
+    from benchmarks import loadgen
+    from hdbscan_tpu.fault import inject
+    from hdbscan_tpu.serve.server import ClusterServer
+    from scripts import check_metrics
+
+    duration = 4.0
+    if "--quick" in argv:
+        argv.remove("--quick")
+        duration = 1.5
+    if argv:
+        raise SystemExit(f"bench.py chaos: unknown arguments {argv!r}")
+
+    _, model, params, sampler, fit_wall, n = _synthetic_model()
+
+    # --- fault + overload leg: tiny queue, tiny batches, 12 closed-loop ----
+    # workers of single-row requests >> capacity, plus injected faults. The
+    # leg measures the CONTRACT under stress: refusals are fast 429/503
+    # (shed), failures are clean 5xx (failed), and the served remainder's
+    # p99 stays bounded.
+    plan = inject.install(
+        "slow_request:p=0.08,seed=5,delay_s=0.05"
+        ";predict_dispatch:p=0.03,seed=6"
+        ";http_reset:p=0.02,seed=7"
+    )
+    srv = ClusterServer(
+        model, max_batch=2, port=0, queue_bound=1
+    ).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        chaos = loadgen.run_load(
+            loadgen.http_predict_submitter(base, sampler, timeout=30),
+            mode="closed", concurrency=12, batch_mix=((1, 1.0),),
+            duration_s=duration, warmup_s=min(0.5, duration / 4),
+            expect_shedding=True,
+        )
+        with urllib.request.urlopen(base + "/metrics") as resp:
+            scrape = resp.read().decode()
+    finally:
+        srv.close()
+        inject.clear()
+    _, merrs = check_metrics.validate_exposition(scrape, "chaos")
+    for err in merrs:
+        print(f"[bench] chaos metrics FAIL: {err}", file=sys.stderr)
+    pct = chaos.percentiles()
+    fired = plan.fired()
+
+    # --- WAL recovery microbench: journal a stream, crash, time replay ----
+    wal_dir = tempfile.mkdtemp(prefix="hdbscan-chaos-wal-")
+    leg_params = params.replace(
+        stream_refit_budget=10**9,
+        stream_drift_threshold=1e9,
+        stream_snapshot_every=16,
+    )
+    try:
+        srv1 = ClusterServer(
+            model, max_batch=512, port=0, ingest=True,
+            params=leg_params, wal_dir=wal_dir,
+        )
+        for _ in range(20):
+            srv1.ingest(sampler(256))
+        # Crash-sim: nothing closed or flushed beyond the per-append fsyncs;
+        # only the socket is released so the recovery server can bind.
+        srv1._httpd.server_close()
+        srv2 = ClusterServer(
+            model, max_batch=512, port=0, ingest=True,
+            params=leg_params, wal_dir=wal_dir,
+        )
+        rec = dict(srv2.journal.last_recover or {})
+        rec_rows = srv2.buffer.stats()["rows_seen"]
+        srv2._httpd.server_close()
+        srv2.journal.close()
+    finally:
+        shutil.rmtree(wal_dir, ignore_errors=True)
+    rec_wall = float(rec.get("wall_s", 0.0))
+    print(
+        f"[bench] chaos: offered={chaos.offered} served={chaos.requests} "
+        f"shed={chaos.shed} ({chaos.shed_rate():.1%}) failed={chaos.errors} "
+        f"p99-under-fault={pct['p99_s'] * 1e3 if pct['p99_s'] else 0:.2f}ms "
+        f"faults={fired}; recovery: {rec_rows} rows in {rec_wall * 1e3:.1f}ms",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "serve_chaos_p99_under_fault_ms_synthetic_5k",
+                "value": round((pct["p99_s"] or 0.0) * 1e3, 3),
+                "unit": "ms",
+                "n_train": n,
+                "fit_wall_s": round(fit_wall, 3),
+                "chaos_duration_s": duration,
+                "chaos_concurrency": 12,
+                "chaos_queue_bound": 1,
+                "chaos_offered": chaos.offered,
+                "chaos_requests": chaos.requests,
+                "chaos_shed": chaos.shed,
+                "chaos_shed_rate": chaos.shed_rate(),
+                "chaos_failed": chaos.errors,
+                "chaos_p50_ms": round((pct["p50_s"] or 0.0) * 1e3, 3),
+                "chaos_p99_ms": round((pct["p99_s"] or 0.0) * 1e3, 3),
+                "chaos_faults_injected": fired,
+                "metrics_scrape_errors": len(merrs),
+                "recovery_rows": int(rec_rows),
+                "recovery_records": int(rec.get("records", 0)),
+                "recovery_snapshot": bool(rec.get("snapshot", False)),
+                "recovery_wall_s": round(rec_wall, 6),
+                "recovery_rows_per_s": round(rec_rows / max(rec_wall, 1e-9), 1),
+                "platform": jax.devices()[0].platform,
+                "cpu_smoke": jax.devices()[0].platform != "tpu",
+            }
+        )
+    )
+
+
 def main(argv: list[str] | None = None) -> None:
     import jax
 
@@ -334,6 +465,9 @@ def main(argv: list[str] | None = None) -> None:
     argv_full = list(argv)
     if argv and argv[0] == "slo":
         _slo(argv[1:])
+        return
+    if argv and argv[0] == "chaos":
+        _chaos(argv[1:])
         return
     if "--stream-synthetic" in argv:
         argv.remove("--stream-synthetic")

@@ -20,6 +20,16 @@ the first ``warmup_requests`` requests, whichever bound is given) are
 issued but not recorded, so JIT compilation and connection setup never
 pollute the percentiles.
 
+Shedding-aware accounting (``expect_shedding=True`` / ``--expect-shedding``):
+a server running with a bounded batcher queue deliberately refuses excess
+work with 429/503 + Retry-After. In that regime a refusal is correct
+behavior, not a failure, so rejections whose status is 429/503 count in
+``shed`` while everything else (5xx, socket resets, timeouts) stays in
+``errors`` — and ``offered = requests + shed + errors`` lets the chaos
+suite reconcile the generator's view against the server's
+``requests_shed_total`` metric. With the default ``expect_shedding=False``
+every rejection is an error, exactly as before.
+
 Every recorded latency lands both in a raw list and in a
 ``utils.metrics.Histogram`` with the serving latency buckets; the result
 exposes nearest-rank p50/p99/p999 computed BOTH ways plus
@@ -112,7 +122,16 @@ class LoadResult:
     warmup_requests: int = 0  # issued but excluded
     rows: int = 0  # rows across recorded requests
     errors: int = 0
+    shed: int = 0  # 429/503 refusals (only populated with expect_shedding)
     wall_s: float = 0.0  # measurement window (warmup excluded)
+
+    @property
+    def offered(self) -> int:
+        """Post-warmup requests offered to the server (served+shed+failed)."""
+        return self.requests + self.shed + self.errors
+
+    def shed_rate(self) -> float:
+        return round(self.shed / self.offered, 6) if self.offered else 0.0
 
     def percentiles(self) -> dict:
         """Raw nearest-rank and histogram-derived p50/p99/p999 + mean/max."""
@@ -168,6 +187,7 @@ def run_load(
     warmup_requests: int = 0,
     rate_rps: float | None = None,
     seed: int = 0,
+    expect_shedding: bool = False,
 ) -> LoadResult:
     """Drive ``submit(batch_size) -> rows`` under load and collect latency.
 
@@ -211,7 +231,7 @@ def run_load(
             issued[0] += 1
         return True
 
-    def record(t_sched: float, t_done: float, rows, err: bool) -> None:
+    def record(t_sched: float, t_done: float, rows, exc) -> None:
         in_warmup = t_sched < warmup_until
         with lock:
             if in_warmup:
@@ -223,8 +243,12 @@ def run_load(
                 if result.warmup_requests < warmup_requests:
                     result.warmup_requests += 1
                     return
-            if err:
-                result.errors += 1
+            if exc is not None:
+                status = getattr(exc, "code", None) or getattr(exc, "status", None)
+                if expect_shedding and status in (429, 503):
+                    result.shed += 1
+                else:
+                    result.errors += 1
                 return
             lat = t_done - t_sched
             result.latencies.append(lat)
@@ -235,11 +259,10 @@ def run_load(
     def one_request(t_sched: float) -> None:
         size = pick()
         try:
-            rows = submit(size)
-            err = False
-        except Exception:
-            rows, err = 0, True
-        record(t_sched, time.perf_counter(), rows, err)
+            rows, exc = submit(size), None
+        except Exception as e:
+            rows, exc = 0, e
+        record(t_sched, time.perf_counter(), rows, exc)
 
     if mode == "closed":
 
@@ -279,22 +302,43 @@ def run_load(
     return result
 
 
-def http_predict_submitter(base_url: str, sampler, timeout: float = 30.0):
+def http_predict_submitter(base_url: str, sampler, timeout: float = 30.0,
+                           headers=None, retry_attempts: int = 0):
     """Build a ``submit(k) -> rows`` posting ``{"points": sampler(k)}`` to
-    ``POST /predict``. ``sampler(k)`` returns a (k, dim) array-like."""
-    url = base_url.rstrip("/") + "/predict"
+    ``POST /predict``. ``sampler(k)`` returns a (k, dim) array-like.
 
-    def submit(k: int) -> int:
+    ``headers`` adds extra request headers (e.g. ``X-Deadline-Ms``).
+    ``retry_attempts > 0`` resubmits requests the server shed with 429/503
+    — capped exponential backoff via ``fault.policy.retry_call`` — so a
+    polite client rides out a transient overload instead of reporting it.
+    """
+    url = base_url.rstrip("/") + "/predict"
+    extra = dict(headers or {})
+
+    def once(k: int) -> int:
         points = sampler(k)
         body = json.dumps(
             {"points": [list(map(float, row)) for row in points]}
         ).encode()
         req = urllib.request.Request(
-            url, data=body, headers={"Content-Type": "application/json"}
+            url, data=body,
+            headers={"Content-Type": "application/json", **extra},
         )
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             out = json.loads(resp.read())
         return len(out["labels"])
+
+    if retry_attempts <= 0:
+        return once
+
+    from hdbscan_tpu.fault.policy import retry_call
+
+    def submit(k: int) -> int:
+        return retry_call(
+            lambda: once(k),
+            attempts=retry_attempts + 1, base_s=0.02, cap_s=0.5, seed=k,
+            should_retry=lambda e: getattr(e, "code", None) in (429, 503),
+        )
 
     return submit
 
@@ -321,6 +365,10 @@ def main(argv=None) -> int:
     ap.add_argument("--mix", type=_parse_mix, default=DEFAULT_MIX)
     ap.add_argument("--dim", type=int, default=3)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--expect-shedding", action="store_true",
+        help="count 429/503 refusals as shed load, not errors",
+    )
     args = ap.parse_args(argv)
 
     rng = np.random.default_rng(args.seed)
@@ -337,6 +385,7 @@ def main(argv=None) -> int:
         warmup_s=args.warmup,
         rate_rps=args.rate if args.mode == "open" else None,
         seed=args.seed,
+        expect_shedding=args.expect_shedding,
     )
     print(
         json.dumps(
@@ -344,6 +393,9 @@ def main(argv=None) -> int:
                 "mode": result.mode,
                 "requests": result.requests,
                 "errors": result.errors,
+                "shed": result.shed,
+                "offered": result.offered,
+                "shed_rate": result.shed_rate(),
                 "rows_per_s": result.rows_per_s(),
                 "wall_s": result.wall_s,
                 "latency": result.percentiles(),

@@ -71,7 +71,9 @@ invocation still means ``fit`` (the reference-compatible form above)::
         [predict_backend=...] [predict_batch=N] [--trace-out PATH] \
         [--report PATH] [--ingest] [--model-dir DIR] \
         [absorb_eps=F] [drift_stat={psi,ks}] [drift_threshold=F] \
-        [refit_budget=N] [stream_reload={auto,manual}] [trace_max_events=N]
+        [refit_budget=N] [stream_reload={auto,manual}] [trace_max_events=N] \
+        [queue_bound=N] [deadline_ms=F] [faults=SPEC] [circuit_failures=N] \
+        [circuit_reset=F] [wal_dir=DIR] [snapshot_every=N]
 
 ``fit --model-out`` persists the fitted clustering as one atomic
 schema-versioned ``.npz`` (``serve/artifact.ClusterModel``); ``predict``
@@ -101,6 +103,19 @@ drift or ``refit_budget`` buffered novel rows a background re-fit publishes
 a new artifact under ``--model-dir`` that hot-swaps in atomically
 (``stream_reload=auto``; ``manual`` stages it for ``POST /swap``). SIGTERM
 drains in-flight requests before exiting.
+
+Fault tolerance (README "Fault tolerance"): ``queue_bound=N`` bounds the
+micro-batcher queue (excess requests are shed with 429/503 + Retry-After;
+0 = unbounded), ``deadline_ms=F`` gives every request a default deadline
+(clients override per-request via the ``X-Deadline-Ms`` header; expired
+requests fail fast with 504 instead of occupying a batch slot),
+``circuit_failures``/``circuit_reset`` tune the breaker that pins the
+served generation after repeated re-fit failures, and ``wal_dir=DIR``
+makes ``/ingest`` crash-safe: every accepted chunk is fsync'd to a JSONL
+write-ahead log (snapshotted every ``snapshot_every`` appends) and
+replayed bit-identically on restart. ``faults=SPEC`` (or the
+``HDBSCAN_TPU_FAULTS`` env var) installs the deterministic fault-injection
+harness — see ``hdbscan_tpu/fault/inject.py`` for the spec grammar.
 """
 
 from __future__ import annotations

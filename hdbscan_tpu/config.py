@@ -292,6 +292,36 @@ class HDBSCANParams:
     #: What happens when a re-fit publishes an artifact: "auto" hot-swaps it
     #: in (blue/green), "manual" stages it for an operator ``POST /swap``.
     stream_reload: str = "auto"
+    #: Bound on the serving micro-batcher's request queue (``serve`` CLI /
+    #: ``ClusterServer``): a submit arriving with this many requests already
+    #: queued is refused with HTTP 503 + Retry-After (load shedding) instead
+    #: of queueing unboundedly — under sustained overload the server sheds
+    #: rather than growing an unservable backlog. 0 = unbounded (the
+    #: pre-fault-layer behavior).
+    serve_queue_bound: int = 1024
+    #: Server-wide default request deadline in milliseconds (0 = none; the
+    #: ``X-Deadline-Ms`` request header overrides per request). A request
+    #: past its deadline fails fast with HTTP 504 — at enqueue or at batch
+    #: assembly — instead of occupying a batch slot.
+    serve_deadline_ms: float = 0.0
+    #: Fault-injection spec for the chaos harness (``hdbscan_tpu/fault``):
+    #: ``site:key=val,...;site2:...`` clauses (see fault/inject.py for the
+    #: grammar and site names). "" = no injection; the
+    #: ``HDBSCAN_TPU_FAULTS`` environment variable is the fallback source.
+    fault_spec: str = ""
+    #: Consecutive refit/swap failures that trip the refit circuit breaker
+    #: open (the server then degrades to serving the pinned generation).
+    circuit_failures: int = 3
+    #: Seconds an open refit circuit waits before allowing a half-open
+    #: trial re-fit.
+    circuit_reset_s: float = 30.0
+    #: Crash-safe stream durability (``stream/wal.StreamJournal``): journal
+    #: directory for the fsync'd ingest WAL + periodic state snapshots.
+    #: "" disables (ingest state is lost on crash, the pre-WAL behavior).
+    stream_wal_dir: str = ""
+    #: Ingest WAL appends between state snapshots (each snapshot truncates
+    #: the WAL, bounding recovery replay).
+    stream_snapshot_every: int = 64
     #: Bound on the Tracer's in-memory event list (0 = unbounded). Sinks
     #: (the on-disk JSONL trace) always see every event; the bound only
     #: rings the in-memory view so a long-running ``serve --ingest``
@@ -398,6 +428,33 @@ class HDBSCANParams:
                 "stream_reload must be 'auto' or 'manual', "
                 f"got {self.stream_reload!r}"
             )
+        if self.serve_queue_bound < 0:
+            raise ValueError(
+                "serve_queue_bound must be >= 0 (0 = unbounded), "
+                f"got {self.serve_queue_bound!r}"
+            )
+        if self.serve_deadline_ms < 0:
+            raise ValueError(
+                "serve_deadline_ms must be >= 0 (0 = no deadline), "
+                f"got {self.serve_deadline_ms!r}"
+            )
+        if self.fault_spec:
+            from hdbscan_tpu.fault.inject import parse_spec
+
+            parse_spec(self.fault_spec)  # eager validation: bad specs fail here
+        if self.circuit_failures < 1:
+            raise ValueError(
+                f"circuit_failures must be >= 1, got {self.circuit_failures!r}"
+            )
+        if not self.circuit_reset_s > 0:
+            raise ValueError(
+                f"circuit_reset_s must be > 0, got {self.circuit_reset_s!r}"
+            )
+        if self.stream_snapshot_every < 1:
+            raise ValueError(
+                "stream_snapshot_every must be >= 1, "
+                f"got {self.stream_snapshot_every!r}"
+            )
         if self.trace_max_events < 0:
             raise ValueError(
                 "trace_max_events must be >= 0 (0 = unbounded), "
@@ -496,6 +553,13 @@ FLAG_FIELDS = {
     "drift_threshold": ("stream_drift_threshold", float),
     "refit_budget": ("stream_refit_budget", int),
     "stream_reload": ("stream_reload", str),
+    "queue_bound": ("serve_queue_bound", int),
+    "deadline_ms": ("serve_deadline_ms", float),
+    "faults": ("fault_spec", str),
+    "circuit_failures": ("circuit_failures", int),
+    "circuit_reset": ("circuit_reset_s", float),
+    "wal_dir": ("stream_wal_dir", str),
+    "snapshot_every": ("stream_snapshot_every", int),
     "trace_max_events": ("trace_max_events", int),
     "max_samples": ("max_samples", int),
     "compat_cf": ("compat_cf_int_math", _bool),

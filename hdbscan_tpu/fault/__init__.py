@@ -1,0 +1,41 @@
+"""Fault-tolerance layer: deterministic fault injection + resilience policy.
+
+MR-HDBSCAN* inherits fault tolerance from MapReduce/Spark lineage
+re-execution for free; the TPU-native serving port has to earn it
+explicitly. This package supplies the two halves:
+
+- ``fault/inject.py`` — a deterministic fault-injection harness: named
+  sites across the serving/streaming stack (predictor device dispatch,
+  artifact save/load, refit fit-crash, batcher submit, HTTP socket resets,
+  slow-request stalls) fire with per-site probability/count/seed from the
+  ``HDBSCAN_TPU_FAULTS`` spec, emitting ``fault_injected`` trace events so
+  every injected failure is accounted for in the trace and metrics.
+- ``fault/policy.py`` — the resilience policies the chaos suite exercises:
+  per-request deadlines (``DeadlineExceeded`` → 504), bounded-queue load
+  shedding (``ShedRequest`` → 429/503 + Retry-After), capped exponential
+  backoff with jitter (``retry_call``/``retry``), and a ``CircuitBreaker``
+  that trips after repeated failures and degrades to the pinned model
+  generation.
+
+Stdlib-only on purpose: injection sites live on serving hot paths, and the
+no-fault fast path is a single module-attribute check.
+"""
+
+from hdbscan_tpu.fault.inject import (  # noqa: F401
+    ENV_VAR,
+    FAULT_SITES,
+    FaultPlan,
+    InjectedFault,
+    clear,
+    install,
+    maybe_fire,
+    parse_spec,
+)
+from hdbscan_tpu.fault.policy import (  # noqa: F401
+    CircuitBreaker,
+    DeadlineExceeded,
+    ShedRequest,
+    backoff_s,
+    retry,
+    retry_call,
+)

@@ -34,6 +34,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from hdbscan_tpu.fault import inject
 from hdbscan_tpu.utils.checkpoint import _data_digest
 
 #: Version tag carried by every model artifact. Bump the integer suffix on
@@ -249,7 +250,23 @@ class ClusterModel:
                     eps_max=self.eps_max,
                     **extra,
                 )
+            # Fault sites for the chaos suite: a "torn" save crashes between
+            # the tempfile write and the atomic rename — proving a crashed
+            # publish leaves no partial artifact where a server could load
+            # it; "digest" corrupts the published bytes so load's stored-
+            # digest check must catch them.
+            act = inject.maybe_fire("artifact_save")
+            if act is not None and act.mode != "digest":
+                raise inject.InjectedFault(
+                    "injected artifact_save crash before publish rename"
+                )
             os.replace(tmp, path)
+            if act is not None and act.mode == "digest":
+                with open(path, "r+b") as f:
+                    f.seek(-1, os.SEEK_END)
+                    last = f.read(1)[0]
+                    f.seek(-1, os.SEEK_END)
+                    f.write(bytes([last ^ 0xFF]))
         finally:
             if os.path.exists(tmp):
                 os.unlink(tmp)
@@ -268,6 +285,8 @@ class ClusterModel:
         serve config X with a model fitted under config Y must refuse, the
         ``utils/checkpoint.load_latest`` stance).
         """
+        if inject.maybe_fire("artifact_load") is not None:
+            raise inject.InjectedFault("injected transient artifact_load fault")
         with np.load(path) as z:
             meta = json.loads(bytes(z["meta"]).decode())
             schema = meta.get("schema")

@@ -22,6 +22,9 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from hdbscan_tpu.fault import inject
+from hdbscan_tpu.fault.policy import DeadlineExceeded, ShedRequest
+
 
 class MicroBatcher:
     """Coalesce concurrent predict requests into bucket-sized batches.
@@ -34,18 +37,24 @@ class MicroBatcher:
       max_rows: dispatch ceiling per coalesced batch — defaults to the
         predictor's largest bucket, so a coalesced batch is exactly one
         device program.
+      max_queue: bound on queued (undispatched) requests; a submit over the
+        bound raises :class:`ShedRequest` (HTTP 503 + Retry-After) instead
+        of queueing unboundedly. 0 = unbounded (the historical behavior).
     """
 
     def __init__(self, predictor, linger_s: float = 0.002,
-                 max_rows: int | None = None):
+                 max_rows: int | None = None, max_queue: int = 0):
         self.predictor = predictor
         self.linger_s = float(linger_s)
         self.max_rows = int(max_rows or predictor.max_bucket)
+        self.max_queue = int(max_queue or 0)
         self._q: queue.Queue = queue.Queue()
         self._closed = False
         self._close_lock = threading.Lock()  # orders submit() vs close()
         self._batches = 0
         self._rows = 0
+        self._shed = 0
+        self._deadline_drops = 0
         self._worker = threading.Thread(
             target=self._run, name="predict-batcher", daemon=True
         )
@@ -62,10 +71,22 @@ class MicroBatcher:
         resolves (the resolution is the happens-before edge) with the span
         attribution the server's ``request_span`` event needs: perf_counter
         marks ``t_assembled``/``t_dispatch``/``t_done``, the dispatched
-        ``bucket``, the ``coalesced`` peer count, and ``batch_rows``."""
+        ``bucket``, the ``coalesced`` peer count, and ``batch_rows``.
+
+        ``meta['deadline']`` (a ``time.perf_counter`` instant) makes the
+        request deadline-aware: an already-expired deadline raises
+        :class:`DeadlineExceeded` here, and one that expires while queued
+        fails the future the same way before dispatch — an expired request
+        never occupies a batch slot.
+        """
         X = np.asarray(X, np.float64)
         if X.ndim == 1:
             X = X[None, :]
+        if inject.maybe_fire("batcher_submit") is not None:
+            raise inject.InjectedFault("injected batcher_submit fault")
+        deadline = meta.get("deadline") if meta else None
+        if deadline is not None and time.perf_counter() > deadline:
+            raise DeadlineExceeded("request deadline passed before enqueue")
         fut: Future = Future()
         # The close lock orders this put against close()'s sentinel: every
         # accepted future lands ahead of the sentinel in the FIFO queue, so
@@ -74,6 +95,14 @@ class MicroBatcher:
         with self._close_lock:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
+            if self.max_queue and self._q.qsize() >= self.max_queue:
+                self._shed += 1
+                raise ShedRequest(
+                    f"batcher queue at bound ({self.max_queue})",
+                    status=503,
+                    retry_after_s=max(0.01, self.linger_s * 2),
+                    reason="queue_full",
+                )
             self._q.put((X, fut, meta))
         return fut
 
@@ -83,9 +112,14 @@ class MicroBatcher:
 
     @property
     def stats(self) -> dict:
-        """{'batches': dispatches so far, 'rows': rows served} — the
-        coalescing ratio is rows/batches."""
-        return {"batches": self._batches, "rows": self._rows}
+        """Dispatch counters — the coalescing ratio is rows/batches; shed
+        and deadline_drops count load-shedding outcomes."""
+        return {
+            "batches": self._batches,
+            "rows": self._rows,
+            "shed": self._shed,
+            "deadline_drops": self._deadline_drops,
+        }
 
     # -- worker side -------------------------------------------------------
 
@@ -112,6 +146,23 @@ class MicroBatcher:
 
     def _dispatch(self, batch) -> None:
         t_assembled = time.perf_counter()  # linger window closed; batch fixed
+        # Deadline fail-fast: a request whose deadline already passed gets a
+        # DeadlineExceeded (the server maps it to 504) instead of a batch
+        # slot — under overload, expired work must not displace live work.
+        live = []
+        for item in batch:
+            m = item[2]
+            dl = m.get("deadline") if m else None
+            if dl is not None and t_assembled > dl:
+                self._deadline_drops += 1
+                item[1].set_exception(
+                    DeadlineExceeded("request deadline passed before dispatch")
+                )
+            else:
+                live.append(item)
+        if not live:
+            return
+        batch = live
         xs = [x for x, _, _ in batch]
         futs = [f for _, f, _ in batch]
         try:

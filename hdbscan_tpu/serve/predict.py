@@ -48,6 +48,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hdbscan_tpu.fault import inject
 from hdbscan_tpu.ops.tiled import (
     _knn_core_scan,
     _next_pow2,
@@ -476,6 +477,8 @@ class Predictor:
             return self._predict_locked(X, with_membership)
 
     def _predict_locked(self, X: np.ndarray, with_membership: bool):
+        if inject.maybe_fire("predict_dispatch") is not None:
+            raise inject.InjectedFault("injected predict_dispatch fault")
         n = len(X)
         chunks = []
         a = 0

@@ -23,16 +23,28 @@ Endpoints:
   batch-size histograms, swap/refit/drift counters, ingest absorb
   counters. ``scripts/check_metrics.py`` validates the output.
 
-Per-request spans: every successful ``/predict``/``/ingest`` request gets
-a process-unique request id (echoed as ``X-Request-Id``) and, when a
-tracer is attached, a ``request_span`` trace event decomposing its wall
-into parse / queue-wait / batch-assembly / device-predict / respond
-segments, with rows, pow2 bucket, coalesced-peer count and model
-generation attributed. The segment timestamps are contiguous
-``perf_counter`` marks threaded through the batcher via a per-request
-``meta`` dict (filled by the worker before the Future resolves), so the
-five segments telescope exactly to the span wall —
-``scripts/check_trace.py`` enforces the sum within 1e-6.
+Per-request spans: every terminated ``/predict``/``/ingest`` request —
+success or error — gets a process-unique request id (echoed as
+``X-Request-Id``) and, when a tracer is attached, exactly one trace event:
+a ``request_shed`` (when the bounded batcher queue refused it with
+429/503 + Retry-After) or a ``request_span`` carrying the HTTP ``status``
+and decomposing its wall into parse / queue-wait / batch-assembly /
+device-predict / respond segments, with rows, pow2 bucket, coalesced-peer
+count and model generation attributed. The segment timestamps are
+contiguous ``perf_counter`` marks threaded through the batcher via a
+per-request ``meta`` dict (filled by the worker before the Future
+resolves), so the five segments telescope exactly to the span wall —
+``scripts/check_trace.py`` enforces the sum within 1e-6 and that
+shed + served + failed accounts for every offered request.
+
+Fault tolerance (README "Fault tolerance"): per-request deadlines
+(``X-Deadline-Ms`` header / ``serve_deadline_ms`` knob → 504 fail-fast
+before a batch slot is spent), bounded-queue load shedding
+(``serve_queue_bound``), a refit circuit breaker that degrades to the
+pinned generation after repeated refit/swap failures, optional crash-safe
+ingest durability (``stream_wal_dir`` → ``stream/wal.StreamJournal``),
+and the ``HDBSCAN_TPU_FAULTS`` injection harness
+(``hdbscan_tpu/fault/``) for chaos testing all of the above.
 
 Blue/green serving: every model lives in an immutable ``_ModelHandle``
 (model + warmed predictor + its own MicroBatcher + generation number).
@@ -64,6 +76,14 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from hdbscan_tpu.fault import inject
+from hdbscan_tpu.fault.policy import (
+    CIRCUIT_STATE_VALUES,
+    CircuitBreaker,
+    DeadlineExceeded,
+    ShedRequest,
+    retry_call,
+)
 from hdbscan_tpu.serve.artifact import _FINGERPRINT_FIELDS, ClusterModel
 from hdbscan_tpu.serve.batcher import MicroBatcher
 from hdbscan_tpu.serve.predict import Predictor
@@ -168,20 +188,35 @@ class _Handler(BaseHTTPRequestHandler):
         t0 = time.perf_counter()
         srv._m_in_flight.inc()
         code = 500
-        span = None
+        rid = srv.next_request_id()
+        # meta is filled across threads (batcher worker) with the span
+        # timestamps; the Future resolution inside predict/ingest is the
+        # happens-before edge that publishes it back to this thread.
+        meta: dict = {}
+        rows = 0
+        generation = int(srv.generation)
+        shed_reason = None  # set when the request was load-shed (429/503)
         try:
+            act = inject.maybe_fire("slow_request")
+            if act is not None:
+                time.sleep(act.delay_s)
+            if inject.maybe_fire("http_reset") is not None:
+                # Simulated socket reset: drop the connection without a
+                # response. 499 (client-saw-reset) keeps the status label
+                # numeric — health() folds int(status) >= 400 into errors.
+                code = 499
+                self.close_connection = True
+                return
             try:
                 payload = self._read_payload()
             except (ValueError, TypeError, json.JSONDecodeError) as e:
                 code = 400
                 self._json(code, {"error": f"bad request: {e}"})
                 return
-            # meta is filled across threads (batcher worker) with the span
-            # timestamps; the Future resolution inside predict/ingest is the
-            # happens-before edge that publishes it back to this thread.
-            meta: dict = {}
-            rid = srv.next_request_id()
             try:
+                deadline = srv.request_deadline(self.headers, t0)
+                if deadline is not None:
+                    meta["deadline"] = deadline
                 if path == "/predict":
                     points = np.asarray(payload["points"], np.float64)
                     meta["t_parse"] = time.perf_counter()
@@ -196,11 +231,26 @@ class _Handler(BaseHTTPRequestHandler):
                     rows = out["rows"]
                 elif path == "/swap":
                     out = srv.swap(payload.get("path"))
-                    rows = 0
                 else:
                     code = 404
                     self._json(code, {"error": f"unknown path {self.path!r}"})
                     return
+            except ShedRequest as e:  # bounded-queue load shedding
+                code = e.status
+                shed_reason = e.reason
+                self._json(
+                    code,
+                    {"error": str(e), "reason": e.reason},
+                    headers={
+                        "Retry-After": f"{max(e.retry_after_s, 0.001):.3f}",
+                        "X-Request-Id": rid,
+                    },
+                )
+                return
+            except DeadlineExceeded as e:  # fail fast, no batch slot spent
+                code = 504
+                self._json(code, {"error": str(e)}, headers={"X-Request-Id": rid})
+                return
             except KeyError as e:
                 code = 400
                 self._json(code, {"error": f"bad request: missing {e}"})
@@ -218,15 +268,24 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(code, {"error": f"{type(e).__name__}: {e}"})
                 return
             code = 200
+            generation = int(out.get("generation", generation))
             self._json(code, out, headers={"X-Request-Id": rid})
-            if path in ("/predict", "/ingest"):
-                span = (path, rid, rows, int(out.get("generation", 0)), meta)
         finally:
             t_end = time.perf_counter()
             srv._m_in_flight.dec()
             srv._observe_request(path if known else "other", code, t_end - t0)
-            if span is not None:
-                srv._emit_request_span(*span, t0=t0, t_end=t_end)
+            # Accounting contract (check_trace): every /predict and /ingest
+            # request terminates in exactly one request_shed (load shed) or
+            # one request_span (any other outcome, success or error) —
+            # shed + served + failed == offered.
+            if path in ("/predict", "/ingest"):
+                if shed_reason is not None:
+                    srv._emit_request_shed(path, rid, code, shed_reason)
+                else:
+                    srv._emit_request_span(
+                        path, rid, rows, generation, meta,
+                        t0=t0, t_end=t_end, status=code,
+                    )
 
 
 class ClusterServer:
@@ -261,6 +320,10 @@ class ClusterServer:
         ingest: bool = False,
         params=None,
         model_dir: str | None = None,
+        queue_bound: int | None = None,
+        deadline_ms: float | None = None,
+        wal_dir: str | None = None,
+        fault_spec: str | None = None,
     ):
         self.tracer = tracer
         self._backend_req = backend
@@ -275,6 +338,29 @@ class ClusterServer:
         # Distinguishes servers sharing one trace file: check_trace enforces
         # monotonic swap generations per (process, server).
         self._server_id = f"{os.getpid():x}.{id(self) & 0xFFFFFF:06x}"
+
+        def knob(name, default):
+            return getattr(params, name, default) if params is not None else default
+
+        # Resilience knobs: explicit ctor args win, then params, then the
+        # permissive defaults (unbounded queue, no deadline) that keep
+        # embedded/test servers at the historical behavior.
+        self._queue_bound = int(
+            queue_bound if queue_bound is not None else knob("serve_queue_bound", 0)
+        )
+        self._deadline_ms = float(
+            deadline_ms if deadline_ms is not None else knob("serve_deadline_ms", 0.0)
+        )
+        self._wal_dir = wal_dir or str(knob("stream_wal_dir", "") or "")
+
+        # Fault harness: an explicit/config spec installs the process plan;
+        # either way an already-installed plan (e.g. a chaos test's) gets
+        # this server's tracer and fault counter attached.
+        spec = fault_spec if fault_spec is not None else str(knob("fault_spec", "") or "")
+        if not spec:
+            spec = os.environ.get(inject.ENV_VAR, "").strip()
+        if spec:
+            inject.install(spec, tracer=tracer)
 
         # Metrics registry must exist before the first handle: the predictor
         # observes its batch histograms through it.
@@ -305,6 +391,21 @@ class ClusterServer:
             "hdbscan_tpu_uptime_seconds",
             "Seconds since server construction.",
         )
+        self._m_shed = self.metrics.counter(
+            "hdbscan_tpu_requests_shed_total",
+            "HTTP requests refused to shed load, by route and reason.",
+            labelnames=("route", "reason"),
+        )
+        self._m_faults = self.metrics.counter(
+            "hdbscan_tpu_faults_injected_total",
+            "Injected faults fired (fault harness), by site.",
+            labelnames=("site",),
+        )
+        plan = inject.plan()
+        if plan is not None:
+            if plan.tracer is None and tracer is not None:
+                plan.tracer = tracer
+            plan.add_on_fire(self._on_fault_fire)
 
         self._handle = self._build_handle(model, generation=1)
         self._m_generation.set(1.0)
@@ -322,10 +423,32 @@ class ClusterServer:
         self._thread: threading.Thread | None = None
         self._serving = False  # a serve_forever loop is (or was) running
 
+    # -- fault wiring ------------------------------------------------------
+
+    def _on_fault_fire(self, site: str, spec, nth: int) -> None:
+        """Fault-plan hook: count every injected fault so /metrics accounts
+        for each one the harness fires."""
+        self._m_faults.inc(site=site)
+
+    def _on_circuit_state(self, name: str, state: str) -> None:
+        self._m_circuit.set(float(CIRCUIT_STATE_VALUES[state]), name=name)
+
+    def _on_refit_result(self, ok: bool, error: str | None) -> None:
+        """Refitter outcome hook → the refit circuit breaker."""
+        if ok:
+            self._refit_circuit.record_success()
+        else:
+            self._refit_circuit.record_failure()
+
     # -- stream wiring -----------------------------------------------------
 
     def _init_stream(self, params, model_dir) -> None:
-        from hdbscan_tpu.stream import DriftDetector, IngestBuffer, Refitter
+        from hdbscan_tpu.stream import (
+            DriftDetector,
+            IngestBuffer,
+            Refitter,
+            StreamJournal,
+        )
 
         def knob(name, default):
             return getattr(params, name, default) if params is not None else default
@@ -357,6 +480,22 @@ class ClusterServer:
             threshold=self._drift_threshold,
             tracer=self.tracer,
         )
+        # Refit circuit breaker: repeated fit/swap failures trip it open and
+        # the server degrades to serving the pinned generation — no refit
+        # kicks until reset_s has elapsed (state in /healthz + /metrics).
+        self._m_circuit = self.metrics.gauge(
+            "hdbscan_tpu_circuit_state",
+            "Circuit breaker state (0 closed, 1 half-open, 2 open).",
+            labelnames=("name",),
+        )
+        self._m_circuit.set(0.0, name="refit")
+        self._refit_circuit = CircuitBreaker(
+            "refit",
+            failures=int(knob("circuit_failures", 3)),
+            reset_s=float(knob("circuit_reset_s", 30.0)),
+            tracer=self.tracer,
+            on_state=self._on_circuit_state,
+        )
         refit_params = self._refit_params(params)
         self.refitter = Refitter(
             refit_params,
@@ -364,7 +503,24 @@ class ClusterServer:
             tracer=self.tracer,
             on_publish=self._on_publish,
             metrics=self.metrics,
+            on_result=self._on_refit_result,
         )
+        # Crash-safe durability: recover buffer/drift state from the WAL
+        # directory (if it belongs to this model's digest), then keep
+        # journaling every accepted ingest batch.
+        self.journal = None
+        if self._wal_dir:
+            self.journal = StreamJournal(
+                self._wal_dir,
+                snapshot_every=int(knob("stream_snapshot_every", 64)),
+                tracer=self.tracer,
+                metrics=self.metrics,
+            )
+            self.journal.open(
+                str(self.model.fingerprint.get("data") or ""),
+                self.buffer,
+                self.drift,
+            )
 
     def _refit_params(self, params):
         """Re-fit params: caller's knobs where given, but the fingerprint
@@ -385,7 +541,9 @@ class ClusterServer:
             tracer=self.tracer, metrics=self.metrics,
         )
         warmup_info = predictor.warmup() if self._warmup else None
-        batcher = MicroBatcher(predictor, linger_s=self._linger_s)
+        batcher = MicroBatcher(
+            predictor, linger_s=self._linger_s, max_queue=self._queue_bound
+        )
         return _ModelHandle(model, predictor, batcher, generation, warmup_info)
 
     @property
@@ -418,15 +576,47 @@ class ClusterServer:
         self._m_requests.inc(route=route, status=str(status))
         self._m_latency.observe(wall, route=route)
 
+    def request_deadline(self, headers, t0: float) -> float | None:
+        """Resolve the request's deadline (a perf_counter instant) from the
+        ``X-Deadline-Ms`` header, falling back to the server-wide
+        ``serve_deadline_ms`` default; None when neither applies."""
+        raw = headers.get("X-Deadline-Ms")
+        if raw is not None:
+            try:
+                ms = float(raw)
+            except ValueError:
+                raise ValueError(f"bad X-Deadline-Ms header: {raw!r}") from None
+            if ms <= 0:
+                raise ValueError(f"X-Deadline-Ms must be > 0, got {raw!r}")
+            return t0 + ms / 1000.0
+        if self._deadline_ms > 0:
+            return t0 + self._deadline_ms / 1000.0
+        return None
+
+    def _emit_request_shed(self, route, rid, status, reason) -> None:
+        """Account one load-shed request: counter always, trace when a
+        tracer is attached (``request_shed`` — check_trace counts these
+        against request_span ids so shed+served+failed == offered)."""
+        self._m_shed.inc(route=route, reason=str(reason))
+        if self.tracer is not None:
+            self.tracer(
+                "request_shed",
+                request_id=rid,
+                route=route,
+                status=int(status),
+                reason=str(reason),
+            )
+
     def _emit_request_span(
-        self, route, rid, rows, generation, meta, t0, t_end
+        self, route, rid, rows, generation, meta, t0, t_end, status=200
     ) -> None:
-        """Emit one ``request_span`` trace event for a successful
-        ``/predict``/``/ingest`` request. The five segments are contiguous
-        perf_counter diffs (clamped monotone into [t0, t_end]) so they
-        telescope exactly to the span wall; 9-decimal rounding keeps the
-        telescoped sum inside check_trace's 1e-6 tolerance, which 6
-        decimals would not."""
+        """Emit one ``request_span`` trace event for a terminated
+        ``/predict``/``/ingest`` request — successes and errors alike
+        (``status`` carries the HTTP code, so error latency is visible in
+        the trace). The five segments are contiguous perf_counter diffs
+        (clamped monotone into [t0, t_end]) so they telescope exactly to
+        the span wall; 9-decimal rounding keeps the telescoped sum inside
+        check_trace's 1e-6 tolerance, which 6 decimals would not."""
         if self.tracer is None:
             return
         t_parse = min(max(t0, meta.get("t_parse", t0)), t_end)
@@ -441,6 +631,7 @@ class ClusterServer:
             "request_span",
             request_id=rid,
             route=route,
+            status=int(status),
             rows=int(rows),
             bucket=int(bucket),
             coalesced=int(meta.get("coalesced", 1)),
@@ -537,6 +728,12 @@ class ClusterServer:
         with self._ingest_lock:
             absorbed, buffered = self.buffer.absorb(points, labels, prob)
             self.drift.update(labels, score)
+            if self.journal is not None:
+                # Write-ahead relative to the HTTP ack: the batch (with its
+                # predicted labels/prob/scores, so replay never re-predicts)
+                # is fsync'd before the 200 goes out.
+                self.journal.append_ingest(points, labels, prob, score)
+                self.journal.maybe_snapshot(self.buffer, self.drift)
             check = self.drift.check(generation=handle.generation)
             self._m_drift_checks.inc()
             if check["drifted"]:
@@ -547,7 +744,15 @@ class ClusterServer:
             elif self.buffer.buffered_rows >= self._refit_budget:
                 trigger = "budget"
             refit_started = False
-            if trigger and self.pending is None and not self.refitter.busy:
+            # Circuit gate: after repeated refit/swap failures the breaker
+            # is open and triggers are suppressed — the server degrades to
+            # serving the pinned generation instead of burning fit cycles.
+            if (
+                trigger
+                and self.pending is None
+                and not self.refitter.busy
+                and self._refit_circuit.allow()
+            ):
                 pool = self.buffer.refit_points(
                     originals=min(self.model.n_train, 8192)
                 )
@@ -585,6 +790,7 @@ class ClusterServer:
             self.swap_model(model, reason=reason, path=path)
         except Exception as exc:  # guard failure: keep serving the old model
             self.last_swap = {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
+            self._refit_circuit.record_failure()
 
     def swap(self, path: str | None = None) -> dict:
         """HTTP-facing swap: explicit artifact ``path``, else the staged
@@ -610,7 +816,15 @@ class ClusterServer:
         """
         if isinstance(model_or_path, (str, os.PathLike)):
             path = str(model_or_path)
-            new_model = ClusterModel.load(path)  # schema + digest guard
+            # Schema + digest guard; transient IO faults retry with backoff
+            # (permanent refusals — corrupt digest, fingerprint mismatch —
+            # raise ValueError and are not retried).
+            new_model = retry_call(
+                lambda: ClusterModel.load(path),
+                attempts=3, base_s=0.05, cap_s=0.5, seed=0,
+                retry_on=(OSError, inject.InjectedFault),
+                tracer=self.tracer, name="artifact_load",
+            )
         else:
             new_model = model_or_path
         old_model = self._handle.model
@@ -654,6 +868,10 @@ class ClusterServer:
                     )
                 )
                 self.pending = None
+                if self.journal is not None:
+                    # The old generation's stream state was consumed by the
+                    # refit; re-key the journal to the new digest.
+                    self.journal.restart(str(new_handle.digest or ""))
         info = {
             "ok": True,
             "generation": int(new_handle.generation),
@@ -717,9 +935,15 @@ class ClusterServer:
                 "refits_ok": self.refitter.refits_ok,
                 "refits_failed": self.refitter.refits_failed,
                 "refit_busy": self.refitter.busy,
+                "refit_last_error": self.refitter.last_error,
+                "refit_last_error_at": self.refitter.last_error_at,
+                "refit_backoff_s": round(self.refitter.backoff_remaining_s(), 3),
+                "circuit": self._refit_circuit.state_info(),
                 "reload": self.reload_mode,
                 "pending": self.pending,
             }
+            if self.journal is not None:
+                out["stream"]["wal"] = self.journal.stats()
         return out
 
     # -- lifecycle ---------------------------------------------------------
@@ -770,6 +994,8 @@ class ClusterServer:
         self._handle.batcher.close()
         if self.ingest_enabled:
             self.refitter.join(timeout=0.5)  # daemon thread; don't block long
+            if self.journal is not None:
+                self.journal.close()
 
     def __enter__(self) -> "ClusterServer":
         return self

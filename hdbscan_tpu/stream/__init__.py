@@ -23,8 +23,13 @@ pieces layered on the predict path:
   reservoir of original training rows) and publishes a new schema-versioned
   artifact for the server to hot-swap (``serve/server.py`` blue/green
   handles — README "Streaming").
+- ``stream/wal.py`` — :class:`StreamJournal`: crash-safe durability for
+  buffer + drift state via an fsync'd JSONL write-ahead log and periodic
+  atomic snapshots; recovery after SIGKILL rebuilds the refit pool
+  bitwise-identically (README "Fault tolerance").
 """
 
 from hdbscan_tpu.stream.buffer import IngestBuffer  # noqa: F401
 from hdbscan_tpu.stream.drift import DriftDetector  # noqa: F401
 from hdbscan_tpu.stream.refit import Refitter  # noqa: F401
+from hdbscan_tpu.stream.wal import StreamJournal  # noqa: F401

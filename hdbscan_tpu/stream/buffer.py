@@ -79,6 +79,14 @@ class BubbleSummary:
             "squared_sum": self.squared_sum.tolist(),
         }
 
+    @classmethod
+    def from_dict(cls, state: dict) -> "BubbleSummary":
+        bub = cls(len(state["linear_sum"]))
+        bub.count = int(state["count"])
+        bub.linear_sum = np.asarray(state["linear_sum"], np.float64)
+        bub.squared_sum = np.asarray(state["squared_sum"], np.float64)
+        return bub
+
 
 class IngestBuffer:
     """Splits an ingested stream into absorbed bubble mass vs novel rows.
@@ -250,6 +258,52 @@ class IngestBuffer:
             pool.view([("", pool.dtype)] * pool.shape[1]), return_index=True
         )
         return pool[np.sort(first)]
+
+    # -- durability --------------------------------------------------------
+
+    def state_dict(self) -> dict:
+        """JSON-able snapshot of the full mutable state (stream/wal.py).
+
+        Floats round-trip bitwise through ``json`` (Python uses shortest
+        round-trip repr), and the reservoir RNG state is captured, so
+        ``load_state`` followed by the same future ``absorb`` calls is
+        indistinguishable from never having crashed.
+        """
+        with self._lock:
+            return {
+                "rows_seen": int(self.rows_seen),
+                "absorbed_exact": int(self.absorbed_exact),
+                "absorbed_near": int(self.absorbed_near),
+                "stream_index": int(self._stream_index),
+                "rng_state": self._rng.bit_generator.state,
+                "bubbles": {
+                    str(lab): b.as_dict() for lab, b in sorted(self.bubbles.items())
+                },
+                "novel": [chunk.tolist() for chunk in self._novel],
+                "reservoir": [row.tolist() for row in self._reservoir],
+            }
+
+    def load_state(self, state: dict) -> None:
+        """Restore a :meth:`state_dict` snapshot (call :meth:`reset` with the
+        matching model first; the training-row hash set is rebuilt there)."""
+        with self._lock:
+            self.rows_seen = int(state["rows_seen"])
+            self.absorbed_exact = int(state["absorbed_exact"])
+            self.absorbed_near = int(state["absorbed_near"])
+            self._stream_index = int(state["stream_index"])
+            self._rng.bit_generator.state = state["rng_state"]
+            self.bubbles = {
+                int(lab): BubbleSummary.from_dict(b)
+                for lab, b in state["bubbles"].items()
+            }
+            self._novel = [
+                np.ascontiguousarray(np.asarray(chunk, np.float64))
+                for chunk in state["novel"]
+            ]
+            self._novel_rows = sum(len(chunk) for chunk in self._novel)
+            self._reservoir = [
+                np.asarray(row, np.float64) for row in state["reservoir"]
+            ]
 
     # -- introspection -----------------------------------------------------
 

@@ -147,6 +147,33 @@ class DriftDetector:
         counts = np.bincount(idx[known], minlength=len(self._label_ids))
         return np.append(counts, np.count_nonzero(~known)).astype(np.int64)
 
+    # -- durability --------------------------------------------------------
+
+    def state_dict(self) -> dict:
+        """JSON-able snapshot of baseline + stream sketches (stream/wal.py)."""
+        with self._lock:
+            return {
+                "label_ids": self._label_ids.tolist(),
+                "base_scores": self._base_scores.tolist(),
+                "base_assign": self._base_assign.tolist(),
+                "cur_scores": self._cur_scores.tolist(),
+                "cur_assign": self._cur_assign.tolist(),
+                "rows": int(self.rows),
+                "checks": int(self.checks),
+            }
+
+    def load_state(self, state: dict) -> None:
+        """Restore a :meth:`state_dict` snapshot (overrides the baseline
+        installed at construction with the snapshot-time one)."""
+        with self._lock:
+            self._label_ids = np.asarray(state["label_ids"], np.int64)
+            self._base_scores = np.asarray(state["base_scores"], np.int64)
+            self._base_assign = np.asarray(state["base_assign"], np.int64)
+            self._cur_scores = np.asarray(state["cur_scores"], np.int64)
+            self._cur_assign = np.asarray(state["cur_assign"], np.int64)
+            self.rows = int(state["rows"])
+            self.checks = int(state["checks"])
+
     # -- streaming ---------------------------------------------------------
 
     def update(self, labels, scores) -> None:

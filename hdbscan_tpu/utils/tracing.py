@@ -142,15 +142,17 @@ class Tracer:
         for s in self._sinks:
             s.close()
 
-    def __call__(self, name: str, **fields) -> None:
+    def __call__(self, name: str, /, **fields) -> None:
         # An explicit wall_s field becomes the event's wall (several sites
         # time their own block and emit an instant event with the result) —
         # otherwise the logfmt line would carry two wall_s keys.
+        # ``name`` is positional-only so an event FIELD named ``name`` (the
+        # circuit_state schema) can't collide with the stage parameter.
         wall = fields.pop("wall_s", 0.0)
         self._emit(TraceEvent(name, float(wall), fields))
 
     @contextmanager
-    def stage(self, name: str, **fields):
+    def stage(self, name: str, /, **fields):
         t0 = time.monotonic()
         try:
             yield self

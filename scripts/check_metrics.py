@@ -9,7 +9,15 @@ With one file: validates the exposition grammar (HELP/TYPE comment lines,
 sample lines with escaped label values, finite sample values, no duplicate
 series) and the histogram invariants (cumulative non-decreasing ``_bucket``
 series ordered by ``le``, a ``+Inf`` bucket present and equal to
-``_count``, finite ``_sum``, integral non-negative counts).
+``_count``, finite ``_sum``, integral non-negative counts) — plus the
+fault-tolerance family contracts (README "Fault tolerance"):
+``hdbscan_tpu_requests_shed_total`` must be a counter labelled
+``route``/``reason``, ``hdbscan_tpu_faults_injected_total`` a counter
+labelled ``site``, ``hdbscan_tpu_circuit_state`` a gauge whose every
+sample is exactly 0 (closed), 1 (half_open) or 2 (open) with a ``name``
+label, and ``hdbscan_tpu_refit_failures_total`` / the three
+``hdbscan_tpu_wal_*_total`` families counters with integral non-negative
+values.
 
 With two files (two scrapes of the same server, second taken later): also
 checks counter monotonicity — every counter-type sample and every
@@ -223,11 +231,68 @@ def _check_histograms(parsed, where: str) -> list:
     return errors
 
 
+#: Fault-tolerance counter families with their REQUIRED label names (a
+#: sample may of course be absent entirely — servers without faults/WAL
+#: never create the series).
+_FAULT_COUNTERS = {
+    "hdbscan_tpu_requests_shed_total": ("route", "reason"),
+    "hdbscan_tpu_faults_injected_total": ("site",),
+    "hdbscan_tpu_refit_failures_total": (),
+    "hdbscan_tpu_wal_appends_total": (),
+    "hdbscan_tpu_wal_snapshots_total": (),
+    "hdbscan_tpu_wal_recovered_records_total": (),
+}
+
+
+def _check_fault_metrics(parsed, where: str) -> list:
+    """Fault-tolerance family contracts (serve/server.py, stream/wal.py):
+    the shed/fault/refit-failure/WAL counters carry their declared labels
+    with integral non-negative values, and every ``circuit_state`` gauge
+    sample is one of the three encoded breaker states."""
+    errors: list = []
+    types, samples = parsed["types"], parsed["samples"]
+    for fam, want_labels in _FAULT_COUNTERS.items():
+        if fam in types and types[fam] != "counter":
+            errors.append(
+                f"{where}: {fam} declared {types[fam]!r}, want counter"
+            )
+        for (name, label_items), value in samples.items():
+            if name != fam:
+                continue
+            got = tuple(sorted(k for k, _ in label_items))
+            if got != tuple(sorted(want_labels)):
+                errors.append(
+                    f"{where}: {fam} labels {got} != required "
+                    f"{tuple(sorted(want_labels))}"
+                )
+            if value < 0 or value != int(value):
+                errors.append(
+                    f"{where}: {fam}{dict(label_items)} value {value} not a "
+                    f"non-negative integer"
+                )
+    fam = "hdbscan_tpu_circuit_state"
+    if fam in types and types[fam] != "gauge":
+        errors.append(f"{where}: {fam} declared {types[fam]!r}, want gauge")
+    for (name, label_items), value in samples.items():
+        if name != fam:
+            continue
+        labels = dict(label_items)
+        if not labels.get("name"):
+            errors.append(f"{where}: {fam} sample lacks a 'name' label")
+        if value not in (0.0, 1.0, 2.0):
+            errors.append(
+                f"{where}: {fam}{labels} value {value} not in (0=closed, "
+                f"1=half_open, 2=open)"
+            )
+    return errors
+
+
 def validate_exposition(text: str, where: str = "metrics"):
-    """Grammar + histogram-consistency validation of one scrape.
-    Returns ``(parsed, errors)``."""
+    """Grammar + histogram-consistency + fault-family validation of one
+    scrape. Returns ``(parsed, errors)``."""
     parsed, errors = parse_exposition(text, where)
     errors += _check_histograms(parsed, where)
+    errors += _check_fault_metrics(parsed, where)
     return parsed, errors
 
 

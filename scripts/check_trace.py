@@ -56,7 +56,25 @@ power-of-two ``bucket``, ``coalesced >= 1``, ``generation >= 1``, and five
 finite non-negative segment walls (``parse_s``/``queue_s``/``assemble_s``/
 ``predict_s``/``respond_s``) that TELESCOPE: their sum equals ``wall_s``
 within 1e-6 — the contract that the decomposition accounts for every
-microsecond of request wall. Given
+microsecond of request wall. Spans may carry an integer HTTP ``status``
+(error spans included since the fault-tolerance layer); ``status >= 400``
+relaxes ``rows`` to ``>= 0`` (a request can fail before any row reaches
+the batcher).
+Fault-tolerance events (``hdbscan_tpu/fault`` + ``stream/wal.py``, README
+"Fault tolerance") add six schemas: ``fault_injected`` must carry a string
+``site``/``mode`` and a positive ``nth`` (the per-site fire ordinal);
+``request_shed`` a route in ``{/predict, /ingest}``, ``status`` in
+``{429, 503}``, a string ``reason`` and a ``request_id`` UNIQUE per
+process ACROSS shed and span events — every terminated request is exactly
+one of the two, so shed + served + failed == offered; ``circuit_state`` a
+string ``name``, ``state`` in {closed, open, half_open} and non-negative
+``failures``; ``retry_backoff`` a string ``name``/``error``, positive
+``attempt`` and non-negative ``delay_s``; ``wal_append`` a string ``wal``,
+string ``kind``, non-negative ``rows`` and a ``wal_seq`` that is CONTIGUOUS
+per (process, wal) — each append is exactly prev + 1, except a ``begin``
+record may reset to 0 (journal wipe on digest change / blue-green swap);
+``wal_recover`` a string ``wal``, non-negative ``records``/``rows`` and a
+boolean ``snapshot``. Given
 a report (``utils/telemetry.REPORT_SCHEMA``), additionally cross-checks
 that the report's per-phase wall totals equal the trace's per-stage wall
 sums within 1e-6, and — when the report carries a ``predict_latency``
@@ -115,7 +133,8 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
     last_batch_seq: dict = {}  # per-(process, predictor) predict_batch seq
     sync_counts: dict = {}  # per-process [host_syncs, device forest builds]
     last_swap_gen: dict = {}  # per-(process, server) model_swap generation
-    seen_request_ids: dict = {}  # per-process set of request_span ids
+    seen_request_ids: dict = {}  # per-process ids across span + shed events
+    last_wal_seq: dict = {}  # per-(process, wal) wal_append seq
     with open(path, encoding="utf-8") as f:
         for lineno, line in enumerate(f, 1):
             line = line.strip()
@@ -259,6 +278,38 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
                             f"{rid!r} repeated within process {proc!r}"
                         )
                     seen.add(rid)
+            # Fault-tolerance invariants (hdbscan_tpu/fault + stream/wal.py):
+            # per-event schemas in the helper; the shed/span request-id
+            # exclusivity and the per-(process, wal) seq contiguity need
+            # cross-event state so they live in this loop.
+            if stage in ("fault_injected", "request_shed", "circuit_state",
+                         "retry_backoff", "wal_append", "wal_recover"):
+                errors += _check_fault(path, lineno, stage, ev)
+                if stage == "request_shed":
+                    rid = ev.get("request_id")
+                    if isinstance(rid, str) and rid:
+                        seen = seen_request_ids.setdefault(proc, set())
+                        if rid in seen:
+                            errors.append(
+                                f"{path}:{lineno}: request_shed request_id "
+                                f"{rid!r} repeated within process {proc!r} — "
+                                f"a request terminates as exactly one of "
+                                f"span/shed"
+                            )
+                        seen.add(rid)
+                elif stage == "wal_append":
+                    wseq = ev.get("wal_seq")
+                    if _nonneg_int(wseq):
+                        key = (proc, ev.get("wal"))
+                        prev = last_wal_seq.get(key)
+                        reset = wseq == 0 and ev.get("kind") == "begin"
+                        if prev is not None and wseq != prev + 1 and not reset:
+                            errors.append(
+                                f"{path}:{lineno}: wal_append seq {wseq} not "
+                                f"contiguous (prev {prev}) for wal "
+                                f"{ev.get('wal')!r}"
+                            )
+                        last_wal_seq[key] = wseq
             # Per-device wall events: each device's timeline must be ordered.
             device = ev.get("device")
             if isinstance(device, int) and isinstance(seq, int):
@@ -435,6 +486,93 @@ def _check_stream(path: str, lineno: int, stage: str, ev: dict) -> list[str]:
     return errors
 
 
+def _check_fault(path: str, lineno: int, stage: str, ev: dict) -> list[str]:
+    """The six fault-tolerance event schemas (hdbscan_tpu/fault/inject.py,
+    fault/policy.py, stream/wal.py). The cross-event checks — shed/span
+    request-id exclusivity, per-(process, wal) ``wal_seq`` contiguity —
+    live in the main loop (they need shared state)."""
+    errors: list[str] = []
+    where = f"{path}:{lineno}: {stage}"
+    if stage == "fault_injected":
+        for key in ("site", "mode"):
+            if not isinstance(ev.get(key), str) or not ev.get(key):
+                errors.append(f"{where} lacks a non-empty string {key!r}")
+        if not _pos_int(ev.get("nth")):
+            errors.append(f"{where} nth={ev.get('nth')!r} not a positive int")
+    elif stage == "request_shed":
+        if ev.get("route") not in ("/predict", "/ingest"):
+            errors.append(
+                f"{where} route={ev.get('route')!r} not in (/predict, /ingest)"
+            )
+        if ev.get("status") not in (429, 503):
+            errors.append(
+                f"{where} status={ev.get('status')!r} not in (429, 503) — "
+                f"shedding is always a retryable refusal"
+            )
+        if not isinstance(ev.get("reason"), str) or not ev.get("reason"):
+            errors.append(f"{where} lacks a non-empty string 'reason'")
+        rid = ev.get("request_id")
+        if not isinstance(rid, str) or not rid:
+            errors.append(f"{where} lacks a non-empty string 'request_id'")
+    elif stage == "circuit_state":
+        if not isinstance(ev.get("name"), str) or not ev.get("name"):
+            errors.append(f"{where} lacks a non-empty string 'name'")
+        if ev.get("state") not in ("closed", "open", "half_open"):
+            errors.append(
+                f"{where} state={ev.get('state')!r} not in "
+                f"(closed, open, half_open)"
+            )
+        if not _nonneg_int(ev.get("failures")):
+            errors.append(
+                f"{where} failures={ev.get('failures')!r} not a "
+                f"non-negative int"
+            )
+    elif stage == "retry_backoff":
+        if not isinstance(ev.get("name"), str) or not ev.get("name"):
+            errors.append(f"{where} lacks a non-empty string 'name'")
+        if not _pos_int(ev.get("attempt")):
+            errors.append(
+                f"{where} attempt={ev.get('attempt')!r} not a positive int"
+            )
+        delay = ev.get("delay_s")
+        if (
+            not isinstance(delay, (int, float))
+            or isinstance(delay, bool)
+            or not math.isfinite(float(delay))
+            or float(delay) < 0
+        ):
+            errors.append(
+                f"{where} delay_s={delay!r} not a finite non-negative number"
+            )
+        if not isinstance(ev.get("error"), str) or not ev.get("error"):
+            errors.append(f"{where} lacks a non-empty string 'error'")
+    elif stage == "wal_append":
+        if not isinstance(ev.get("wal"), str) or not ev.get("wal"):
+            errors.append(f"{where} lacks a non-empty string 'wal'")
+        if not isinstance(ev.get("kind"), str) or not ev.get("kind"):
+            errors.append(f"{where} lacks a non-empty string 'kind'")
+        if not _nonneg_int(ev.get("wal_seq")):
+            errors.append(
+                f"{where} wal_seq={ev.get('wal_seq')!r} not a "
+                f"non-negative int"
+            )
+        if not _nonneg_int(ev.get("rows")):
+            errors.append(
+                f"{where} rows={ev.get('rows')!r} not a non-negative int"
+            )
+    else:  # wal_recover
+        if not isinstance(ev.get("wal"), str) or not ev.get("wal"):
+            errors.append(f"{where} lacks a non-empty string 'wal'")
+        for key in ("records", "rows"):
+            if not _nonneg_int(ev.get(key)):
+                errors.append(
+                    f"{where} {key}={ev.get(key)!r} not a non-negative int"
+                )
+        if not isinstance(ev.get("snapshot"), bool):
+            errors.append(f"{where} snapshot={ev.get('snapshot')!r} not a bool")
+    return errors
+
+
 #: The five telescoping segments of a request_span, in wall-clock order.
 SPAN_SEGMENTS = ("parse_s", "queue_s", "assemble_s", "predict_s", "respond_s")
 
@@ -453,7 +591,23 @@ def _check_request_span(path: str, lineno: int, ev: dict) -> list[str]:
     rid = ev.get("request_id")
     if not isinstance(rid, str) or not rid:
         errors.append(f"{where} lacks a non-empty string 'request_id'")
-    if not _pos_int(ev.get("rows")):
+    status = ev.get("status")
+    is_error = False
+    if status is not None:
+        if not isinstance(status, int) or isinstance(status, bool) or not (
+            100 <= status <= 599
+        ):
+            errors.append(f"{where} status={status!r} not an HTTP status int")
+        else:
+            is_error = status >= 400
+    # An error span may legitimately carry rows=0 (the request failed
+    # before any row reached the batcher); success spans always have rows.
+    if is_error:
+        if not _nonneg_int(ev.get("rows")):
+            errors.append(
+                f"{where} rows={ev.get('rows')!r} not a non-negative int"
+            )
+    elif not _pos_int(ev.get("rows")):
         errors.append(f"{where} rows={ev.get('rows')!r} not a positive int")
     bucket = ev.get("bucket")
     if not _pos_int(bucket) or (bucket & (bucket - 1)):

@@ -1,0 +1,493 @@
+"""Chaos end-to-end acceptance for the fault-tolerance layer.
+
+Four legs, all driven through the real HTTP server:
+
+- fault classes under concurrent load (slow_request / predict_dispatch /
+  http_reset): zero hung or malformed responses, and every injected fault
+  is accounted identically by the plan, the trace, and /metrics,
+- bounded-queue load shedding: 429/503 + Retry-After, shed/served/failed
+  reconciles bitwise between the load generator, the trace, the batcher
+  stats and ``requests_shed_total``; per-request deadlines 504 fail-fast,
+- refit circuit breaker: three injected refit failures trip it open
+  (visible in /healthz, /metrics and the circuit_state trace), the server
+  keeps serving the pinned generation, and after ``circuit_reset_s`` a
+  half-open trial refit recovers to generation 2,
+- crash-safe durability: a SIGKILLed writer process loses nothing it
+  acked, and a server recovered from the WAL mid-stream finishes with a
+  refit pool bitwise identical to an uninterrupted run (so recovery ARI
+  == uninterrupted ARI >= the 0.99x acceptance bar).
+
+Every leg's trace passes scripts/check_trace.py and every scrape passes
+scripts/check_metrics.py.
+"""
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from benchmarks.loadgen import http_predict_submitter, run_load
+from hdbscan_tpu import HDBSCANParams
+from hdbscan_tpu.fault import inject
+from hdbscan_tpu.models import hdbscan, mr_hdbscan
+from hdbscan_tpu.serve.server import ClusterServer
+from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+from hdbscan_tpu.utils.tracing import JsonlSink, Tracer
+from scripts import check_metrics, check_trace
+
+#: Three fit-time blobs plus one the streaming legs drift onto.
+CENTERS = np.asarray([(0.0, 0.0, 0.0), (6.0, 6.0, 6.0), (0.0, 8.0, 0.0)])
+NOVEL = np.asarray((10.0, -6.0, 5.0))
+SPREAD = 0.25
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    """Fault plans are process-global: never leak one across tests."""
+    inject.clear()
+    yield
+    inject.clear()
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    """One small fitted model shared by every chaos leg."""
+    rng = np.random.default_rng(0)
+    params = HDBSCANParams(
+        min_points=8, min_cluster_size=25, processing_units=1024
+    )
+    train, _ = _blobs(rng, 600, CENTERS)
+    model = hdbscan.fit(train, params).to_cluster_model(train, params)
+    return model, params, train
+
+
+def _blobs(rng, n, centers):
+    centers = np.atleast_2d(np.asarray(centers, float))
+    truth = np.arange(n) % len(centers)
+    return centers[truth] + rng.normal(0, SPREAD, (n, 3)), truth
+
+
+def _post(base, path, obj, headers=None, timeout=60):
+    req = urllib.request.Request(
+        base + path, json.dumps(obj).encode(),
+        {"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get(base, path, timeout=30):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _stage_counts(events, stage, key):
+    out = {}
+    for e in events:
+        if e["stage"] == stage:
+            out[e[key]] = out.get(e[key], 0) + 1
+    return out
+
+
+def _metric(samples, name, /, **labels):
+    key = (name, tuple(sorted(labels.items())))
+    return samples.get(key, 0.0)
+
+
+def test_faults_accounted_under_load(fitted, tmp_path):
+    """Fault classes under concurrent /predict load: no hangs, no malformed
+    responses, and plan == trace == /metrics fault accounting."""
+    model, _, _ = fitted
+    trace = str(tmp_path / "trace.jsonl")
+    tracer = Tracer(sinks=[JsonlSink(trace, static={"process": 0})])
+    # Installed BEFORE the server so its ctor attaches the metrics hook.
+    plan = inject.install(
+        "slow_request:p=0.25,seed=11,delay_s=0.01"
+        ";predict_dispatch:p=0.12,seed=12"
+        ";http_reset:p=0.08,seed=13",
+        tracer=tracer,
+    )
+    srv = ClusterServer(model, max_batch=32, port=0, tracer=tracer).start()
+    base = f"http://{srv.host}:{srv.port}"
+    rng = np.random.default_rng(5)
+
+    try:
+        res = run_load(
+            http_predict_submitter(
+                base, lambda k: _blobs(rng, k, CENTERS)[0], timeout=30
+            ),
+            mode="closed", concurrency=4,
+            batch_mix=((1, 0.5), (8, 0.3), (24, 0.2)),
+            requests=80, expect_shedding=True, seed=3,
+        )
+        scrape = _get(base, "/metrics")
+    finally:
+        srv.close()
+        tracer.close()
+
+    # Every offered request terminated (the 30s client timeout would have
+    # surfaced a hang as an error; run_load returning at all rules out a
+    # wedged worker) and unbounded queue => nothing shed.
+    assert res.offered == 80 and res.shed == 0
+    assert res.errors > 0  # the reset/dispatch faults really bit
+
+    events, errors = check_trace.validate_trace(trace)
+    assert not errors, errors
+    spans = [e for e in events if e["stage"] == "request_span"]
+    assert len(spans) == 80  # exactly one span per offered request
+    by_status = _stage_counts(spans, "request_span", "status")
+    assert by_status.get(200, 0) == res.requests
+    assert sum(v for s, v in by_status.items() if s != 200) == res.errors
+    assert by_status.get(499, 0) == plan.fired()["http_reset"]
+
+    # plan == trace == metrics, per site.
+    fired = {k: v for k, v in plan.fired().items() if v}
+    assert fired and fired.get("slow_request", 0) > 0
+    assert _stage_counts(events, "fault_injected", "site") == fired
+    parsed, merrors = check_metrics.validate_exposition(scrape, "chaos")
+    assert merrors == [], merrors
+    for site, n in fired.items():
+        assert _metric(
+            parsed["samples"], "hdbscan_tpu_faults_injected_total", site=site
+        ) == n
+    # requests_total double-entry: every span's status is counted.
+    for status, n in by_status.items():
+        assert _metric(
+            parsed["samples"], "hdbscan_tpu_requests_total",
+            route="/predict", status=str(status),
+        ) == n
+
+
+def test_bounded_queue_sheds_and_deadlines_fail_fast(fitted, tmp_path):
+    """Load shedding + deadlines: 429/503 + Retry-After under overload,
+    504 on an expired deadline, and shed+served+failed == offered across
+    the generator, the trace, the batcher and /metrics."""
+    model, _, _ = fitted
+    trace = str(tmp_path / "trace.jsonl")
+    tracer = Tracer(sinks=[JsonlSink(trace, static={"process": 0})])
+    srv = ClusterServer(
+        model, max_batch=2, port=0, tracer=tracer, queue_bound=2
+    ).start()
+    base = f"http://{srv.host}:{srv.port}"
+    # Slow the device path so the tiny queue genuinely backs up.
+    pred = srv._handle.predictor
+    orig_predict = pred.predict
+    pred.predict = lambda X: (time.sleep(0.03), orig_predict(X))[1]
+    rng = np.random.default_rng(6)
+
+    try:
+        res = run_load(
+            http_predict_submitter(
+                base, lambda k: _blobs(rng, k, CENTERS)[0], timeout=30
+            ),
+            mode="closed", concurrency=8, batch_mix=((1, 1.0),),
+            requests=120, expect_shedding=True, seed=4,
+        )
+
+        # A simultaneous burst to capture one rejection's headers.
+        outcomes = []
+
+        def probe():
+            try:
+                _post(base, "/predict", {"points": [[0.1, 0.1, 0.1]]})
+                outcomes.append(("ok", None))
+            except urllib.error.HTTPError as e:
+                outcomes.append((e.code, dict(e.headers)))
+
+        burst = [threading.Thread(target=probe) for _ in range(12)]
+        for t in burst:
+            t.start()
+        for t in burst:
+            t.join(timeout=30)
+        rejected = [(c, h) for c, h in outcomes if c in (429, 503)]
+        assert rejected, outcomes
+        for code, headers in rejected:
+            assert float(headers["Retry-After"]) > 0
+            assert headers["X-Request-Id"]
+
+        # Deadline semantics: an already-expired deadline is a 504 before
+        # any batch slot is spent; a malformed header is a 400.
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, "/predict", {"points": [[0.0, 0.0, 0.0]]},
+                  headers={"X-Deadline-Ms": "0.001"})
+        assert ei.value.code == 504
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, "/predict", {"points": [[0.0, 0.0, 0.0]]},
+                  headers={"X-Deadline-Ms": "bogus"})
+        assert ei.value.code == 400
+
+        scrape = _get(base, "/metrics")
+        batcher_shed = srv._handle.batcher.stats["shed"]
+    finally:
+        srv.close()
+        tracer.close()
+
+    assert res.shed > 0 and res.errors == 0
+    assert res.requests + res.shed == 120
+
+    events, errors = check_trace.validate_trace(trace)
+    assert not errors, errors
+    sheds = [e for e in events if e["stage"] == "request_shed"]
+    manual_shed = len(rejected)
+    manual_ok = sum(1 for c, _ in outcomes if c == "ok")
+    assert len(sheds) == res.shed + manual_shed == batcher_shed
+    assert {e["status"] for e in sheds} <= {429, 503}
+    assert {e["reason"] for e in sheds} == {"queue_full"}
+    spans = [e for e in events if e["stage"] == "request_span"]
+    # offered = loadgen 120 + burst 12 + the two deadline probes; each
+    # terminated as exactly one of span / shed.
+    assert len(spans) + len(sheds) == 120 + 12 + 2
+    by_status = _stage_counts(spans, "request_span", "status")
+    assert by_status[200] == res.requests + manual_ok
+    assert by_status[504] == 1 and by_status[400] == 1
+
+    parsed, merrors = check_metrics.validate_exposition(scrape, "chaos")
+    assert merrors == [], merrors
+    assert _metric(
+        parsed["samples"], "hdbscan_tpu_requests_shed_total",
+        route="/predict", reason="queue_full",
+    ) == len(sheds)
+    assert _metric(
+        parsed["samples"], "hdbscan_tpu_requests_total",
+        route="/predict", status="503",
+    ) == len(sheds)
+
+
+def test_refit_circuit_opens_then_recovers(fitted, tmp_path):
+    """Three injected refit failures trip the circuit open (healthz,
+    metrics, trace agree); the server keeps serving generation 1; after
+    circuit_reset_s a half-open trial refit succeeds, swaps to generation
+    2 and closes the circuit."""
+    model, params0, _ = fitted
+    params = dataclasses.replace(
+        params0,
+        stream_refit_budget=150,
+        stream_drift_threshold=50.0,  # budget, not drift, triggers
+        circuit_failures=3,
+        circuit_reset_s=0.5,
+    )
+    trace = str(tmp_path / "trace.jsonl")
+    tracer = Tracer(sinks=[JsonlSink(trace, static={"process": 0})])
+    plan = inject.install("refit_fit:count=3", tracer=tracer)
+    srv = ClusterServer(
+        model, max_batch=32, port=0, tracer=tracer,
+        ingest=True, params=params, model_dir=str(tmp_path / "models"),
+    ).start()
+    # The server builds its Refitter with the production backoff; drop it
+    # so the three failures happen inside the test budget.
+    srv.refitter.backoff_base_s = 0.01
+    srv.refitter.backoff_cap_s = 0.05
+    base = f"http://{srv.host}:{srv.port}"
+    rng = np.random.default_rng(9)
+
+    try:
+        open_health = None
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            pts, _ = _blobs(rng, 80, NOVEL)
+            out = _post(base, "/ingest", {"points": pts.tolist()})
+            assert out["rows"] == 80
+            if open_health is None and srv._refit_circuit.state == "open":
+                open_health = json.loads(_get(base, "/healthz"))
+                # Degraded but serving: the pinned generation answers.
+                got = _post(base, "/predict", {"points": pts[:4].tolist()})
+                assert got["generation"] == 1
+            if srv.generation >= 2:
+                break
+            time.sleep(0.05)
+
+        assert open_health is not None, "circuit never opened"
+        stream = open_health["stream"]
+        assert stream["circuit"]["state"] == "open"
+        assert stream["refits_failed"] == 3
+        assert "InjectedFault" in stream["refit_last_error"]
+        assert stream["refit_last_error_at"]
+
+        assert srv.generation == 2, f"no recovery: health={srv.health()}"
+        assert srv._refit_circuit.state == "closed"
+        scrape = _get(base, "/metrics")
+        health = json.loads(_get(base, "/healthz"))
+        assert health["stream"]["circuit"]["state"] == "closed"
+        assert health["stream"]["circuit"]["trips"] == 1
+        assert health["stream"]["refits_ok"] >= 1
+    finally:
+        srv.close()
+        tracer.close()
+
+    assert plan.fired()["refit_fit"] == 3
+
+    events, errors = check_trace.validate_trace(trace)
+    assert not errors, errors
+    states = [e["state"] for e in events if e["stage"] == "circuit_state"]
+    assert states == ["open", "half_open", "closed"]
+    refits = [e for e in events if e["stage"] == "model_refit"]
+    assert sum(1 for e in refits if not e["ok"]) == 3
+    assert any(e["ok"] for e in refits)
+    swaps = [e for e in events if e["stage"] == "model_swap"]
+    assert [e["generation"] for e in swaps] == [2]
+
+    parsed, merrors = check_metrics.validate_exposition(scrape, "chaos")
+    assert merrors == [], merrors
+    samples = parsed["samples"]
+    assert _metric(samples, "hdbscan_tpu_refit_failures_total") == 3
+    assert _metric(
+        samples, "hdbscan_tpu_faults_injected_total", site="refit_fit"
+    ) == 3
+    assert _metric(samples, "hdbscan_tpu_circuit_state", name="refit") == 0.0
+
+
+#: Stand-alone WAL writer for the SIGKILL leg: acks each durable append on
+#: stdout, so the parent can kill it mid-stream and check nothing acked is
+#: lost. numpy-only — the buffer/drift/journal state machines need no jax.
+_KILL_CHILD = r"""
+import sys, types
+import numpy as np
+from hdbscan_tpu.stream.buffer import IngestBuffer
+from hdbscan_tpu.stream.drift import DriftDetector
+from hdbscan_tpu.stream.wal import StreamJournal
+
+wal_dir = sys.argv[1]
+rng = np.random.default_rng(0)
+model = types.SimpleNamespace(data=rng.normal(0, 1, (64, 3)))
+buf = IngestBuffer(model, reservoir_size=32, seed=0)
+drift = DriftDetector(rng.uniform(0, 1, 512), rng.integers(-1, 3, 512))
+jr = StreamJournal(wal_dir, snapshot_every=5)
+jr.open("kill-digest", buf, drift)
+for i in range(100_000):
+    pts = rng.normal(0, 2, (4, 3))
+    labels = rng.integers(-1, 3, 4)
+    prob = rng.uniform(0, 1, 4)
+    scores = rng.uniform(0, 1, 4)
+    buf.absorb(pts, labels, prob)
+    drift.update(labels, scores)
+    jr.append_ingest(pts, labels, prob, scores)
+    jr.maybe_snapshot(buf, drift)
+    print(f"ACK {i + 1}", flush=True)
+"""
+
+
+def test_sigkill_loses_nothing_acked(tmp_path):
+    """SIGKILL a live WAL writer mid-stream: recovery replays every append
+    the child acked (fsync-before-ack), at most one unacked extra."""
+    import types
+
+    from hdbscan_tpu.stream.buffer import IngestBuffer
+    from hdbscan_tpu.stream.drift import DriftDetector
+    from hdbscan_tpu.stream.wal import StreamJournal
+
+    wal_dir = tmp_path / "wal"
+    wal_dir.mkdir()
+    repo = Path(__file__).resolve().parents[2]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _KILL_CHILD, str(wal_dir)],
+        stdout=subprocess.PIPE, cwd=str(repo), env=env, text=True,
+    )
+    acked = 0
+    try:
+        for line in proc.stdout:
+            assert line.startswith("ACK ")
+            acked = int(line.split()[1])
+            if acked >= 6:
+                os.kill(proc.pid, signal.SIGKILL)
+                break
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
+    assert acked == 6
+
+    rng = np.random.default_rng(0)
+    model = types.SimpleNamespace(data=rng.normal(0, 1, (64, 3)))
+    buf = IngestBuffer(model, reservoir_size=32, seed=0)
+    drift = DriftDetector(rng.uniform(0, 1, 512), rng.integers(-1, 3, 512))
+    jr = StreamJournal(str(wal_dir), snapshot_every=5)
+    jr.open("kill-digest", buf, drift)
+    recovered = jr.stats()["seq"] - 1  # minus the begin record
+    # Everything acked is durable; the kill can at most leave one extra
+    # append that completed after the last ack we read.
+    assert acked <= recovered <= acked + 2
+    assert buf.stats()["rows_seen"] == recovered * 4
+    jr.close()
+
+
+def test_crash_recovery_matches_uninterrupted_stream(fitted, tmp_path):
+    """Server crash-sim mid-stream: a WAL-recovered server finishes the
+    stream with a refit pool bitwise identical to an uninterrupted run, so
+    recovery ARI == uninterrupted ARI (>= the 0.99x acceptance bar)."""
+    model, params0, _ = fitted
+    params = dataclasses.replace(
+        params0,
+        stream_refit_budget=100_000,  # no refit: this leg is about state
+        stream_drift_threshold=50.0,
+        stream_snapshot_every=8,
+    )
+    rng = np.random.default_rng(21)
+    all_centers = np.vstack([CENTERS, NOVEL[None]])
+    chunks = [_blobs(rng, 100, all_centers)[0] for _ in range(20)]
+
+    def serve(wal_dir):
+        return ClusterServer(
+            model, max_batch=64, port=0, ingest=True, params=params,
+            model_dir=str(tmp_path / "models"), wal_dir=str(wal_dir),
+        )
+
+    def stream(srv, some_chunks):
+        base = f"http://{srv.host}:{srv.port}"
+        for c in some_chunks:
+            out = _post(base, "/ingest", {"points": c.tolist()})
+            assert out["rows"] == 100
+
+    # Uninterrupted reference run.
+    srv_a = serve(tmp_path / "wal_a").start()
+    stream(srv_a, chunks)
+    pool_a = srv_a.buffer.refit_points(originals=200, seed=3)
+    state_a = srv_a.buffer.state_dict()
+    srv_a.close()
+
+    # Crashed run: 10 chunks, then the process "dies" — nothing is closed
+    # or flushed beyond what each fsync'd append already made durable; we
+    # only release the port so the recovery server can exist alongside.
+    srv_b = serve(tmp_path / "wal_b").start()
+    stream(srv_b, chunks[:10])
+    srv_b._httpd.shutdown()
+    srv_b._httpd.server_close()
+
+    # Recovery: a fresh server on the same WAL replays to the crash point
+    # before serving, then finishes the stream.
+    srv_c = serve(tmp_path / "wal_b")
+    assert srv_c.buffer.stats()["rows_seen"] == 1000  # replayed pre-start
+    rec = srv_c.journal.last_recover
+    assert rec["records"] >= 1 or rec["snapshot"]
+    srv_c.start()
+    stream(srv_c, chunks[10:])
+    pool_c = srv_c.buffer.refit_points(originals=200, seed=3)
+    state_c = srv_c.buffer.state_dict()
+    srv_c.close()
+
+    assert state_c == state_a  # bitwise, RNG state included
+    np.testing.assert_array_equal(pool_c, pool_a)
+
+    # The acceptance bar, stated as ARI: fit both pools and score against
+    # nearest-center truth. Bitwise-equal pools make this exact equality.
+    def ari(pool):
+        labels = mr_hdbscan.fit(pool, params0).labels
+        d2 = ((pool[:, None, :] - all_centers[None, :, :]) ** 2).sum(-1)
+        truth = np.argmin(d2, axis=1)
+        return adjusted_rand_index(
+            np.asarray(labels), truth, noise_as_singletons=True
+        )
+
+    ari_uninterrupted = ari(pool_a)
+    ari_recovered = ari(pool_c)
+    assert ari_recovered >= 0.99 * ari_uninterrupted
+    assert ari_recovered > 0.3  # the pools genuinely cluster

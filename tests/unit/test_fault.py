@@ -1,0 +1,494 @@
+"""Unit tests for the fault-tolerance layer (hdbscan_tpu/fault/):
+
+- spec grammar + validation (parse_spec / SiteSpec),
+- deterministic firing (same seed -> same pattern), count caps, fired()
+  accounting, trace events, and on_fire hooks (FaultPlan),
+- module-level install / clear / maybe_fire fast path,
+- backoff_s / retry_call / retry (capped exponential backoff + jitter),
+- CircuitBreaker transitions under a fake clock,
+- the MicroBatcher resilience contracts: queue-bound shedding, deadline
+  fail-fast at submit and at dispatch, and the 100-round randomized
+  submit-vs-close race under injected batcher_submit faults — every
+  accepted future resolves, every rejection is one of the four typed
+  refusals, nothing hangs.
+"""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.fault import inject
+from hdbscan_tpu.fault.inject import FaultPlan, InjectedFault, SiteSpec, parse_spec
+from hdbscan_tpu.fault.policy import (
+    CIRCUIT_STATE_VALUES,
+    CircuitBreaker,
+    DeadlineExceeded,
+    ShedRequest,
+    backoff_s,
+    retry,
+    retry_call,
+)
+from hdbscan_tpu.serve.batcher import MicroBatcher
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    """Every test starts and ends with no process-wide fault plan."""
+    inject.clear()
+    yield
+    inject.clear()
+
+
+class RecordingTracer:
+    """Minimal tracer stub: collects (stage, fields) tuples."""
+
+    def __init__(self):
+        self.events = []
+        self._lock = threading.Lock()
+
+    def __call__(self, stage, **fields):
+        with self._lock:
+            self.events.append((stage, fields))
+
+    def stages(self, name):
+        return [f for s, f in self.events if s == name]
+
+
+# -- spec grammar ----------------------------------------------------------
+
+
+def test_parse_spec_defaults_and_keys():
+    specs = parse_spec("predict_dispatch:p=0.2,count=5,seed=7;artifact_save:mode=torn")
+    assert [s.site for s in specs] == ["predict_dispatch", "artifact_save"]
+    assert specs[0].p == 0.2 and specs[0].count == 5 and specs[0].seed == 7
+    assert specs[0].mode == "raise"  # default
+    assert specs[1].mode == "torn"
+    assert specs[1].p == 1.0 and specs[1].count == -1 and specs[1].seed == 0
+    assert specs[1].delay_s == 0.05
+
+
+def test_parse_spec_empty_and_whitespace():
+    assert parse_spec("") == []
+    assert parse_spec(" ; ; ") == []
+    (spec,) = parse_spec("  slow_request : delay_s=0.5 ")
+    assert spec.site == "slow_request" and spec.delay_s == 0.5
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "no_such_site",
+        "predict_dispatch:p=1.5",
+        "predict_dispatch:p=-0.1",
+        "slow_request:delay_s=-1",
+        "predict_dispatch:frequency=2",  # unknown key
+        "predict_dispatch:p",  # malformed pair
+        "predict_dispatch;predict_dispatch",  # duplicate site
+    ],
+)
+def test_parse_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_spec(bad)
+
+
+def test_site_spec_validates_directly():
+    with pytest.raises(ValueError):
+        SiteSpec(site="bogus")
+    with pytest.raises(ValueError):
+        SiteSpec(site="http_reset", p=2.0)
+
+
+# -- FaultPlan -------------------------------------------------------------
+
+
+def test_plan_count_cap_and_fired():
+    plan = FaultPlan("batcher_submit:count=2")
+    assert plan.maybe_fire("batcher_submit") is not None
+    assert plan.maybe_fire("batcher_submit") is not None
+    assert plan.maybe_fire("batcher_submit") is None  # cap reached
+    assert plan.fired() == {"batcher_submit": 2}
+    assert plan.maybe_fire("predict_dispatch") is None  # site not in plan
+
+
+def test_plan_probability_deterministic_per_seed():
+    def pattern(seed):
+        plan = FaultPlan(f"predict_dispatch:p=0.5,seed={seed}")
+        return [plan.maybe_fire("predict_dispatch") is not None for _ in range(64)]
+
+    a, b, c = pattern(3), pattern(3), pattern(4)
+    assert a == b  # same seed, same arrival order -> identical fires
+    assert a != c  # different seed diverges
+    assert 0 < sum(a) < 64  # actually probabilistic
+
+    # ...and matches the raw PRNG stream the spec promises.
+    rng = random.Random(3)
+    want = [rng.random() < 0.5 for _ in range(64)]
+    assert a == want
+
+
+def test_plan_trace_events_and_hooks():
+    tracer = RecordingTracer()
+    plan = FaultPlan("refit_fit:count=3", tracer=tracer)
+    hook_calls = []
+    plan.add_on_fire(lambda site, spec, nth: hook_calls.append((site, nth)))
+    for _ in range(5):
+        plan.maybe_fire("refit_fit")
+    faults = tracer.stages("fault_injected")
+    assert [f["nth"] for f in faults] == [1, 2, 3]
+    assert all(f["site"] == "refit_fit" and f["mode"] == "raise" for f in faults)
+    assert hook_calls == [("refit_fit", 1), ("refit_fit", 2), ("refit_fit", 3)]
+
+
+def test_module_install_clear_and_env(monkeypatch):
+    assert inject.maybe_fire("http_reset") is None  # no plan installed
+    plan = inject.install("http_reset:count=1")
+    assert inject.plan() is plan
+    assert inject.maybe_fire("http_reset") is not None
+    assert inject.maybe_fire("http_reset") is None
+    inject.clear()
+    assert inject.plan() is None
+
+    monkeypatch.setenv(inject.ENV_VAR, "slow_request:count=1,delay_s=0.01")
+    plan = inject.install_from_env()
+    assert plan is not None and plan.sites() == ("slow_request",)
+    spec = inject.maybe_fire("slow_request")
+    assert spec is not None and spec.delay_s == 0.01
+
+    monkeypatch.setenv(inject.ENV_VAR, "")
+    inject.clear()
+    assert inject.install_from_env() is None
+
+
+# -- backoff / retry -------------------------------------------------------
+
+
+def test_backoff_caps_exponential_growth():
+    delays = [backoff_s(a, base_s=0.1, cap_s=0.5, jitter=0.0) for a in range(6)]
+    assert delays == [0.1, 0.2, 0.4, 0.5, 0.5, 0.5]
+    with pytest.raises(ValueError):
+        backoff_s(-1)
+
+
+def test_backoff_jitter_range_and_determinism():
+    rng = random.Random(0)
+    vals = [backoff_s(2, base_s=0.1, cap_s=10.0, jitter=0.5, rng=rng) for _ in range(100)]
+    assert all(0.2 <= v <= 0.4 for v in vals)  # uniform in [(1-j)d, d]
+    assert len(set(vals)) > 1
+    again = random.Random(0)
+    assert vals[0] == backoff_s(2, base_s=0.1, cap_s=10.0, jitter=0.5, rng=again)
+
+
+def test_retry_call_succeeds_after_transients():
+    calls, slept = [], []
+    tracer = RecordingTracer()
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    out = retry_call(
+        flaky, attempts=4, base_s=0.05, cap_s=2.0, sleep=slept.append,
+        tracer=tracer, name="publish",
+    )
+    assert out == "ok" and len(calls) == 3
+    assert slept == [0.05, 0.1]  # seed=None -> unjittered, deterministic
+    backoffs = tracer.stages("retry_backoff")
+    assert [b["attempt"] for b in backoffs] == [1, 2]
+    assert all(b["name"] == "publish" and "OSError" in b["error"] for b in backoffs)
+    assert all(b["delay_s"] >= 0 for b in backoffs)
+
+
+def test_retry_call_exhaustion_reraises_last():
+    calls = []
+
+    def always(e=ValueError("boom")):
+        calls.append(1)
+        raise e
+
+    with pytest.raises(ValueError, match="boom"):
+        retry_call(always, attempts=3, sleep=lambda s: None)
+    assert len(calls) == 3
+
+
+def test_retry_call_respects_retry_on_and_should_retry():
+    def keyerr():
+        raise KeyError("nope")
+
+    with pytest.raises(KeyError):  # not in retry_on -> immediate
+        retry_call(keyerr, attempts=5, retry_on=(OSError,), sleep=lambda s: None)
+
+    calls = []
+
+    def oserr():
+        calls.append(1)
+        raise OSError(5, "fatal")
+
+    with pytest.raises(OSError):
+        retry_call(
+            oserr, attempts=5, retry_on=(OSError,),
+            should_retry=lambda e: False, sleep=lambda s: None,
+        )
+    assert len(calls) == 1  # predicate vetoed the retry
+
+    with pytest.raises(ValueError):
+        retry_call(lambda: None, attempts=0)
+
+
+def test_retry_call_seeded_jitter_is_deterministic():
+    def run():
+        slept = []
+
+        def fail():
+            raise OSError("x")
+
+        with pytest.raises(OSError):
+            retry_call(fail, attempts=4, base_s=0.05, seed=9, sleep=slept.append)
+        return slept
+
+    a, b = run(), run()
+    assert a == b and len(a) == 3
+    assert a != [0.05, 0.1, 0.2]  # jitter actually applied
+
+
+def test_retry_decorator():
+    calls = []
+
+    @retry(attempts=3, sleep=lambda s: None)
+    def flaky(x):
+        calls.append(1)
+        if len(calls) < 2:
+            raise OSError("once")
+        return x * 2
+
+    assert flaky(21) == 42 and len(calls) == 2
+
+
+# -- circuit breaker -------------------------------------------------------
+
+
+def test_circuit_breaker_full_lifecycle():
+    clock = [0.0]
+    tracer = RecordingTracer()
+    states = []
+    cb = CircuitBreaker(
+        "refit", failures=3, reset_s=10.0, tracer=tracer,
+        on_state=lambda name, st: states.append((name, st)),
+        clock=lambda: clock[0],
+    )
+    assert cb.state == "closed" and cb.allow()
+    cb.record_failure()
+    cb.record_failure()
+    assert cb.state == "closed" and cb.allow()  # under threshold
+    cb.record_failure()
+    assert cb.state == "open" and not cb.allow()
+    info = cb.state_info()
+    assert info["state"] == "open" and info["trips"] == 1
+    assert info["retry_in_s"] == pytest.approx(10.0)
+
+    clock[0] = 9.9
+    assert not cb.allow()  # reset window not yet elapsed
+    clock[0] = 10.0
+    assert cb.allow()  # open -> half_open, trial allowed
+    assert cb.state == "half_open"
+    assert cb.allow()  # trials are not limited to one (no wedge)
+    cb.record_success()
+    assert cb.state == "closed" and cb.state_info()["failures"] == 0
+
+    # half_open failure re-opens immediately (single strike)
+    for _ in range(3):
+        cb.record_failure()
+    clock[0] = 25.0
+    assert cb.allow() and cb.state == "half_open"
+    cb.record_failure()
+    # every transition into open counts as a trip (2nd threshold trip +
+    # the half_open re-open)
+    assert cb.state == "open" and cb.state_info()["trips"] == 3
+
+    seq = [f["state"] for f in tracer.stages("circuit_state")]
+    assert seq == ["open", "half_open", "closed", "open", "half_open", "open"]
+    assert [s for _, s in states] == seq
+    assert all(f["name"] == "refit" for f in tracer.stages("circuit_state"))
+    assert set(seq) <= set(CIRCUIT_STATE_VALUES)
+
+
+def test_circuit_breaker_validates_params():
+    with pytest.raises(ValueError):
+        CircuitBreaker(failures=0)
+    with pytest.raises(ValueError):
+        CircuitBreaker(reset_s=0.0)
+
+
+def test_shed_request_attrs():
+    e = ShedRequest("queue full", status=429, retry_after_s=0.2, reason="rate")
+    assert e.status == 429 and e.retry_after_s == 0.2 and e.reason == "rate"
+    assert ShedRequest("x").status == 503
+    with pytest.raises(ValueError):
+        ShedRequest("x", status=500)
+    # Neither control-flow exception is a RuntimeError: the server's
+    # swap-retry loop catches RuntimeError("closed") and must NOT swallow
+    # shedding/deadline signals.
+    assert not isinstance(e, RuntimeError)
+    assert not isinstance(DeadlineExceeded("x"), RuntimeError)
+
+
+# -- MicroBatcher resilience ----------------------------------------------
+
+
+class FakePredictor:
+    """predict/max_bucket/bucket_for — all the batcher needs. Optionally
+    blocks dispatch on an event so tests can pile up the queue."""
+
+    max_bucket = 64
+
+    def __init__(self, gate=None):
+        self.gate = gate
+
+    def bucket_for(self, n):
+        b = 1
+        while b < n:
+            b *= 2
+        return b
+
+    def predict(self, X):
+        if self.gate is not None:
+            assert self.gate.wait(timeout=30)
+        n = len(X)
+        return np.zeros(n, np.int64), np.ones(n), np.zeros(n)
+
+
+def test_batcher_queue_bound_sheds():
+    gate = threading.Event()
+    mb = MicroBatcher(FakePredictor(gate), linger_s=0.0, max_queue=1)
+    try:
+        first = mb.submit(np.zeros((1, 3)))  # worker grabs it, blocks in predict
+        deadline = time.monotonic() + 5
+        while mb._q.qsize() and time.monotonic() < deadline:
+            time.sleep(0.001)
+        second = mb.submit(np.zeros((1, 3)))  # queued (qsize hits the bound)
+        with pytest.raises(ShedRequest) as exc:
+            mb.submit(np.zeros((1, 3)))
+        assert exc.value.status == 503 and exc.value.reason == "queue_full"
+        assert exc.value.retry_after_s > 0
+        gate.set()
+        assert first.result(timeout=10)[0].shape == (1,)
+        assert second.result(timeout=10)[0].shape == (1,)
+        assert mb.stats["shed"] == 1
+    finally:
+        gate.set()
+        mb.close()
+
+
+def test_batcher_unbounded_by_default():
+    mb = MicroBatcher(FakePredictor(), linger_s=0.0)
+    try:
+        assert mb.max_queue == 0
+        futs = [mb.submit(np.zeros((1, 3))) for _ in range(200)]
+        for f in futs:
+            f.result(timeout=10)
+        assert mb.stats["shed"] == 0
+    finally:
+        mb.close()
+
+
+def test_batcher_deadline_rejected_at_submit():
+    mb = MicroBatcher(FakePredictor(), linger_s=0.0)
+    try:
+        meta = {"deadline": time.perf_counter() - 1.0}
+        with pytest.raises(DeadlineExceeded):
+            mb.submit(np.zeros((1, 3)), meta)
+        # A live deadline sails through.
+        ok = mb.submit(np.zeros((1, 3)), {"deadline": time.perf_counter() + 30})
+        assert ok.result(timeout=10)[0].shape == (1,)
+    finally:
+        mb.close()
+
+
+def test_batcher_deadline_expires_in_queue():
+    gate = threading.Event()
+    mb = MicroBatcher(FakePredictor(gate), linger_s=0.0)
+    try:
+        blocker = mb.submit(np.zeros((1, 3)))  # occupies the worker
+        deadline = time.monotonic() + 5
+        while mb._q.qsize() and time.monotonic() < deadline:
+            time.sleep(0.001)
+        doomed = mb.submit(np.zeros((1, 3)), {"deadline": time.perf_counter() + 0.01})
+        time.sleep(0.05)  # deadline passes while queued behind the blocker
+        gate.set()
+        assert blocker.result(timeout=10)[0].shape == (1,)
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=10)
+        assert mb.stats["deadline_drops"] == 1
+    finally:
+        gate.set()
+        mb.close()
+
+
+def test_batcher_submit_fault_site():
+    inject.install("batcher_submit:count=2")
+    mb = MicroBatcher(FakePredictor(), linger_s=0.0)
+    try:
+        with pytest.raises(InjectedFault):
+            mb.submit(np.zeros((1, 3)))
+        with pytest.raises(InjectedFault):
+            mb.submit(np.zeros((1, 3)))
+        ok = mb.submit(np.zeros((1, 3)))  # count cap reached
+        assert ok.result(timeout=10)[0].shape == (1,)
+    finally:
+        mb.close()
+
+
+def test_batcher_submit_close_race_randomized_under_faults():
+    """Satellite: 100 randomized rounds of submit threads racing close()
+    with batcher_submit faults installed. Invariants: every ACCEPTED future
+    resolves (or fails typed — never hangs); every REJECTED submit raised
+    exactly one of the four expected refusals."""
+    for round_no in range(100):
+        inject.install(f"batcher_submit:p=0.3,seed={round_no}")
+        rng = random.Random(round_no)
+        mb = MicroBatcher(
+            FakePredictor(), linger_s=0.001, max_queue=rng.choice([0, 2, 8])
+        )
+        accepted, outcomes = [], []
+        lock = threading.Lock()
+        start = threading.Barrier(5)
+
+        def worker(seed, mb=mb, accepted=accepted, outcomes=outcomes, lock=lock,
+                   start=start):
+            wrng = random.Random(seed)
+            start.wait()
+            for _ in range(6):
+                meta = None
+                if wrng.random() < 0.3:
+                    meta = {"deadline": time.perf_counter() + wrng.uniform(-0.001, 0.05)}
+                try:
+                    fut = mb.submit(np.zeros((1, 3)), meta)
+                    with lock:
+                        accepted.append(fut)
+                except (RuntimeError, InjectedFault, ShedRequest, DeadlineExceeded) as e:
+                    with lock:
+                        outcomes.append(type(e).__name__)
+
+        threads = [
+            threading.Thread(target=worker, args=(round_no * 101 + i,))
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        start.wait()
+        time.sleep(rng.uniform(0.0, 0.002))  # jitter the close into the storm
+        mb.close()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive()
+        for fut in accepted:
+            try:
+                labels, prob, score = fut.result(timeout=10)
+                assert labels.shape == (1,)
+            except (DeadlineExceeded, RuntimeError):
+                pass  # typed failure is fine; hanging is not
+        inject.clear()

@@ -1,0 +1,290 @@
+"""Unit tests for the crash-safe stream journal (stream/wal.py):
+
+- append/recover round trip: a journal replayed after a simulated crash
+  reconstructs buffer + drift state BITWISE (state_dict equality covers the
+  reservoir RNG state, so all future absorbs are identical too),
+- snapshot + WAL truncation + snapshot-based recovery,
+- digest mismatch / stale WAL -> fresh start (stale state never replayed),
+- a torn FINAL line is dropped, a torn middle line raises,
+- seq contiguity: a gap in the WAL raises,
+- restart() re-keys and wipes after a blue/green swap,
+- wal_append / wal_recover trace events satisfy scripts/check_trace.py's
+  contiguity contract.
+
+Numpy-only: the buffer/drift state machines don't need jax, and a fit-free
+FakeModel (just ``.data``) keys the buffer's training-row hash set.
+"""
+
+import json
+import os
+import types
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.stream.buffer import IngestBuffer
+from hdbscan_tpu.stream.drift import DriftDetector
+from hdbscan_tpu.stream.wal import StreamJournal
+
+
+def _model(seed=0, n=64, dims=3):
+    rng = np.random.default_rng(seed)
+    return types.SimpleNamespace(data=rng.normal(0, 1, (n, dims)))
+
+
+def _fresh(model, seed=0):
+    """A (buffer, drift) pair in their post-construction state."""
+    buf = IngestBuffer(model, reservoir_size=32, seed=seed)
+    rng = np.random.default_rng(seed + 100)
+    drift = DriftDetector(rng.uniform(0, 1, 512), rng.integers(-1, 3, 512))
+    return buf, drift
+
+
+def _chunk(rng, rows=16, dims=3):
+    """One synthetic predicted batch: (points, labels, prob, scores)."""
+    return (
+        rng.normal(0, 2, (rows, dims)),
+        rng.integers(-1, 3, rows),
+        rng.uniform(0, 1, rows),
+        rng.uniform(0, 1, rows),
+    )
+
+
+def _ingest(journal, buf, drift, chunk):
+    """The server's ingest ordering: absorb/update, then append+snapshot."""
+    pts, labels, prob, scores = chunk
+    buf.absorb(pts, labels, prob)
+    drift.update(labels, scores)
+    if journal is not None:
+        journal.append_ingest(pts, labels, prob, scores)
+        journal.maybe_snapshot(buf, drift)
+
+
+def test_recovery_is_bitwise_identical(tmp_path):
+    model = _model()
+    rng = np.random.default_rng(1)
+    chunks = [_chunk(rng) for _ in range(10)]
+
+    # Uninterrupted reference run (no journal needed).
+    ref_buf, ref_drift = _fresh(model)
+    for c in chunks:
+        _ingest(None, ref_buf, ref_drift, c)
+
+    # Journaled run killed after 6 chunks: nothing closed, file handle
+    # simply abandoned — exactly what SIGKILL leaves behind (every append
+    # was fsync'd, so the WAL is complete).
+    buf_a, drift_a = _fresh(model)
+    jr_a = StreamJournal(str(tmp_path), snapshot_every=4)
+    jr_a.open("digest-1", buf_a, drift_a)
+    for c in chunks[:6]:
+        _ingest(jr_a, buf_a, drift_a, c)
+
+    # Recovery process: fresh objects, same directory.
+    buf_b, drift_b = _fresh(model)
+    jr_b = StreamJournal(str(tmp_path), snapshot_every=4)
+    info = jr_b.open("digest-1", buf_b, drift_b)
+    # snapshot_every=4 counts the begin record: snapshot landed after
+    # ingest 3 (watermark 4), leaving ingests 4-6 in the WAL tail.
+    assert info["snapshot"] is True
+    assert info["records"] == 3
+    assert not info["stale_discarded"] and not info["torn_tail_dropped"]
+
+    # Continue the stream where the crash left off.
+    for c in chunks[6:]:
+        _ingest(jr_b, buf_b, drift_b, c)
+
+    # Bitwise equality of the full state, RNG included...
+    assert buf_b.state_dict() == ref_buf.state_dict()
+    assert drift_b.state_dict() == ref_drift.state_dict()
+    # ...hence the refit pool is bitwise identical.
+    np.testing.assert_array_equal(
+        buf_b.refit_points(originals=16, seed=7),
+        ref_buf.refit_points(originals=16, seed=7),
+    )
+    jr_a.close()
+    jr_b.close()
+
+
+def test_snapshot_truncates_wal(tmp_path):
+    model = _model()
+    buf, drift = _fresh(model)
+    jr = StreamJournal(str(tmp_path), snapshot_every=3)
+    jr.open("d", buf, drift)
+    rng = np.random.default_rng(2)
+    for _ in range(3):
+        _ingest(jr, buf, drift, _chunk(rng))
+    # begin + ingest1 + ingest2 hit snapshot_every=3: snapshot at
+    # watermark 3, WAL truncated; ingest3 (seq 3) landed after.
+    snap = json.loads((tmp_path / "snapshot.json").read_text())
+    assert snap["digest"] == "d" and snap["watermark"] == 3
+    records = [
+        json.loads(line)
+        for line in (tmp_path / "wal.jsonl").read_text().splitlines()
+    ]
+    assert [r["seq"] for r in records] == [3]
+    assert jr.stats()["since_snapshot"] == 1
+    jr.close()
+
+
+def test_digest_mismatch_starts_fresh(tmp_path):
+    model = _model()
+    buf, drift = _fresh(model)
+    jr = StreamJournal(str(tmp_path), snapshot_every=2)
+    jr.open("old-digest", buf, drift)
+    rng = np.random.default_rng(3)
+    for _ in range(4):
+        _ingest(jr, buf, drift, _chunk(rng))
+    jr.close()
+
+    buf2, drift2 = _fresh(model)
+    jr2 = StreamJournal(str(tmp_path), snapshot_every=2)
+    info = jr2.open("NEW-digest", buf2, drift2)
+    assert info["stale_discarded"] is True
+    assert info["records"] == 0 and info["rows"] == 0
+    assert buf2.stats()["rows_seen"] == 0  # nothing stale replayed
+    # The journal is now keyed to the new digest and usable.
+    _ingest(jr2, buf2, drift2, _chunk(rng))
+    assert buf2.stats()["rows_seen"] == 16
+    jr2.close()
+
+
+def test_stale_wal_without_snapshot_starts_fresh(tmp_path):
+    (tmp_path / "wal.jsonl").write_text(
+        json.dumps({"seq": 0, "kind": "begin", "digest": "other"}) + "\n"
+    )
+    model = _model()
+    buf, drift = _fresh(model)
+    jr = StreamJournal(str(tmp_path))
+    info = jr.open("mine", buf, drift)
+    assert info["stale_discarded"] is True and info["records"] == 0
+    jr.close()
+
+
+def test_torn_final_line_dropped(tmp_path):
+    model = _model()
+    buf, drift = _fresh(model)
+    jr = StreamJournal(str(tmp_path), snapshot_every=100)
+    jr.open("d", buf, drift)
+    rng = np.random.default_rng(4)
+    for _ in range(3):
+        _ingest(jr, buf, drift, _chunk(rng))
+    jr.close()
+    ref_state = buf.state_dict()
+
+    # Simulate the one half-flushed write a crash can leave.
+    wal_path = tmp_path / "wal.jsonl"
+    torn = wal_path.read_text() + '{"seq": 4, "kind": "ingest", "points": [[1.'
+    wal_path.write_text(torn)
+
+    buf2, drift2 = _fresh(model)
+    jr2 = StreamJournal(str(tmp_path), snapshot_every=100)
+    info = jr2.open("d", buf2, drift2)
+    assert info["torn_tail_dropped"] is True
+    assert info["records"] == 3
+    # Only the torn (never-acked) record is lost; acked state is intact.
+    # (rng_state differs is impossible: same absorb sequence.)
+    assert buf2.state_dict() == ref_state
+    jr2.close()
+
+
+def test_torn_middle_line_raises(tmp_path):
+    model = _model()
+    buf, drift = _fresh(model)
+    jr = StreamJournal(str(tmp_path), snapshot_every=100)
+    jr.open("d", buf, drift)
+    rng = np.random.default_rng(5)
+    for _ in range(2):
+        _ingest(jr, buf, drift, _chunk(rng))
+    jr.close()
+
+    wal_path = tmp_path / "wal.jsonl"
+    lines = wal_path.read_text().splitlines()
+    lines[1] = lines[1][: len(lines[1]) // 2]  # corrupt a MIDDLE record
+    wal_path.write_text("\n".join(lines) + "\n")
+
+    buf2, drift2 = _fresh(model)
+    jr2 = StreamJournal(str(tmp_path), snapshot_every=100)
+    with pytest.raises(ValueError, match="corrupt WAL record"):
+        jr2.open("d", buf2, drift2)
+
+
+def test_seq_gap_raises(tmp_path):
+    model = _model()
+    buf, drift = _fresh(model)
+    jr = StreamJournal(str(tmp_path), snapshot_every=100)
+    jr.open("d", buf, drift)
+    rng = np.random.default_rng(6)
+    for _ in range(3):
+        _ingest(jr, buf, drift, _chunk(rng))
+    jr.close()
+
+    wal_path = tmp_path / "wal.jsonl"
+    lines = wal_path.read_text().splitlines()
+    del lines[2]  # drop a middle record -> seq gap
+    wal_path.write_text("\n".join(lines) + "\n")
+
+    buf2, drift2 = _fresh(model)
+    jr2 = StreamJournal(str(tmp_path), snapshot_every=100)
+    with pytest.raises(ValueError, match="WAL seq gap"):
+        jr2.open("d", buf2, drift2)
+
+
+def test_restart_rekeys_after_swap(tmp_path):
+    model = _model()
+    buf, drift = _fresh(model)
+    jr = StreamJournal(str(tmp_path), snapshot_every=100)
+    jr.open("gen1", buf, drift)
+    rng = np.random.default_rng(7)
+    for _ in range(3):
+        _ingest(jr, buf, drift, _chunk(rng))
+    assert jr.stats()["seq"] == 4
+
+    jr.restart("gen2")
+    assert jr.stats()["seq"] == 1  # fresh begin record only
+    assert not os.path.exists(tmp_path / "snapshot.json")
+    records = [
+        json.loads(line)
+        for line in (tmp_path / "wal.jsonl").read_text().splitlines()
+    ]
+    assert records == [{"seq": 0, "kind": "begin", "digest": "gen2"}]
+    jr.close()
+
+
+def test_trace_events_pass_check_trace(tmp_path):
+    from hdbscan_tpu.utils.tracing import JsonlSink, Tracer
+    from scripts import check_trace
+
+    trace = str(tmp_path / "trace.jsonl")
+    tracer = Tracer(sinks=[JsonlSink(trace)])
+    model = _model()
+    buf, drift = _fresh(model)
+    jr = StreamJournal(str(tmp_path / "wal"), snapshot_every=3, tracer=tracer)
+    jr.open("d", buf, drift)
+    rng = np.random.default_rng(8)
+    for _ in range(5):
+        _ingest(jr, buf, drift, _chunk(rng))
+    jr.restart("d2")  # wal_seq resets to 0 with a begin record
+    _ingest(jr, buf, drift, _chunk(rng))
+    jr.close()
+    tracer.close()
+
+    events, errors = check_trace.validate_trace(trace)
+    assert not errors, errors
+    appends = [e for e in events if e["stage"] == "wal_append"]
+    assert [a["kind"] for a in appends][0] == "begin"
+    recovers = [e for e in events if e["stage"] == "wal_recover"]
+    assert len(recovers) == 1 and recovers[0]["records"] == 0
+
+    # Contiguity check actually bites: forge a gap and expect a violation.
+    with open(trace, "a", encoding="utf-8") as f:
+        ev = dict(appends[-1])
+        ev["wal_seq"] += 7
+        ev["seq"] = 10_000
+        f.write(json.dumps(ev) + "\n")
+    _, errors = check_trace.validate_trace(trace)
+    assert any("not contiguous" in e for e in errors)
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        StreamJournal("/tmp/x-never-created", snapshot_every=0)
