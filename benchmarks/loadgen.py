@@ -124,6 +124,9 @@ class LoadResult:
     errors: int = 0
     shed: int = 0  # 429/503 refusals (only populated with expect_shedding)
     wall_s: float = 0.0  # measurement window (warmup excluded)
+    #: tenant -> {"latencies", "requests", "rows", "errors", "shed"} when
+    #: the run spread load over tenants (run_load(tenants=...)).
+    per_tenant: dict = field(default_factory=dict)
 
     @property
     def offered(self) -> int:
@@ -158,6 +161,32 @@ class LoadResult:
             return False
         return hist_quantile_close(self.hist, sorted(self.latencies), q)
 
+    def tenant_percentiles(self) -> dict:
+        """Per-tenant latency/accounting rows (empty without tenants).
+
+        Each row mirrors :meth:`percentiles` plus the per-tenant
+        served/shed/error split, so a fleet bench leg can hand every
+        tenant's observed p50/p99 straight to the registry's SLO verdicts.
+        """
+        out = {}
+        for tenant, st in sorted(self.per_tenant.items()):
+            walls = sorted(st["latencies"])
+            n = len(walls)
+            row = {
+                "count": n,
+                "requests": st["requests"],
+                "rows": st["rows"],
+                "errors": st["errors"],
+                "shed": st["shed"],
+                "mean_s": round(sum(walls) / n, 6) if n else None,
+                "max_s": round(walls[-1], 6) if n else None,
+            }
+            for q, key in ((0.50, "p50"), (0.99, "p99"), (0.999, "p999")):
+                raw = nearest_rank(walls, q)
+                row[f"{key}_s"] = round(raw, 6) if raw is not None else None
+            out[tenant] = row
+        return out
+
 
 def _pick_sizes(batch_mix, seed: int):
     """Deterministic weighted batch-size chooser (one RNG, lock-guarded)."""
@@ -188,6 +217,7 @@ def run_load(
     rate_rps: float | None = None,
     seed: int = 0,
     expect_shedding: bool = False,
+    tenants=0,
 ) -> LoadResult:
     """Drive ``submit(batch_size) -> rows`` under load and collect latency.
 
@@ -195,6 +225,13 @@ def run_load(
     window (both given = both respected, first hit wins). ``open`` mode
     additionally requires ``rate_rps``. Raises on submit() exceptions
     being swallowed — errors are counted, never recorded as latencies.
+
+    ``tenants`` spreads the load over a multi-tenant server: an int N
+    round-robins over tenant ids ``t0..t{N-1}``; a sequence of strings
+    round-robins over those names (matching the ``<tenant>.npz`` stems of
+    a ``--tenants-dir``). With tenants set, ``submit`` is called as
+    ``submit(batch_size, tenant)`` and the result carries per-tenant
+    latency accounting in :attr:`LoadResult.per_tenant`.
     """
     if mode not in ("closed", "open"):
         raise ValueError(f"mode must be 'closed' or 'open', got {mode!r}")
@@ -204,6 +241,10 @@ def run_load(
         raise ValueError("open mode requires rate_rps")
     if concurrency < 1:
         raise ValueError(f"concurrency must be >= 1, got {concurrency!r}")
+    if isinstance(tenants, int):
+        tenant_names = [f"t{i}" for i in range(tenants)]
+    else:
+        tenant_names = [str(t) for t in tenants]
 
     pick = _pick_sizes(batch_mix, seed)
     hist = MetricsRegistry().histogram(
@@ -231,7 +272,14 @@ def run_load(
             issued[0] += 1
         return True
 
-    def record(t_sched: float, t_done: float, rows, exc) -> None:
+    def tenant_bin(tenant: str) -> dict:
+        # caller holds the lock
+        return result.per_tenant.setdefault(
+            tenant,
+            {"latencies": [], "requests": 0, "rows": 0, "errors": 0, "shed": 0},
+        )
+
+    def record(t_sched: float, t_done: float, rows, exc, tenant) -> None:
         in_warmup = t_sched < warmup_until
         with lock:
             if in_warmup:
@@ -247,22 +295,45 @@ def run_load(
                 status = getattr(exc, "code", None) or getattr(exc, "status", None)
                 if expect_shedding and status in (429, 503):
                     result.shed += 1
+                    if tenant is not None:
+                        tenant_bin(tenant)["shed"] += 1
                 else:
                     result.errors += 1
+                    if tenant is not None:
+                        tenant_bin(tenant)["errors"] += 1
                 return
             lat = t_done - t_sched
             result.latencies.append(lat)
             result.requests += 1
             result.rows += int(rows)
+            if tenant is not None:
+                st = tenant_bin(tenant)
+                st["latencies"].append(lat)
+                st["requests"] += 1
+                st["rows"] += int(rows)
         hist.observe(lat)  # Histogram has its own lock
+
+    tenant_counter = [0]
+
+    def next_tenant():
+        if not tenant_names:
+            return None
+        with lock:
+            i = tenant_counter[0]
+            tenant_counter[0] += 1
+        return tenant_names[i % len(tenant_names)]
 
     def one_request(t_sched: float) -> None:
         size = pick()
+        tenant = next_tenant()
         try:
-            rows, exc = submit(size), None
+            if tenant is None:
+                rows, exc = submit(size), None
+            else:
+                rows, exc = submit(size, tenant), None
         except Exception as e:
             rows, exc = 0, e
-        record(t_sched, time.perf_counter(), rows, exc)
+        record(t_sched, time.perf_counter(), rows, exc, tenant)
 
     if mode == "closed":
 
@@ -311,17 +382,20 @@ def http_predict_submitter(base_url: str, sampler, timeout: float = 30.0,
     ``retry_attempts > 0`` resubmits requests the server shed with 429/503
     — capped exponential backoff via ``fault.policy.retry_call`` — so a
     polite client rides out a transient overload instead of reporting it.
+    The returned callable also accepts ``submit(k, tenant)`` — the form
+    ``run_load(tenants=...)`` uses — adding a ``"tenant"`` field to the
+    request body for multi-tenant servers (``serve --tenants-dir``).
     """
     url = base_url.rstrip("/") + "/predict"
     extra = dict(headers or {})
 
-    def once(k: int) -> int:
+    def once(k: int, tenant: str | None = None) -> int:
         points = sampler(k)
-        body = json.dumps(
-            {"points": [list(map(float, row)) for row in points]}
-        ).encode()
+        payload = {"points": [list(map(float, row)) for row in points]}
+        if tenant is not None:
+            payload["tenant"] = tenant
         req = urllib.request.Request(
-            url, data=body,
+            url, data=json.dumps(payload).encode(),
             headers={"Content-Type": "application/json", **extra},
         )
         with urllib.request.urlopen(req, timeout=timeout) as resp:
@@ -333,9 +407,9 @@ def http_predict_submitter(base_url: str, sampler, timeout: float = 30.0,
 
     from hdbscan_tpu.fault.policy import retry_call
 
-    def submit(k: int) -> int:
+    def submit(k: int, tenant: str | None = None) -> int:
         return retry_call(
-            lambda: once(k),
+            lambda: once(k, tenant),
             attempts=retry_attempts + 1, base_s=0.02, cap_s=0.5, seed=k,
             should_retry=lambda e: getattr(e, "code", None) in (429, 503),
         )
@@ -369,6 +443,11 @@ def main(argv=None) -> int:
         "--expect-shedding", action="store_true",
         help="count 429/503 refusals as shed load, not errors",
     )
+    ap.add_argument(
+        "--tenants", type=int, default=0, metavar="N",
+        help="spread load round-robin over tenant ids t0..t{N-1} "
+        "(multi-tenant server) with per-tenant latency accounting",
+    )
     args = ap.parse_args(argv)
 
     rng = np.random.default_rng(args.seed)
@@ -386,24 +465,23 @@ def main(argv=None) -> int:
         rate_rps=args.rate if args.mode == "open" else None,
         seed=args.seed,
         expect_shedding=args.expect_shedding,
+        tenants=args.tenants,
     )
-    print(
-        json.dumps(
-            {
-                "mode": result.mode,
-                "requests": result.requests,
-                "errors": result.errors,
-                "shed": result.shed,
-                "offered": result.offered,
-                "shed_rate": result.shed_rate(),
-                "rows_per_s": result.rows_per_s(),
-                "wall_s": result.wall_s,
-                "latency": result.percentiles(),
-                "hist_p99_consistent": result.quantiles_consistent(0.99),
-            },
-            indent=2,
-        )
-    )
+    out = {
+        "mode": result.mode,
+        "requests": result.requests,
+        "errors": result.errors,
+        "shed": result.shed,
+        "offered": result.offered,
+        "shed_rate": result.shed_rate(),
+        "rows_per_s": result.rows_per_s(),
+        "wall_s": result.wall_s,
+        "latency": result.percentiles(),
+        "hist_p99_consistent": result.quantiles_consistent(0.99),
+    }
+    if args.tenants:
+        out["tenants"] = result.tenant_percentiles()
+    print(json.dumps(out, indent=2))
     return 1 if result.errors else 0
 
 

@@ -70,10 +70,18 @@ invocation still means ``fit`` (the reference-compatible form above)::
     python -m hdbscan_tpu serve --model MODEL.npz [--host H] [--port P] \
         [predict_backend=...] [predict_batch=N] [--trace-out PATH] \
         [--report PATH] [--ingest] [--model-dir DIR] \
+        [--tenants-dir DIR] [--port-file PATH] \
         [absorb_eps=F] [drift_stat={psi,ks}] [drift_threshold=F] \
         [refit_budget=N] [stream_reload={auto,manual}] [trace_max_events=N] \
         [queue_bound=N] [deadline_ms=F] [faults=SPEC] [circuit_failures=N] \
-        [circuit_reset=F] [wal_dir=DIR] [snapshot_every=N]
+        [circuit_reset=F] [wal_dir=DIR] [snapshot_every=N] \
+        [tenant_lru=N] [tenant_quota=F]
+    python -m hdbscan_tpu fleet --model MODEL.npz [--host H] [--port P] \
+        [--model-dir DIR] [--tenants-dir DIR] [--ingest] [--wal-root DIR] \
+        [--trace-out PATH] [--report PATH] [fleet_replicas=N] \
+        [fleet_policy={consistent_hash,least_loaded}] \
+        [fleet_health_interval=F] [fleet_drain=F] \
+        [<replica serve knobs, forwarded verbatim>]
 
 ``fit --model-out`` persists the fitted clustering as one atomic
 schema-versioned ``.npz`` (``serve/artifact.ClusterModel``); ``predict``
@@ -116,6 +124,26 @@ write-ahead log (snapshotted every ``snapshot_every`` appends) and
 replayed bit-identically on restart. ``faults=SPEC`` (or the
 ``HDBSCAN_TPU_FAULTS`` env var) installs the deterministic fault-injection
 harness — see ``hdbscan_tpu/fault/inject.py`` for the spec grammar.
+
+Fleet (README "Fleet"): ``fleet`` spawns ``fleet_replicas`` independent
+``serve`` subprocesses sharing the same ``--model`` (and ``--model-dir``
+artifacts) and fronts them on ONE asyncio accept loop — ``/predict`` and
+``/ingest`` route by ``fleet_policy`` (``consistent_hash`` pins a tenant's
+requests to a replica via an md5 ring; ``least_loaded`` picks the replica
+with the fewest in-flight requests), ``/metrics`` scrapes every replica and
+serves one aggregated exposition with a ``replica`` label, and ``/swap``
+broadcasts to all replicas. A health loop probes ``/healthz`` every
+``fleet_health_interval`` seconds; a dead replica is routed around within
+one interval and restarted (each replica keeps its own ``--wal-root``/r<id>
+write-ahead log, so acked ingest survives a SIGKILL). SIGTERM forwards to
+every replica and waits up to ``fleet_drain`` seconds for drain — exit
+status is nonzero if any replica had to be killed. ``serve --tenants-dir
+DIR`` (also forwarded by ``fleet``) serves every ``<tenant>.npz`` in DIR
+behind an LRU of ``tenant_lru`` AOT-warmed predictors with per-tenant
+generations and a ``tenant_quota`` req/s token bucket (exceed = 429 +
+Retry-After); ``POST /predict`` bodies gain an optional ``"tenant"`` field.
+``serve --port-file PATH`` writes the bound port to PATH after the socket
+binds (how the fleet router discovers each replica's ephemeral port).
 """
 
 from __future__ import annotations
@@ -172,6 +200,8 @@ def main(argv: list[str] | None = None) -> int:
         return _main_predict(argv[1:], list(argv))
     if argv[0] == "serve":
         return _main_serve(argv[1:], list(argv))
+    if argv[0] == "fleet":
+        return _main_fleet(argv[1:], list(argv))
     if argv[0] == "fit":
         argv = argv[1:]
     return _main_fit(argv)
@@ -537,6 +567,8 @@ def _main_serve(argv: list[str], argv_full: list[str]) -> int:
         trace_out = _pop_path_flag(argv, "--trace-out")
         report_out = _pop_path_flag(argv, "--report")
         model_dir = _pop_path_flag(argv, "--model-dir")
+        tenants_dir = _pop_path_flag(argv, "--tenants-dir")
+        port_file = _pop_path_flag(argv, "--port-file")
         ingest = _pop_bool_flag(argv, "--ingest")
         params = HDBSCANParams.from_args(argv)
         port = int(port) if port is not None else 8799
@@ -567,13 +599,25 @@ def _main_serve(argv: list[str], argv_full: list[str]) -> int:
             ingest=ingest,
             params=params,
             model_dir=model_dir,
+            tenants=tenants_dir,
         )
+        if port_file is not None:
+            # The fleet router polls this file to discover the replica's
+            # ephemeral port (serve --port 0), so write it only after the
+            # socket is bound.
+            with open(port_file, "w", encoding="utf-8") as f:
+                f.write(f"{server.port}\n")
         mode = ""
         if ingest:
             mode = (
                 f", ingest on ({params.stream_drift_stat} drift @ "
                 f"{params.stream_drift_threshold}, {params.stream_reload} "
                 f"reload)"
+            )
+        if tenants_dir is not None:
+            mode += (
+                f", tenants dir {tenants_dir} "
+                f"(lru {params.tenant_lru_size})"
             )
         print(
             f"hdbscan-tpu serve: http://{server.host}:{server.port} "
@@ -588,6 +632,70 @@ def _main_serve(argv: list[str], argv_full: list[str]) -> int:
     if report_out is not None:
         _write_serving_report(report_out, tracer, params, argv_full)
     return 0
+
+
+def _main_fleet(argv: list[str], argv_full: list[str]) -> int:
+    try:
+        model_path = _pop_path_flag(argv, "--model")
+        host = _pop_path_flag(argv, "--host") or "127.0.0.1"
+        port = _pop_path_flag(argv, "--port")
+        trace_out = _pop_path_flag(argv, "--trace-out")
+        report_out = _pop_path_flag(argv, "--report")
+        model_dir = _pop_path_flag(argv, "--model-dir")
+        tenants_dir = _pop_path_flag(argv, "--tenants-dir")
+        wal_root = _pop_path_flag(argv, "--wal-root")
+        ingest = _pop_bool_flag(argv, "--ingest")
+        params = HDBSCANParams.from_args(argv)
+        port = int(port) if port is not None else 0
+    except ValueError as e:
+        print(f"error: {e}\n{HELP}", file=sys.stderr)
+        return 2
+    if not model_path:
+        print("error: fleet requires --model MODEL.npz", file=sys.stderr)
+        return 2
+
+    from hdbscan_tpu.fleet.router import FleetRouter
+
+    tracer = _serving_tracer(trace_out, report_out, params.trace_max_events)
+    rc = 1
+    try:
+        # Remaining key=value argv forwards to every replica verbatim, so
+        # predict_batch / queue_bound / wal knobs tune the whole fleet from
+        # one command line (fleet_* keys are valid serve config too — inert
+        # in a replica).
+        router = FleetRouter(
+            model_path,
+            replicas=params.fleet_replicas,
+            policy=params.fleet_policy,
+            health_interval_s=params.fleet_health_interval_s,
+            drain_s=params.fleet_drain_s,
+            host=host,
+            port=port,
+            replica_args=argv,
+            tenants_dir=tenants_dir,
+            model_dir=model_dir,
+            ingest=ingest,
+            wal_root=wal_root,
+            tracer=tracer,
+            verbose=True,
+        )
+        try:
+            router.start()
+        except RuntimeError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        print(
+            f"hdbscan-tpu fleet: http://{router.host}:{router.port} "
+            f"({params.fleet_replicas} replicas, {params.fleet_policy} "
+            f"routing, model {model_path})",
+            file=sys.stderr,
+        )
+        rc = router.serve_forever()
+    finally:
+        tracer.close()
+    if report_out is not None:
+        _write_serving_report(report_out, tracer, params, argv_full)
+    return rc
 
 
 if __name__ == "__main__":

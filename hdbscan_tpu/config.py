@@ -322,6 +322,31 @@ class HDBSCANParams:
     #: Ingest WAL appends between state snapshots (each snapshot truncates
     #: the WAL, bounding recovery replay).
     stream_snapshot_every: int = 64
+    #: Replica subprocesses behind the ``fleet`` CLI router
+    #: (``hdbscan_tpu/fleet``): each is a full ``serve`` process sharing the
+    #: model artifact / ``--model-dir``; the router spawns, health-checks,
+    #: and routes across them.
+    fleet_replicas: int = 2
+    #: Fleet routing policy: "consistent_hash" pins a tenant (or body
+    #: digest) to a stable replica via an md5 ring; "least_loaded" picks the
+    #: replica with the fewest in-flight proxied requests.
+    fleet_policy: str = "least_loaded"
+    #: Fleet health-probe period in seconds — also the bound within which a
+    #: dead replica stops receiving traffic, and the Retry-After hint when
+    #: every replica is down.
+    fleet_health_interval_s: float = 0.5
+    #: SIGTERM drain bound for the fleet: a replica still alive this many
+    #: seconds after the router forwards SIGTERM is SIGKILLed and the
+    #: router exits nonzero.
+    fleet_drain_s: float = 10.0
+    #: Multi-tenant serving (``--tenants-dir``): max AOT-warmed tenant
+    #: Predictors resident per replica; the coldest is evicted (with a
+    #: ``tenant_evict`` trace event) when a miss would exceed it.
+    tenant_lru_size: int = 8
+    #: Per-tenant sustained request quota in requests/second (token bucket,
+    #: burst = max(1, quota)); an over-quota request is refused with HTTP
+    #: 429 + Retry-After. 0 = unlimited.
+    tenant_quota_rps: float = 0.0
     #: Bound on the Tracer's in-memory event list (0 = unbounded). Sinks
     #: (the on-disk JSONL trace) always see every event; the bound only
     #: rings the in-memory view so a long-running ``serve --ingest``
@@ -455,6 +480,33 @@ class HDBSCANParams:
                 "stream_snapshot_every must be >= 1, "
                 f"got {self.stream_snapshot_every!r}"
             )
+        if self.fleet_replicas < 1:
+            raise ValueError(
+                f"fleet_replicas must be >= 1, got {self.fleet_replicas!r}"
+            )
+        if self.fleet_policy not in ("consistent_hash", "least_loaded"):
+            raise ValueError(
+                "fleet_policy must be 'consistent_hash' or 'least_loaded', "
+                f"got {self.fleet_policy!r}"
+            )
+        if not self.fleet_health_interval_s > 0:
+            raise ValueError(
+                "fleet_health_interval_s must be > 0, "
+                f"got {self.fleet_health_interval_s!r}"
+            )
+        if not self.fleet_drain_s > 0:
+            raise ValueError(
+                f"fleet_drain_s must be > 0, got {self.fleet_drain_s!r}"
+            )
+        if self.tenant_lru_size < 1:
+            raise ValueError(
+                f"tenant_lru_size must be >= 1, got {self.tenant_lru_size!r}"
+            )
+        if self.tenant_quota_rps < 0:
+            raise ValueError(
+                "tenant_quota_rps must be >= 0 (0 = unlimited), "
+                f"got {self.tenant_quota_rps!r}"
+            )
         if self.trace_max_events < 0:
             raise ValueError(
                 "trace_max_events must be >= 0 (0 = unbounded), "
@@ -560,6 +612,12 @@ FLAG_FIELDS = {
     "circuit_reset": ("circuit_reset_s", float),
     "wal_dir": ("stream_wal_dir", str),
     "snapshot_every": ("stream_snapshot_every", int),
+    "fleet_replicas": ("fleet_replicas", int),
+    "fleet_policy": ("fleet_policy", str),
+    "fleet_health_interval": ("fleet_health_interval_s", float),
+    "fleet_drain": ("fleet_drain_s", float),
+    "tenant_lru": ("tenant_lru_size", int),
+    "tenant_quota": ("tenant_quota_rps", float),
     "trace_max_events": ("trace_max_events", int),
     "max_samples": ("max_samples", int),
     "compat_cf": ("compat_cf_int_math", _bool),

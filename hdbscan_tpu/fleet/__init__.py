@@ -1,0 +1,20 @@
+"""Serving fleet: multi-replica router + multi-tenant model registry.
+
+``FleetRouter`` (``fleet/router.py``) fronts N replica subprocesses on one
+asyncio accept loop with health-tracked consistent-hash / least-loaded
+routing and aggregated ``/metrics``; ``TenantRegistry``
+(``fleet/tenants.py``) serves many models per replica behind an LRU of
+AOT-warmed Predictors with per-tenant generations, quotas, and SLO
+verdicts. See the README "Fleet" section for topology and the failure
+matrix.
+"""
+
+from hdbscan_tpu.fleet.router import POLICIES, FleetRouter
+from hdbscan_tpu.fleet.tenants import DEFAULT_TENANT_SLO, TenantRegistry
+
+__all__ = [
+    "FleetRouter",
+    "TenantRegistry",
+    "POLICIES",
+    "DEFAULT_TENANT_SLO",
+]

@@ -1,0 +1,771 @@
+"""Asyncio fleet router: N replica subprocesses behind one front door.
+
+The paper's MR-HDBSCAN* is a master coordinating many workers over
+partitioned data; this is the serving-side analogue — a thin coordination
+layer over unchanged per-replica servers, the way PANDA (arxiv 1607.08220)
+scales one-node k-NN into a distributed exchange. Each replica is a full
+``serve/server.ClusterServer`` process with the PR 8–10 contracts intact
+(micro-batching, blue/green swap, deadlines, shedding, WAL); the router
+adds only placement and failure handling:
+
+* **Spawn/monitor** — replicas launch as ``python -m hdbscan_tpu serve``
+  subprocesses sharing ``--model-dir`` artifacts (digest-guarded loads make
+  concurrent loading safe) and report their ephemeral port through a
+  ``--port-file``; a crashed replica is respawned (its WAL replays on the
+  same ``wal_dir``, so acked ingest survives a SIGKILL).
+* **Routing** — ``/predict``/``/ingest`` route by ``consistent_hash``
+  (md5 ring over the request's tenant id, falling back to a body digest)
+  or ``least_loaded`` (fewest in-flight proxied requests). A replica that
+  refuses a connection is marked down *immediately* and the request
+  re-routes in place — strictly faster than the one-health-interval bound.
+  Re-dispatch after bytes were already sent is only safe for idempotent
+  ``/predict``; a torn ``/ingest`` returns 502 rather than risk double
+  ingestion (acked writes are WAL-durable either way).
+* **Asyncio front-end** — the accept path is a single-threaded
+  ``asyncio`` loop: connections are coroutines, not threads, so 10k idle
+  keep-alive clients cost file descriptors rather than stacks, and the
+  replicas' linger-based coalescing is fed by as many concurrent proxied
+  requests as the OS allows.
+* **Headers** — ``X-Deadline-Ms`` propagates to the chosen replica (and
+  bounds the proxy's own wait); ``Retry-After`` from a shedding replica
+  passes through untouched; an all-replicas-down 503 carries the health
+  interval as its Retry-After.
+* **Aggregation** — ``GET /metrics`` scrapes every live replica, re-parses
+  the exposition into a registry tagged ``replica="<id>"``
+  (``utils.metrics.registry_from_exposition``), and folds the results plus
+  the router's own instruments through ``MetricsRegistry.merge()``.
+
+Trace events: ``fleet_route`` per proxied request, ``replica_health`` per
+probe — both validated by ``scripts/check_trace.py``.
+
+Device pinning: on multi-chip hosts pass ``devices=N`` — replica ``i``
+gets ``TPU_VISIBLE_CHIPS``/``CUDA_VISIBLE_DEVICES`` set to ``i % N``
+(keyed off ``JAX_PLATFORMS``), so replicas land on distinct chips instead
+of all initializing chip 0.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import bisect
+import hashlib
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+_TENANT_RE = re.compile(rb'"tenant"\s*:\s*(?:"((?:[^"\\]|\\.)*)"|(-?\d+))')
+
+#: Routing policies ``FleetRouter`` accepts (mirrored by the
+#: ``fleet_policy`` config knob).
+POLICIES = ("consistent_hash", "least_loaded")
+
+_VNODES = 64  # ring points per replica; 64 keeps the max/min load skew < ~20%
+
+_HOP_HEADERS = {"connection", "keep-alive", "transfer-encoding", "server", "date"}
+
+
+def _h(key: str) -> int:
+    return int.from_bytes(hashlib.md5(key.encode()).digest()[:8], "big")
+
+
+class _ReplicaError(Exception):
+    """A proxied request failed against one replica. ``sent`` is True when
+    request bytes reached the replica (re-dispatch is then unsafe for
+    non-idempotent routes)."""
+
+    def __init__(self, message: str, *, sent: bool):
+        super().__init__(message)
+        self.sent = sent
+
+
+class _Replica:
+    """One managed replica subprocess and its routing state."""
+
+    def __init__(self, rid: str):
+        self.rid = rid
+        self.proc: subprocess.Popen | None = None
+        self.port: int | None = None
+        self.port_file = ""
+        self.log_path = ""
+        self.up = False
+        self.failures = 0  # consecutive
+        self.in_flight = 0
+        self.restarts = 0
+        self.checks = 0
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+class FleetRouter:
+    """Spawn and front N ``serve`` replicas on one asyncio accept loop.
+
+    Args:
+      model_path: artifact every replica serves (``--model``).
+      replicas: process count (>= 1).
+      policy: one of :data:`POLICIES`.
+      health_interval_s: probe period; also the re-route bound for a dead
+        replica and the Retry-After hint when no replica is up.
+      drain_s: SIGTERM drain bound per :meth:`close`; a replica still
+        alive after it is SIGKILLed and close() reports failure.
+      replica_args: extra serve argv entries (``predict_batch=32``, ...).
+      replica_env: env overrides for every replica.
+      tenants_dir / model_dir / ingest / wal_root: forwarded serving
+        features; ``wal_root`` gives each replica ``wal_root/r<id>`` so a
+        respawned replica replays its own WAL.
+      devices: pin replica i to device ordinal ``i % devices``.
+      restart: respawn replicas that exit while the fleet is running.
+      tracer: optional ``utils.tracing.Tracer`` (``fleet_route`` /
+        ``replica_health`` events).
+    """
+
+    def __init__(self, model_path: str, *, replicas: int = 2,
+                 policy: str = "least_loaded", health_interval_s: float = 0.5,
+                 drain_s: float = 10.0, host: str = "127.0.0.1", port: int = 0,
+                 replica_args=(), replica_env: dict | None = None,
+                 tenants_dir: str | None = None, model_dir: str | None = None,
+                 ingest: bool = False, wal_root: str | None = None,
+                 devices: int | None = None, restart: bool = True,
+                 startup_timeout_s: float = 180.0, proxy_timeout_s: float = 30.0,
+                 run_dir: str | None = None, tracer=None, metrics=None,
+                 verbose: bool = False):
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas!r}")
+        if policy not in POLICIES:
+            raise ValueError(
+                f"policy must be one of {POLICIES}, got {policy!r}"
+            )
+        if health_interval_s <= 0.0:
+            raise ValueError(
+                f"health_interval_s must be > 0, got {health_interval_s!r}"
+            )
+        if drain_s <= 0.0:
+            raise ValueError(f"drain_s must be > 0, got {drain_s!r}")
+        self.model_path = str(model_path)
+        self.n_replicas = int(replicas)
+        self.policy = policy
+        self.health_interval_s = float(health_interval_s)
+        self.drain_s = float(drain_s)
+        self.host = host
+        self.port = int(port)  # 0 until bound
+        self.replica_args = list(replica_args)
+        self.replica_env = dict(replica_env or {})
+        self.tenants_dir = tenants_dir
+        self.model_dir = model_dir
+        self.ingest = bool(ingest)
+        self.wal_root = wal_root
+        self.devices = devices
+        self.restart = bool(restart)
+        self.startup_timeout_s = float(startup_timeout_s)
+        self.proxy_timeout_s = float(proxy_timeout_s)
+        self.run_dir = run_dir or tempfile.mkdtemp(prefix="hdbscan_fleet_")
+        self.tracer = tracer
+        self.verbose = bool(verbose)
+        self.replicas = [_Replica(str(i)) for i in range(self.n_replicas)]
+        self._ring = sorted(
+            (_h(f"{r.rid}#{v}"), r.rid)
+            for r in self.replicas for v in range(_VNODES)
+        )
+        self._ring_keys = [h for h, _ in self._ring]
+        self._by_rid = {r.rid: r for r in self.replicas}
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._server: asyncio.AbstractServer | None = None
+        self._ready = threading.Event()
+        self._startup_error: BaseException | None = None
+        self._shutdown = threading.Event()
+        self._closed = False
+        self._close_lock = threading.Lock()
+        self.drain_ok: bool | None = None
+        self._requests = {"/predict": 0, "/ingest": 0, "/swap": 0}
+
+        if metrics is None:
+            from hdbscan_tpu.utils.metrics import MetricsRegistry
+
+            metrics = MetricsRegistry()
+        self.metrics = metrics
+        self._m_requests = metrics.counter(
+            "hdbscan_tpu_fleet_requests_total",
+            "Requests proxied through the fleet router by outcome.",
+            ("replica", "route", "status"),
+        )
+        self._m_reroutes = metrics.counter(
+            "hdbscan_tpu_fleet_reroutes_total",
+            "Proxied requests re-dispatched away from a failed replica.",
+            ("replica", "route"),
+        )
+        self._m_up = metrics.gauge(
+            "hdbscan_tpu_replica_up",
+            "1 when the replica answered its last probe, else 0.",
+            ("replica",),
+        )
+        self._m_checks = metrics.counter(
+            "hdbscan_tpu_replica_health_checks_total",
+            "Health probes by result.",
+            ("replica", "ok"),
+        )
+        self._m_restarts = metrics.counter(
+            "hdbscan_tpu_replica_restarts_total",
+            "Replica subprocess respawns after an unexpected exit.",
+            ("replica",),
+        )
+        self._m_in_flight = metrics.gauge(
+            "hdbscan_tpu_replica_in_flight",
+            "Requests currently proxied to the replica.",
+            ("replica",),
+        )
+
+    # -- replica lifecycle -------------------------------------------------
+
+    def _replica_cmd(self, r: _Replica) -> list:
+        cmd = [
+            sys.executable, "-m", "hdbscan_tpu", "serve",
+            "--model", self.model_path,
+            "--host", "127.0.0.1", "--port", "0",
+            "--port-file", r.port_file,
+        ]
+        if self.model_dir:
+            cmd += ["--model-dir", self.model_dir]
+        if self.tenants_dir:
+            cmd += ["--tenants-dir", self.tenants_dir]
+        if self.ingest:
+            cmd.append("--ingest")
+        if self.wal_root:
+            cmd.append(
+                f"wal_dir={os.path.join(self.wal_root, 'r' + r.rid)}"
+            )
+        cmd += self.replica_args
+        return cmd
+
+    def _replica_environ(self, r: _Replica) -> dict:
+        env = dict(os.environ)
+        env.update(self.replica_env)
+        env["HDBSCAN_TPU_REPLICA_ID"] = r.rid
+        if self.devices:
+            ordinal = str(int(r.rid) % int(self.devices))
+            platform = env.get("JAX_PLATFORMS", "")
+            if "tpu" in platform:
+                env["TPU_VISIBLE_CHIPS"] = ordinal
+            elif "gpu" in platform or "cuda" in platform:
+                env["CUDA_VISIBLE_DEVICES"] = ordinal
+        return env
+
+    def _spawn(self, r: _Replica) -> None:
+        r.port_file = os.path.join(self.run_dir, f"replica_{r.rid}.port")
+        r.log_path = os.path.join(self.run_dir, f"replica_{r.rid}.log")
+        if os.path.exists(r.port_file):
+            os.unlink(r.port_file)
+        r.port = None
+        log = open(r.log_path, "ab")
+        try:
+            r.proc = subprocess.Popen(
+                self._replica_cmd(r),
+                stdout=log, stderr=log, stdin=subprocess.DEVNULL,
+                env=self._replica_environ(r),
+                start_new_session=True,  # SIGINT to the router can't nuke replicas mid-drain
+            )
+        finally:
+            log.close()
+
+    def _log_tail(self, r: _Replica, n: int = 2000) -> str:
+        try:
+            with open(r.log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                f.seek(max(0, f.tell() - n))
+                return f.read().decode(errors="replace")
+        except OSError:
+            return ""
+
+    async def _await_port(self, r: _Replica, deadline: float) -> None:
+        while True:
+            try:
+                with open(r.port_file, encoding="utf-8") as f:
+                    text = f.read().strip()
+                if text:
+                    r.port = int(text)
+                    return
+            except (OSError, ValueError):
+                pass
+            if not r.alive():
+                raise RuntimeError(
+                    f"replica {r.rid} exited (rc={r.proc.returncode}) before "
+                    f"binding a port; log tail:\n{self._log_tail(r)}"
+                )
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"replica {r.rid} did not report a port within "
+                    f"{self.startup_timeout_s:.0f}s; log tail:\n{self._log_tail(r)}"
+                )
+            await asyncio.sleep(0.05)
+
+    async def _respawn(self, r: _Replica) -> None:
+        r.restarts += 1
+        self._m_restarts.inc(replica=r.rid)
+        self._spawn(r)
+        await self._await_port(
+            r, time.monotonic() + self.startup_timeout_s
+        )
+
+    # -- tiny async HTTP ---------------------------------------------------
+
+    async def _replica_request(self, r: _Replica, method: str, path: str,
+                               headers: dict, body: bytes, timeout: float):
+        """One request/response against a replica over a fresh connection.
+        Returns ``(status, headers, body)``; raises :class:`_ReplicaError`."""
+        sent_box = [False]
+
+        async def _one():
+            reader, writer = await asyncio.open_connection("127.0.0.1", r.port)
+            try:
+                head = [
+                    f"{method} {path} HTTP/1.1",
+                    f"Host: 127.0.0.1:{r.port}",
+                    f"Content-Length: {len(body)}",
+                    "Connection: close",
+                ]
+                head += [f"{k}: {v}" for k, v in headers.items()]
+                writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + body)
+                sent_box[0] = True
+                await writer.drain()
+                status_line = await reader.readline()
+                if not status_line:
+                    raise ConnectionResetError("empty response")
+                parts = status_line.decode("latin1").split(None, 2)
+                status = int(parts[1])
+                rheaders: dict = {}
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = line.decode("latin1").partition(":")
+                    rheaders[k.strip().lower()] = v.strip()
+                n = int(rheaders.get("content-length", 0))
+                rbody = await reader.readexactly(n) if n else b""
+                return status, rheaders, rbody
+            finally:
+                writer.close()
+
+        try:
+            return await asyncio.wait_for(_one(), timeout)
+        except (OSError, asyncio.IncompleteReadError, asyncio.TimeoutError,
+                TimeoutError, ValueError, IndexError) as exc:
+            raise _ReplicaError(
+                f"replica {r.rid}: {type(exc).__name__}: {exc}",
+                sent=sent_box[0],
+            ) from exc
+
+    # -- routing -----------------------------------------------------------
+
+    def _hash_key(self, body: bytes) -> str:
+        m = _TENANT_RE.search(body[:4096])
+        if m is not None:
+            return (m.group(1) or m.group(2)).decode("utf-8", "replace")
+        return hashlib.md5(body).hexdigest()
+
+    def _route_order(self, route: str, body: bytes) -> list:
+        """Replicas in dispatch-preference order; down replicas go last so
+        a request placed while every replica is marked down still probes
+        one (it may have just recovered)."""
+        if self.policy == "consistent_hash":
+            start = bisect.bisect_left(self._ring_keys, _h(self._hash_key(body)))
+            order: list = []
+            for i in range(len(self._ring)):
+                rid = self._ring[(start + i) % len(self._ring)][1]
+                r = self._by_rid[rid]
+                if r not in order:
+                    order.append(r)
+                if len(order) == len(self.replicas):
+                    break
+        else:
+            order = sorted(
+                self.replicas, key=lambda r: (r.in_flight, r.failures, r.rid)
+            )
+        return sorted(order, key=lambda r: not r.up)
+
+    def _mark(self, r: _Replica, ok: bool) -> None:
+        r.up = ok
+        r.failures = 0 if ok else r.failures + 1
+        self._m_up.set(1.0 if ok else 0.0, replica=r.rid)
+
+    async def _proxy(self, route: str, headers: dict, body: bytes):
+        self._requests[route] = self._requests.get(route, 0) + 1
+        fwd = {"Content-Type": headers.get("content-type", "application/json")}
+        timeout = self.proxy_timeout_s
+        if headers.get("x-deadline-ms"):
+            fwd["X-Deadline-Ms"] = headers["x-deadline-ms"]
+            try:
+                timeout = min(timeout, float(headers["x-deadline-ms"]) / 1000.0)
+            except ValueError:
+                pass
+        order = self._route_order(route, body)
+        t0 = time.perf_counter()
+        attempts = 0
+        last_rid = order[0].rid if order else "none"
+        for r in order:
+            if r.port is None:
+                continue
+            attempts += 1
+            last_rid = r.rid
+            r.in_flight += 1
+            self._m_in_flight.set(r.in_flight, replica=r.rid)
+            try:
+                status, rheaders, rbody = await self._replica_request(
+                    r, "POST", route, fwd, body, timeout
+                )
+            except _ReplicaError as exc:
+                # Connection-refused never reached the replica: always safe
+                # to re-dispatch. After bytes were sent, only idempotent
+                # /predict (and /swap, a no-op republish) may retry.
+                self._mark(r, False)
+                self._m_reroutes.inc(replica=r.rid, route=route)
+                if exc.sent and route == "/ingest":
+                    self._emit_route(route, r.rid, 502, attempts, t0)
+                    return 502, {}, _json_body(
+                        {"error": f"replica {r.rid} failed mid-ingest: {exc}"}
+                    )
+                continue
+            finally:
+                r.in_flight -= 1
+                self._m_in_flight.set(r.in_flight, replica=r.rid)
+            self._mark(r, True)
+            self._emit_route(route, r.rid, status, attempts, t0)
+            out_headers = {
+                k: v for k, v in rheaders.items() if k not in _HOP_HEADERS
+                and k != "content-length"
+            }
+            out_headers["x-replica"] = r.rid
+            return status, out_headers, rbody
+        self._emit_route(route, last_rid, 503, max(attempts, 1), t0)
+        return 503, {"retry-after": f"{self.health_interval_s:.3f}"}, _json_body(
+            {"error": "no replica available", "reason": "fleet_unavailable"}
+        )
+
+    def _emit_route(self, route: str, rid: str, status: int,
+                    attempts: int, t0: float) -> None:
+        wall = time.perf_counter() - t0
+        self._m_requests.inc(replica=rid, route=route, status=str(status))
+        if self.tracer is not None:
+            self.tracer(
+                "fleet_route", replica=rid, route=route, policy=self.policy,
+                status=int(status), attempts=int(attempts),
+                wall_s=round(wall, 9),
+            )
+
+    # -- health ------------------------------------------------------------
+
+    async def _check_one(self, r: _Replica) -> None:
+        probe_timeout = max(0.05, min(2.0, self.health_interval_s))
+        ok = False
+        if r.port is not None:
+            try:
+                status, _, _ = await self._replica_request(
+                    r, "GET", "/healthz", {}, b"", probe_timeout
+                )
+                ok = status == 200
+            except _ReplicaError:
+                ok = False
+        self._mark(r, ok)
+        r.checks += 1
+        self._m_checks.inc(replica=r.rid, ok=str(ok).lower())
+        if self.tracer is not None:
+            self.tracer(
+                "replica_health", replica=r.rid, ok=bool(ok),
+                failures=int(r.failures), restarts=int(r.restarts),
+            )
+        if not ok and not r.alive() and self.restart and not self._shutdown.is_set():
+            try:
+                await self._respawn(r)
+            except RuntimeError:
+                pass  # next probe retries; the replica stays down meanwhile
+
+    async def _health_loop(self) -> None:
+        while not self._shutdown.is_set():
+            await asyncio.gather(
+                *(self._check_one(r) for r in self.replicas)
+            )
+            await asyncio.sleep(self.health_interval_s)
+
+    def health(self) -> dict:
+        n_up = sum(1 for r in self.replicas if r.up)
+        return {
+            "status": "ok" if n_up == len(self.replicas)
+            else ("degraded" if n_up else "down"),
+            "policy": self.policy,
+            "replicas": {
+                r.rid: {
+                    "up": r.up, "port": r.port,
+                    "pid": r.proc.pid if r.proc else None,
+                    "failures": r.failures, "in_flight": r.in_flight,
+                    "restarts": r.restarts, "checks": r.checks,
+                }
+                for r in self.replicas
+            },
+            "requests": dict(self._requests),
+            "health_interval_s": self.health_interval_s,
+        }
+
+    # -- metrics aggregation ----------------------------------------------
+
+    async def _aggregate_metrics(self) -> str:
+        from hdbscan_tpu.utils.metrics import (
+            MetricsRegistry, registry_from_exposition,
+        )
+
+        async def scrape(r: _Replica):
+            try:
+                status, _, body = await self._replica_request(
+                    r, "GET", "/metrics", {}, b"", min(2.0, self.proxy_timeout_s)
+                )
+                return r.rid, body if status == 200 else None
+            except _ReplicaError:
+                return r.rid, None
+
+        results = await asyncio.gather(
+            *(scrape(r) for r in self.replicas if r.port is not None)
+        )
+        agg = MetricsRegistry()
+        agg.merge(self.metrics)
+        for rid, body in results:
+            if body is None:
+                continue  # down replica: its series drop out of this scrape
+            agg.merge(
+                registry_from_exposition(
+                    body.decode("utf-8", "replace"), {"replica": rid}
+                )
+            )
+        return agg.render()
+
+    # -- front-end ---------------------------------------------------------
+
+    async def _handle_client(self, reader, writer) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                try:
+                    method, target, _ = line.decode("latin1").split(None, 2)
+                except ValueError:
+                    return
+                headers: dict = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.decode("latin1").partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                n = int(headers.get("content-length", 0) or 0)
+                body = await reader.readexactly(n) if n else b""
+                status, out_headers, out_body = await self._dispatch(
+                    method, target, headers, body
+                )
+                keep = headers.get("connection", "").lower() != "close"
+                head = [
+                    f"HTTP/1.1 {status} {_REASONS.get(status, 'OK')}",
+                    f"Content-Length: {len(out_body)}",
+                    f"Connection: {'keep-alive' if keep else 'close'}",
+                ]
+                if "content-type" not in out_headers:
+                    head.append("Content-Type: application/json")
+                head += [f"{k}: {v}" for k, v in out_headers.items()]
+                writer.write(
+                    ("\r\n".join(head) + "\r\n\r\n").encode() + out_body
+                )
+                await writer.drain()
+                if not keep:
+                    return
+        except (OSError, asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    async def _dispatch(self, method: str, target: str, headers: dict,
+                        body: bytes):
+        path = target.split("?", 1)[0]
+        if method == "GET" and path == "/healthz":
+            return 200, {}, _json_body(self.health())
+        if method == "GET" and path == "/metrics":
+            text = await self._aggregate_metrics()
+            return 200, {"content-type": "text/plain; version=0.0.4"}, \
+                text.encode()
+        if method == "POST" and path in ("/predict", "/ingest"):
+            return await self._proxy(path, headers, body)
+        if method == "POST" and path == "/swap":
+            return await self._broadcast_swap(headers, body)
+        return 404, {}, _json_body({"error": f"unknown route {path}"})
+
+    async def _broadcast_swap(self, headers: dict, body: bytes):
+        self._requests["/swap"] = self._requests.get("/swap", 0) + 1
+        fwd = {"Content-Type": headers.get("content-type", "application/json")}
+
+        async def one(r: _Replica):
+            if r.port is None:
+                return r.rid, {"error": "not started"}
+            try:
+                status, _, rbody = await self._replica_request(
+                    r, "POST", "/swap", fwd, body, self.proxy_timeout_s
+                )
+                try:
+                    payload = json.loads(rbody.decode() or "{}")
+                except ValueError:
+                    payload = {}
+                return r.rid, {"status": status, **payload}
+            except _ReplicaError as exc:
+                self._mark(r, False)
+                return r.rid, {"error": str(exc)}
+
+        results = dict(await asyncio.gather(*(one(r) for r in self.replicas)))
+        ok = all("error" not in v and v.get("status") == 200
+                 for v in results.values())
+        return (200 if ok else 502), {}, _json_body({"replicas": results})
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def _main(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        try:
+            deadline = time.monotonic() + self.startup_timeout_s
+            await asyncio.gather(
+                *(self._await_port(r, deadline) for r in self.replicas)
+            )
+            # First health pass before accepting: a fleet that reports
+            # ready has every replica warmed and answering.
+            while not all(r.up for r in self.replicas):
+                await asyncio.gather(
+                    *(self._check_one(r) for r in self.replicas)
+                )
+                if all(r.up for r in self.replicas):
+                    break
+                if time.monotonic() > deadline:
+                    bad = [r.rid for r in self.replicas if not r.up]
+                    raise RuntimeError(
+                        f"replicas {bad} not healthy within "
+                        f"{self.startup_timeout_s:.0f}s"
+                    )
+                await asyncio.sleep(0.1)
+            self._server = await asyncio.start_server(
+                self._handle_client, self.host, self.port or 0
+            )
+            self.port = self._server.sockets[0].getsockname()[1]
+        except BaseException as exc:
+            self._startup_error = exc
+            self._ready.set()
+            return
+        self._ready.set()
+        health = asyncio.ensure_future(self._health_loop())
+        try:
+            while not self._shutdown.is_set():
+                await asyncio.sleep(0.05)
+        finally:
+            health.cancel()
+            self._server.close()
+            await self._server.wait_closed()
+
+    def start(self) -> "FleetRouter":
+        """Spawn replicas, wait until every one is healthy, bind the front
+        port. Blocking; raises (after killing the spawned replicas) if the
+        fleet cannot come up."""
+        for r in self.replicas:
+            self._spawn(r)
+        self._thread = threading.Thread(
+            target=lambda: asyncio.run(self._main()),
+            name="fleet-router", daemon=True,
+        )
+        self._thread.start()
+        self._ready.wait(self.startup_timeout_s + 10.0)
+        if self._startup_error is not None or not self._ready.is_set():
+            err = self._startup_error or RuntimeError(
+                "fleet router startup timed out"
+            )
+            self.close()
+            raise RuntimeError(f"fleet startup failed: {err}") from err
+        if self.verbose:
+            print(
+                f"fleet: {self.n_replicas} replicas behind "
+                f"http://{self.host}:{self.port} (policy={self.policy})",
+                file=sys.stderr,
+            )
+        return self
+
+    def close(self, drain_s: float | None = None) -> bool:
+        """SIGTERM every replica and wait for the in-flight drain.
+
+        Each replica's SIGTERM handler runs ``ClusterServer.close()`` —
+        resolving every accepted request — before exiting. Returns True
+        when all replicas exit within the bound; a hung replica is
+        SIGKILLed and the result is False (the CLI turns that into a
+        nonzero exit). Idempotent; the first call's verdict sticks
+        (``drain_ok``).
+        """
+        with self._close_lock:
+            if self._closed:
+                return bool(self.drain_ok) if self.drain_ok is not None else True
+            self._closed = True
+            self._shutdown.set()
+            if self._thread is not None:
+                self._thread.join(timeout=10.0)
+            bound = self.drain_s if drain_s is None else float(drain_s)
+            for r in self.replicas:
+                if r.alive():
+                    r.proc.send_signal(signal.SIGTERM)
+            ok = True
+            deadline = time.monotonic() + bound
+            for r in self.replicas:
+                if r.proc is None:
+                    continue
+                try:
+                    r.proc.wait(timeout=max(0.0, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    ok = False
+                    r.proc.kill()
+                    try:
+                        r.proc.wait(timeout=5.0)
+                    except subprocess.TimeoutExpired:
+                        pass
+                self._mark(r, False)
+            self.drain_ok = ok
+            return ok
+
+    def serve_forever(self) -> int:
+        """Block until SIGTERM/SIGINT, then drain. Exit code 0 on a clean
+        drain, 1 when a replica hung past the bound."""
+        stop = threading.Event()
+
+        def _stop(signum, frame):
+            stop.set()
+
+        signal.signal(signal.SIGTERM, _stop)
+        signal.signal(signal.SIGINT, _stop)
+        try:
+            stop.wait()
+        finally:
+            clean = self.close()
+        return 0 if clean else 1
+
+    def __enter__(self) -> "FleetRouter":
+        # `with FleetRouter(...) as router:` implies a running fleet —
+        # start() is idempotent via _thread so an explicit
+        # `FleetRouter(...).start()` composes with `with` too.
+        if self._thread is None:
+            self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+_REASONS = {
+    200: "OK", 400: "Bad Request", 404: "Not Found", 408: "Request Timeout",
+    429: "Too Many Requests", 500: "Internal Server Error",
+    502: "Bad Gateway", 503: "Service Unavailable", 504: "Gateway Timeout",
+}
+
+
+def _json_body(obj) -> bytes:
+    return json.dumps(obj).encode()

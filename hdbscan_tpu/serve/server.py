@@ -220,9 +220,17 @@ class _Handler(BaseHTTPRequestHandler):
                 if path == "/predict":
                     points = np.asarray(payload["points"], np.float64)
                     meta["t_parse"] = time.perf_counter()
-                    out = srv.predict(
-                        points, bool(payload.get("membership", False)), meta=meta
-                    )
+                    tenant = payload.get("tenant")
+                    if tenant is not None:
+                        out = srv.tenant_predict(
+                            str(tenant), points,
+                            bool(payload.get("membership", False)), meta=meta,
+                        )
+                    else:
+                        out = srv.predict(
+                            points, bool(payload.get("membership", False)),
+                            meta=meta,
+                        )
                     rows = len(out["labels"])
                 elif path == "/ingest":
                     points = np.asarray(payload["points"], np.float64)
@@ -324,6 +332,7 @@ class ClusterServer:
         deadline_ms: float | None = None,
         wal_dir: str | None = None,
         fault_spec: str | None = None,
+        tenants=None,
     ):
         self.tracer = tracer
         self._backend_req = backend
@@ -409,6 +418,27 @@ class ClusterServer:
 
         self._handle = self._build_handle(model, generation=1)
         self._m_generation.set(1.0)
+
+        # Multi-tenant registry (``fleet/tenants.py``): a directory path
+        # builds one over its artifacts with this server's metrics/tracer
+        # attached; a prebuilt TenantRegistry is used as-is. None keeps the
+        # single-model behavior (a request with a tenant field gets 409).
+        self.tenants = None
+        if tenants is not None:
+            if isinstance(tenants, str):
+                from hdbscan_tpu.fleet.tenants import TenantRegistry
+
+                self.tenants = TenantRegistry.from_dir(
+                    tenants,
+                    backend=self._backend_req,
+                    max_batch=self._max_batch,
+                    lru_size=int(knob("tenant_lru_size", 8)),
+                    quota_rps=float(knob("tenant_quota_rps", 0.0)),
+                    metrics=self.metrics,
+                    tracer=tracer,
+                )
+            else:
+                self.tenants = tenants
 
         self.ingest_enabled = bool(ingest)
         self._params = params
@@ -698,6 +728,42 @@ class ClusterServer:
             "outlier_scores": [round(s, 6) for s in score.tolist()],
             "generation": handle.generation,
         }
+
+    def tenant_predict(
+        self, tenant: str, points, membership: bool = False,
+        meta: dict | None = None,
+    ) -> dict:
+        """Predict against one tenant's model via the registry: quota check
+        (429 ShedRequest on exceed), LRU touch, load + AOT warmup on a cold
+        tenant. Bypasses the micro-batcher like the membership path — the
+        tenant predictor's internal dispatch lock serializes, so the span
+        meta collapses queue/assemble to zero-width."""
+        if self.tenants is None:
+            raise RuntimeError(
+                "server started without a tenant registry (--tenants-dir)"
+            )
+        if meta is not None:
+            t = time.perf_counter()
+            meta["t_assembled"] = meta["t_dispatch"] = t
+        out, info = self.tenants.predict(
+            tenant, points, with_membership=membership
+        )
+        if meta is not None:
+            meta["t_done"] = time.perf_counter()
+            meta["coalesced"] = 1
+            meta["bucket"] = info["bucket"]
+        labels, prob, score = out[:3]
+        resp = {
+            "labels": labels.tolist(),
+            "probabilities": [round(p, 6) for p in prob.tolist()],
+            "outlier_scores": [round(s, 6) for s in score.tolist()],
+            "tenant": info["tenant"],
+            "generation": info["generation"],
+        }
+        if membership:
+            resp["membership"] = np.round(out[3], 6).tolist()
+            resp["selected_ids"] = info["selected_ids"]
+        return resp
 
     def ingest(self, points: np.ndarray, meta: dict | None = None) -> dict:
         """Streaming entry: predict → absorb/buffer → drift check → maybe

@@ -17,7 +17,15 @@ labelled ``site``, ``hdbscan_tpu_circuit_state`` a gauge whose every
 sample is exactly 0 (closed), 1 (half_open) or 2 (open) with a ``name``
 label, and ``hdbscan_tpu_refit_failures_total`` / the three
 ``hdbscan_tpu_wal_*_total`` families counters with integral non-negative
-values.
+values. Required labels are a SUBSET check: a fleet router's aggregated
+scrape (README "Fleet") re-tags every replica-origin series with a
+``replica`` label, which must not fail validation. Fleet families add
+their own contracts: the routing/health/tenant counters
+(``hdbscan_tpu_fleet_requests_total`` et al, see ``_FLEET_COUNTERS``)
+carry their required labels with integral non-negative values,
+``hdbscan_tpu_replica_up`` is a per-replica 0/1 gauge, the
+in-flight/resident gauges never go negative, and
+``hdbscan_tpu_tenant_predict_seconds`` is a histogram labelled by tenant.
 
 With two files (two scrapes of the same server, second taken later): also
 checks counter monotonicity — every counter-type sample and every
@@ -259,11 +267,15 @@ def _check_fault_metrics(parsed, where: str) -> list:
         for (name, label_items), value in samples.items():
             if name != fam:
                 continue
-            got = tuple(sorted(k for k, _ in label_items))
-            if got != tuple(sorted(want_labels)):
+            # Required labels are a SUBSET check, not equality: a fleet
+            # router's aggregated scrape re-tags every replica-origin
+            # series with a "replica" label on top of the family's own.
+            got = {k for k, _ in label_items}
+            missing = set(want_labels) - got
+            if missing:
                 errors.append(
-                    f"{where}: {fam} labels {got} != required "
-                    f"{tuple(sorted(want_labels))}"
+                    f"{where}: {fam} labels {tuple(sorted(got))} missing "
+                    f"required {tuple(sorted(missing))}"
                 )
             if value < 0 or value != int(value):
                 errors.append(
@@ -287,12 +299,84 @@ def _check_fault_metrics(parsed, where: str) -> list:
     return errors
 
 
+#: Fleet + tenant counter families (hdbscan_tpu/fleet) with their REQUIRED
+#: label names — same subset semantics as _FAULT_COUNTERS (an aggregated
+#: scrape adds "replica" to replica-origin series; the router's own
+#: families carry "replica" natively).
+_FLEET_COUNTERS = {
+    "hdbscan_tpu_fleet_requests_total": ("replica", "route", "status"),
+    "hdbscan_tpu_fleet_reroutes_total": ("replica", "route"),
+    "hdbscan_tpu_replica_health_checks_total": ("replica", "ok"),
+    "hdbscan_tpu_replica_restarts_total": ("replica",),
+    "hdbscan_tpu_tenant_requests_total": ("tenant", "outcome"),
+    "hdbscan_tpu_tenant_evictions_total": ("tenant",),
+    "hdbscan_tpu_tenant_loads_total": ("tenant",),
+}
+
+
+def _check_fleet_metrics(parsed, where: str) -> list:
+    """Fleet/tenant family contracts (fleet/router.py, fleet/tenants.py):
+    routing/health/tenant counters carry their required labels with
+    integral non-negative values, ``replica_up`` is a 0/1 gauge keyed by
+    replica, the in-flight/resident gauges never go negative, and the
+    per-tenant latency histogram carries a ``tenant`` label."""
+    errors: list = []
+    types, samples = parsed["types"], parsed["samples"]
+    for fam, want_labels in _FLEET_COUNTERS.items():
+        if fam in types and types[fam] != "counter":
+            errors.append(
+                f"{where}: {fam} declared {types[fam]!r}, want counter"
+            )
+        for (name, label_items), value in samples.items():
+            if name != fam:
+                continue
+            got = {k for k, _ in label_items}
+            missing = set(want_labels) - got
+            if missing:
+                errors.append(
+                    f"{where}: {fam} labels {tuple(sorted(got))} missing "
+                    f"required {tuple(sorted(missing))}"
+                )
+            if value < 0 or value != int(value):
+                errors.append(
+                    f"{where}: {fam}{dict(label_items)} value {value} not a "
+                    f"non-negative integer"
+                )
+    for fam, zero_one in (
+        ("hdbscan_tpu_replica_up", True),
+        ("hdbscan_tpu_replica_in_flight", False),
+        ("hdbscan_tpu_tenant_resident", False),
+    ):
+        if fam in types and types[fam] != "gauge":
+            errors.append(f"{where}: {fam} declared {types[fam]!r}, want gauge")
+        for (name, label_items), value in samples.items():
+            if name != fam:
+                continue
+            labels = dict(label_items)
+            if fam.startswith("hdbscan_tpu_replica") and not labels.get("replica"):
+                errors.append(f"{where}: {fam} sample lacks a 'replica' label")
+            if zero_one and value not in (0.0, 1.0):
+                errors.append(
+                    f"{where}: {fam}{labels} value {value} not in (0=down, 1=up)"
+                )
+            elif value < 0:
+                errors.append(f"{where}: {fam}{labels} value {value} negative")
+    fam = "hdbscan_tpu_tenant_predict_seconds"
+    if fam in types and types[fam] != "histogram":
+        errors.append(f"{where}: {fam} declared {types[fam]!r}, want histogram")
+    for (name, label_items), _ in samples.items():
+        if name == fam + "_count" and "tenant" not in dict(label_items):
+            errors.append(f"{where}: {fam} series lacks a 'tenant' label")
+    return errors
+
+
 def validate_exposition(text: str, where: str = "metrics"):
-    """Grammar + histogram-consistency + fault-family validation of one
-    scrape. Returns ``(parsed, errors)``."""
+    """Grammar + histogram-consistency + fault-family + fleet-family
+    validation of one scrape. Returns ``(parsed, errors)``."""
     parsed, errors = parse_exposition(text, where)
     errors += _check_histograms(parsed, where)
     errors += _check_fault_metrics(parsed, where)
+    errors += _check_fleet_metrics(parsed, where)
     return parsed, errors
 
 

@@ -74,7 +74,21 @@ string ``kind``, non-negative ``rows`` and a ``wal_seq`` that is CONTIGUOUS
 per (process, wal) — each append is exactly prev + 1, except a ``begin``
 record may reset to 0 (journal wipe on digest change / blue-green swap);
 ``wal_recover`` a string ``wal``, non-negative ``records``/``rows`` and a
-boolean ``snapshot``. Given
+boolean ``snapshot``.
+Fleet events (``hdbscan_tpu/fleet``, README "Fleet") add four schemas:
+``fleet_route`` must carry a non-empty string ``replica``, a ``route`` in
+``{/predict, /ingest}``, a ``policy`` in
+``{consistent_hash, least_loaded}``, an HTTP ``status`` int and a positive
+``attempts`` (how many replicas the router tried before this terminal
+answer — 1 on the happy path, more after re-routes); ``replica_health`` a
+non-empty string ``replica``, a boolean ``ok`` and non-negative
+``failures``/``restarts``; ``tenant_load`` a non-empty string ``tenant``,
+positive ``generation``, positive ``resident`` (the new tenant is resident
+when its load event fires) and non-negative ``jit_compiles`` (0 on a
+re-warm against a warmed bucket ladder — the zero-steady-state-recompile
+contract across evictions); ``tenant_evict`` a non-empty string
+``tenant``, positive ``generation`` and non-negative
+``resident``/``requests``. Given
 a report (``utils/telemetry.REPORT_SCHEMA``), additionally cross-checks
 that the report's per-phase wall totals equal the trace's per-stage wall
 sums within 1e-6, and — when the report carries a ``predict_latency``
@@ -310,6 +324,11 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
                                 f"{ev.get('wal')!r}"
                             )
                         last_wal_seq[key] = wseq
+            # Fleet invariants (hdbscan_tpu/fleet): router routing/health
+            # events and tenant-registry lifecycle events.
+            if stage in ("fleet_route", "replica_health", "tenant_load",
+                         "tenant_evict"):
+                errors += _check_fleet(path, lineno, stage, ev)
             # Per-device wall events: each device's timeline must be ordered.
             device = ev.get("device")
             if isinstance(device, int) and isinstance(seq, int):
@@ -570,6 +589,73 @@ def _check_fault(path: str, lineno: int, stage: str, ev: dict) -> list[str]:
                 )
         if not isinstance(ev.get("snapshot"), bool):
             errors.append(f"{where} snapshot={ev.get('snapshot')!r} not a bool")
+    return errors
+
+
+def _check_fleet(path: str, lineno: int, stage: str, ev: dict) -> list[str]:
+    """The four fleet event schemas (hdbscan_tpu/fleet/router.py,
+    fleet/tenants.py)."""
+    errors: list[str] = []
+    where = f"{path}:{lineno}: {stage}"
+    if stage == "fleet_route":
+        if not isinstance(ev.get("replica"), str) or not ev.get("replica"):
+            errors.append(f"{where} lacks a non-empty string 'replica'")
+        if ev.get("route") not in ("/predict", "/ingest"):
+            errors.append(
+                f"{where} route={ev.get('route')!r} not in (/predict, /ingest)"
+            )
+        if ev.get("policy") not in ("consistent_hash", "least_loaded"):
+            errors.append(
+                f"{where} policy={ev.get('policy')!r} not in "
+                f"(consistent_hash, least_loaded)"
+            )
+        status = ev.get("status")
+        if not isinstance(status, int) or isinstance(status, bool) or not (
+            100 <= status <= 599
+        ):
+            errors.append(f"{where} status={status!r} not an HTTP status int")
+        if not _pos_int(ev.get("attempts")):
+            errors.append(
+                f"{where} attempts={ev.get('attempts')!r} not a positive int"
+            )
+    elif stage == "replica_health":
+        if not isinstance(ev.get("replica"), str) or not ev.get("replica"):
+            errors.append(f"{where} lacks a non-empty string 'replica'")
+        if not isinstance(ev.get("ok"), bool):
+            errors.append(f"{where} ok={ev.get('ok')!r} not a bool")
+        for key in ("failures", "restarts"):
+            if not _nonneg_int(ev.get(key)):
+                errors.append(
+                    f"{where} {key}={ev.get(key)!r} not a non-negative int"
+                )
+    else:  # tenant_load / tenant_evict
+        if not isinstance(ev.get("tenant"), str) or not ev.get("tenant"):
+            errors.append(f"{where} lacks a non-empty string 'tenant'")
+        if not _pos_int(ev.get("generation")):
+            errors.append(
+                f"{where} generation={ev.get('generation')!r} not a "
+                f"positive int"
+            )
+        if stage == "tenant_load":
+            # The freshly loaded tenant is itself resident when the event
+            # fires, so resident is strictly positive here.
+            if not _pos_int(ev.get("resident")):
+                errors.append(
+                    f"{where} resident={ev.get('resident')!r} not a "
+                    f"positive int"
+                )
+            if not _nonneg_int(ev.get("jit_compiles")):
+                errors.append(
+                    f"{where} jit_compiles={ev.get('jit_compiles')!r} not a "
+                    f"non-negative int"
+                )
+        else:
+            for key in ("resident", "requests"):
+                if not _nonneg_int(ev.get(key)):
+                    errors.append(
+                        f"{where} {key}={ev.get(key)!r} not a "
+                        f"non-negative int"
+                    )
     return errors
 
 

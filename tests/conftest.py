@@ -22,6 +22,17 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    # Tier-1 (ROADMAP) runs `-m 'not slow'`; the slow lane holds the
+    # long e2e legs (multi-second sustained-load / full-stream runs).
+    # Opt in with `-m slow` or by dropping the filter.
+    config.addinivalue_line(
+        "markers",
+        "slow: long e2e leg, excluded from tier-1 (`-m 'not slow'`); "
+        "run with `pytest -m slow` or no marker filter",
+    )
+
+
 #: The reference checkout's bundled 149x4 dataset. Optional at test time:
 #: containers without the checkout SKIP the golden/oracle tests that need it
 #: instead of erroring (tests that hard-code the path carry their own

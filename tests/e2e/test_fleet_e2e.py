@@ -1,0 +1,318 @@
+"""End-to-end fleet acceptance (README "Fleet"): real replica
+subprocesses behind the asyncio router.
+
+Three legs:
+
+- **Multi-tenant**: 8 tenants over a 2-replica consistent-hash fleet with
+  a per-replica LRU (2) far under the tenant count — every tenant's
+  response bitwise-matches a dedicated single-model server over the same
+  artifact, evictions show up in the aggregated /metrics, a hammered
+  tenant sheds 429 with a Retry-After hint, and the router trace passes
+  ``scripts/check_trace.py``.
+- **Re-warm economics** (in-process): evicting and re-warming tenants
+  whose shapes were seen before costs zero jit compiles — the
+  steady-state-no-recompile property survives multi-tenancy.
+- **Chaos**: SIGKILL a replica mid-load — requests re-route in place
+  (faster than one health interval), the replica respawns and replays its
+  WAL with zero acked-ingest loss, no request hangs, and
+  served+shed+failed reconciles with offered.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu import HDBSCANParams
+from hdbscan_tpu.fleet import FleetRouter, TenantRegistry
+from hdbscan_tpu.models import hdbscan
+from hdbscan_tpu.utils.tracing import JsonlSink, Tracer
+from scripts import check_metrics, check_trace
+
+CENTERS = np.asarray([(0.0, 0.0, 0.0), (6.0, 6.0, 6.0), (0.0, 8.0, 0.0)])
+
+
+@pytest.fixture(scope="module")
+def fleet_model(tmp_path_factory):
+    """One small fitted artifact shared by every leg (the tenants are
+    copies of it, so tenant warmups ride the process jit cache)."""
+    rng = np.random.default_rng(11)
+    pts = CENTERS[np.arange(360) % 3] + rng.normal(0, 0.25, (360, 3))
+    params = HDBSCANParams(
+        min_points=5, min_cluster_size=25, processing_units=512,
+    )
+    model = hdbscan.fit(pts, params).to_cluster_model(pts, params)
+    path = str(tmp_path_factory.mktemp("fleet-model") / "model.npz")
+    model.save(path)
+    return path, pts
+
+
+def _post(base, path, obj, timeout=120):
+    """POST returning (status, headers, body) without raising on 4xx/5xx —
+    the 429/503 legs read the status and Retry-After like a client would."""
+    req = urllib.request.Request(
+        base + path, json.dumps(obj).encode(),
+        {"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, {k.lower(): v for k, v in r.headers.items()}, \
+                json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, {k.lower(): v for k, v in e.headers.items()}, \
+            json.loads(e.read() or b"{}")
+
+
+def _get(base, path, timeout=30):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def test_tenant_rewarm_costs_zero_recompiles(fleet_model, tmp_path):
+    """LRU thrash over tenants with identical shapes never recompiles:
+    after the first tenant's warmup, every further load/evict/re-warm is
+    a jit-cache hit (``tenant_load.jit_compiles == 0``)."""
+    from hdbscan_tpu.utils.telemetry import compile_counter
+
+    model_path, pts = fleet_model
+    trace = str(tmp_path / "tenants.jsonl")
+    tracer = Tracer(sinks=[JsonlSink(trace)])
+    reg = TenantRegistry(
+        {f"t{i}": model_path for i in range(4)},
+        max_batch=64, lru_size=2, tracer=tracer,
+    )
+    X = pts[:16]
+    reg.predict("t0", X)  # first load may compile (cold per-process cache)
+    counter = compile_counter()
+    before = counter()
+    for tenant in ("t1", "t2", "t3", "t0", "t1"):  # loads, evicts, re-warms
+        out, info = reg.predict(tenant, X)
+        assert info["tenant"] == tenant and len(out[0]) == 16
+    assert counter() - before == 0, "re-warm recompiled a seen shape"
+    assert reg.generation("t0") == 2  # evicted, then re-warmed: new gen
+    tracer.close()
+
+    events, errors = check_trace.validate_trace(trace)
+    assert not errors, errors
+    loads = [e for e in events if e["stage"] == "tenant_load"]
+    evicts = [e for e in events if e["stage"] == "tenant_evict"]
+    assert len(loads) >= 6 and evicts, "expected LRU churn"
+    assert all(e["resident"] <= 2 for e in evicts)
+    assert all(e["jit_compiles"] == 0 for e in loads[1:])
+
+
+def test_fleet_multi_tenant_matches_dedicated_server(fleet_model, tmp_path):
+    from hdbscan_tpu.serve import ClusterModel
+    from hdbscan_tpu.serve.server import ClusterServer
+
+    model_path, pts = fleet_model
+    tenants = [f"t{i}" for i in range(8)]
+    tdir = tmp_path / "tenants"
+    tdir.mkdir()
+    blob = open(model_path, "rb").read()
+    for t in tenants:
+        (tdir / f"{t}.npz").write_bytes(blob)
+
+    trace = str(tmp_path / "fleet.jsonl")
+    tracer = Tracer(sinks=[JsonlSink(trace)])
+    X = pts[:16].tolist()
+
+    # the reference: a dedicated single-model server over the same artifact
+    dedicated = ClusterServer(
+        ClusterModel.load(model_path), max_batch=64, port=0,
+    ).start()
+    try:
+        _, _, want = _post(
+            f"http://{dedicated.host}:{dedicated.port}", "/predict",
+            {"points": X},
+        )
+    finally:
+        dedicated.close()
+
+    router = FleetRouter(
+        model_path, replicas=2, policy="consistent_hash",
+        health_interval_s=0.3, tenants_dir=str(tdir),
+        replica_args=["predict_batch=64", "tenant_lru=2", "tenant_quota=5"],
+        tracer=tracer,
+    )
+    with router:
+        base = f"http://{router.host}:{router.port}"
+        homes = {}
+        for _ in range(2):  # round 2 re-touches: LRU churn on each replica
+            for t in tenants:
+                status, headers, out = _post(
+                    base, "/predict", {"points": X, "tenant": t}
+                )
+                assert status == 200, out
+                assert out["tenant"] == t and out["generation"] >= 1
+                # bitwise: the tenant answer IS the single-model answer
+                for k in ("labels", "probabilities", "outlier_scores"):
+                    assert out[k] == want[k], f"{t} diverged on {k}"
+                homes.setdefault(t, headers["x-replica"])
+                assert headers["x-replica"] == homes[t], (
+                    f"tenant {t} flapped replicas"
+                )
+        assert set(homes.values()) <= {"0", "1"}
+
+        # aggregated /metrics: per-replica + per-tenant series, evictions
+        # from the LRU (8 tenants >> lru=2 per replica) already counted
+        scrape = _get(base, "/metrics")
+        parsed, errors = check_metrics.validate_exposition(scrape, "fleet")
+        assert errors == [], errors
+        samples = parsed["samples"]
+        evicted = sum(
+            v for (name, labels), v in samples.items()
+            if name == "hdbscan_tpu_tenant_evictions_total"
+        )
+        assert evicted > 0
+        fleet_series = {
+            dict(labels)["replica"]
+            for (name, labels), v in samples.items()
+            if name == "hdbscan_tpu_fleet_requests_total"
+        }
+        assert {"0", "1"} <= fleet_series
+
+        # quota: burst of 5 rps per tenant per replica — a hammered tenant
+        # sheds 429 with a Retry-After hint, then recovers
+        codes = []
+        retry_after = None
+        for _ in range(14):
+            status, headers, _ = _post(
+                base, "/predict", {"points": X[:1], "tenant": "t0"}
+            )
+            codes.append(status)
+            if status == 429:
+                retry_after = headers.get("retry-after")
+        assert 429 in codes, f"quota never shed: {codes}"
+        assert retry_after is not None and float(retry_after) > 0.0
+        time.sleep(1.5)  # tokens refill at quota_rps
+        status, _, _ = _post(base, "/predict", {"points": X[:1], "tenant": "t0"})
+        assert status == 200
+
+        # unknown tenant maps to a client error, not a fleet failure
+        status, _, _ = _post(base, "/predict", {"points": X, "tenant": "nope"})
+        assert status in (400, 404)
+    assert router.drain_ok is True
+    tracer.close()
+
+    events, errors = check_trace.validate_trace(trace)
+    assert not errors, errors
+    routes = [e for e in events if e["stage"] == "fleet_route"]
+    assert routes and all(e["policy"] == "consistent_hash" for e in routes)
+    assert {e["status"] for e in routes} >= {200, 429}
+    assert [e for e in events if e["stage"] == "replica_health"]
+
+
+def test_fleet_chaos_sigkill_reroutes_and_replays_wal(fleet_model, tmp_path):
+    model_path, pts = fleet_model
+    trace = str(tmp_path / "chaos.jsonl")
+    tracer = Tracer(sinks=[JsonlSink(trace)])
+    rng = np.random.default_rng(3)
+    router = FleetRouter(
+        model_path, replicas=2, policy="least_loaded",
+        health_interval_s=0.25, ingest=True,
+        wal_root=str(tmp_path / "wal"),
+        replica_args=["predict_batch=64"], tracer=tracer,
+    )
+    with router:
+        base = f"http://{router.host}:{router.port}"
+        acked = {"0": 0, "1": 0}
+
+        def ingest(n_rows=16):
+            batch = CENTERS[np.arange(n_rows) % 3] + rng.normal(
+                0, 0.25, (n_rows, 3)
+            )
+            status, headers, out = _post(
+                base, "/ingest", {"points": batch.tolist()}
+            )
+            if status == 200:
+                acked[headers["x-replica"]] += out["rows"]
+            return status
+
+        # acked-before-the-crash rows: least_loaded breaks idle ties by
+        # rid, so sequential ingests all land on replica 0 — the victim
+        for _ in range(6):
+            assert ingest() == 200
+        assert acked["0"] > 0
+
+        # concurrent /predict load across the kill window ------------------
+        outcomes = {"served": 0, "shed": 0, "failed": 0, "offered": 0}
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                status, _, _ = _post(base, "/predict", {"points": pts[:8].tolist()})
+                with lock:
+                    outcomes["offered"] += 1
+                    if status == 200:
+                        outcomes["served"] += 1
+                    elif status in (429, 503):
+                        outcomes["shed"] += 1
+                    else:
+                        outcomes["failed"] += 1
+                time.sleep(0.02)
+
+        load = [threading.Thread(target=hammer, daemon=True) for _ in range(2)]
+        for t in load:
+            t.start()
+        time.sleep(0.5)
+
+        victim = router.replicas[0]
+        os.kill(victim.proc.pid, signal.SIGKILL)
+        # the very next routed request re-routes IN PLACE on connection
+        # refused — strictly faster than the one-health-interval bound
+        t0 = time.monotonic()
+        status, headers, _ = _post(base, "/predict", {"points": pts[:8].tolist()})
+        assert status == 200
+        assert time.monotonic() - t0 < router.health_interval_s + 2.0
+        assert headers["x-replica"] == "1"
+        assert ingest() == 200  # un-sent ingest re-dispatches safely too
+
+        # the router respawns the victim; its WAL replays the acked rows --
+        deadline = time.monotonic() + 150
+        while time.monotonic() < deadline:
+            h = router.health()["replicas"]["0"]
+            if h["restarts"] >= 1 and h["up"]:
+                break
+            time.sleep(0.25)
+        else:
+            pytest.fail(f"replica 0 never respawned: {router.health()}")
+
+        health = json.loads(_get(
+            f"http://127.0.0.1:{router.replicas[0].port}", "/healthz"
+        ))
+        recover = health["stream"]["wal"]["last_recover"]
+        assert recover["rows"] == acked["0"], "acked ingest rows lost"
+        assert health["stream"]["rows_seen"] >= acked["0"]
+        assert ingest() == 200  # the respawned replica takes traffic again
+
+        stop.set()
+        for t in load:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in load), "a request hung"
+    assert router.drain_ok is True
+    tracer.close()
+
+    # reconciliation: every offered request reached a terminal outcome,
+    # and the kill cost zero failures (predict re-routes freely)
+    assert outcomes["offered"] > 0
+    assert (outcomes["served"] + outcomes["shed"] + outcomes["failed"]
+            == outcomes["offered"])
+    assert outcomes["failed"] == 0, outcomes
+    assert outcomes["shed"] == 0, outcomes
+
+    events, errors = check_trace.validate_trace(trace)
+    assert not errors, errors
+    routes = [e for e in events if e["stage"] == "fleet_route"]
+    # in-place re-route is visible as a served request with attempts > 1
+    assert any(e["attempts"] > 1 and e["status"] == 200 for e in routes)
+    probes = [e for e in events if e["stage"] == "replica_health"]
+    assert any(not e["ok"] for e in probes)  # the probe saw the corpse
+    assert any(e["restarts"] >= 1 for e in probes)

@@ -136,3 +136,33 @@ def test_legacy_bare_invocation_still_fits(tmp_path):
                f"out_dir={tmp_path}"])
     assert rc == 0
     assert (tmp_path / "blobs_partition.csv").exists()
+
+
+def test_fleet_cli_validates_args(capsys):
+    """The fleet subcommand (README "Fleet") fails fast on a missing
+    --model or a bad fleet knob — exit 2 with the reason, before any
+    replica is spawned."""
+    from hdbscan_tpu.cli import HELP
+
+    assert main(["fleet"]) == 2
+    assert "--model" in capsys.readouterr().err
+    assert main(["fleet", "--model", "m.npz", "fleet_policy=round_robin"]) == 2
+    err = capsys.readouterr().err
+    assert "fleet_policy" in err and "round_robin" in err
+    assert main(["fleet", "--model", "m.npz", "fleet_replicas=0"]) == 2
+    assert "fleet_replicas" in capsys.readouterr().err
+    # the help text documents the subcommand and its knobs
+    for needle in ("fleet", "fleet_replicas=N", "fleet_policy=",
+                   "--tenants-dir DIR", "tenant_quota=F"):
+        assert needle in HELP, f"HELP missing {needle!r}"
+
+
+def test_fleet_cli_missing_model_fails_fast(tmp_path):
+    """A model path that doesn't exist dies at replica startup with exit
+    2, not a hang: the router's startup deadline converts a replica that
+    never binds its port into a loud error."""
+    rc = main([
+        "fleet", "--model", str(tmp_path / "nope.npz"),
+        "fleet_replicas=1", "fleet_health_interval=0.1",
+    ])
+    assert rc == 2

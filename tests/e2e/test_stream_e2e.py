@@ -4,7 +4,11 @@ flags the shift, a background re-fit publishes a generation-2 artifact,
 and the blue/green hot-swap lands with zero failed and zero mixed-model
 requests under concurrent /predict load. Post-swap quality is checked as
 ARI on shifted data against a from-scratch fit over the same distribution,
-and the whole trace passes scripts/check_trace.py."""
+and the whole trace passes scripts/check_trace.py.
+
+The full leg streams 10k points through a live server (~a minute on CPU),
+so it rides the documented ``slow`` lane — excluded from tier-1's
+``-m 'not slow'`` run; exercised via ``pytest -m slow``."""
 
 import json
 import threading
@@ -12,6 +16,7 @@ import time
 import urllib.request
 
 import numpy as np
+import pytest
 
 from hdbscan_tpu import HDBSCANParams
 from hdbscan_tpu.models import hdbscan, mr_hdbscan
@@ -43,6 +48,7 @@ def _post(base, path, obj):
         return json.loads(r.read())
 
 
+@pytest.mark.slow
 def test_stream_drift_refit_hot_swap(tmp_path):
     rng = np.random.default_rng(42)
     params = HDBSCANParams(

@@ -80,6 +80,44 @@ def test_trace_max_events_validates_eagerly():
     assert HDBSCANParams(trace_max_events=500).trace_max_events == 500
 
 
+def test_fleet_policy_validates_eagerly():
+    with pytest.raises(ValueError, match="fleet_policy") as exc:
+        HDBSCANParams(fleet_policy="round_robin")
+    msg = str(exc.value)
+    assert repr("round_robin") in msg
+    for value in ("consistent_hash", "least_loaded"):
+        assert f"'{value}'" in msg, f"error must list {value!r}"
+    for value in ("consistent_hash", "least_loaded"):
+        assert HDBSCANParams(fleet_policy=value).fleet_policy == value
+
+
+@pytest.mark.parametrize(
+    "field,bad",
+    [
+        ("fleet_replicas", 0),
+        ("fleet_replicas", -2),
+        ("fleet_health_interval_s", 0.0),
+        ("fleet_health_interval_s", -0.5),
+        ("fleet_drain_s", 0.0),
+        ("tenant_lru_size", 0),
+        ("tenant_quota_rps", -1.0),
+    ],
+)
+def test_fleet_knob_ranges(field, bad):
+    with pytest.raises(ValueError, match=field) as exc:
+        HDBSCANParams(**{field: bad})
+    assert repr(bad) in str(exc.value)
+
+
+def test_valid_fleet_values_construct():
+    p = HDBSCANParams(
+        fleet_replicas=1, fleet_health_interval_s=0.05, fleet_drain_s=1.0,
+        tenant_lru_size=1, tenant_quota_rps=0.0,  # 0 = unlimited
+    )
+    assert p.fleet_replicas == 1
+    assert p.tenant_quota_rps == 0.0
+
+
 def test_valid_backend_values_construct():
     for knn_index in ("auto", "exact", "rpforest"):
         p = HDBSCANParams(
@@ -109,5 +147,11 @@ def test_flag_parsing_roundtrip():
         ("refit_budget", "stream_refit_budget", int),
         ("stream_reload", "stream_reload", str),
         ("trace_max_events", "trace_max_events", int),
+        ("fleet_replicas", "fleet_replicas", int),
+        ("fleet_policy", "fleet_policy", str),
+        ("fleet_health_interval", "fleet_health_interval_s", float),
+        ("fleet_drain", "fleet_drain_s", float),
+        ("tenant_lru", "tenant_lru_size", int),
+        ("tenant_quota", "tenant_quota_rps", float),
     ):
         assert FLAG_FIELDS.get(flag) == (field, conv)

@@ -1,0 +1,341 @@
+"""Fleet routing and the multi-tenant registry (``hdbscan_tpu/fleet/``,
+README "Fleet") — the pieces that decide *where* a request lands and
+*which* model answers it, tested without spawning real replicas:
+
+- the consistent-hash ring is stable (same tenant -> same replica),
+  spreads tenants across the fleet, and moves only ~1/N of keys when the
+  fleet grows by one replica;
+- ``least_loaded`` orders replicas by (in_flight, failures, rid) with
+  down replicas last, so a fleet that just lost a replica still prefers
+  live ones without abandoning the dead one forever;
+- ``_replica_environ`` pins replica i to device ordinal ``i % devices``
+  for TPU/GPU platforms and leaves CPU untouched;
+- ``TenantRegistry`` evicts coldest-first at ``lru_size``, bumps
+  generations strictly, enforces the token-bucket quota with a 429
+  ``ShedRequest`` carrying ``retry_after_s``, and reports per-tenant SLO
+  verdicts;
+- ``close()`` SIGKILLs a replica that ignores SIGTERM past the drain
+  bound and reports the dirty drain (the CLI's nonzero exit).
+"""
+
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.fault.policy import ShedRequest
+from hdbscan_tpu.fleet import POLICIES, FleetRouter, TenantRegistry
+
+
+def _router(**kw):
+    kw.setdefault("replicas", 4)
+    return FleetRouter("/nonexistent/model.npz", **kw)
+
+
+def _body(tenant=None, n=8):
+    import json
+
+    payload = {"points": [[0.0, 0.0, 0.0]] * n}
+    if tenant is not None:
+        payload["tenant"] = tenant
+    return json.dumps(payload).encode()
+
+
+# -- constructor validation ----------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        {"replicas": 0},
+        {"policy": "round_robin"},
+        {"health_interval_s": 0.0},
+        {"health_interval_s": -1.0},
+        {"drain_s": 0.0},
+    ],
+)
+def test_router_ctor_rejects_bad_knobs(kw):
+    with pytest.raises(ValueError):
+        _router(**kw)
+    assert "round_robin" not in POLICIES
+
+
+# -- consistent-hash ring ------------------------------------------------------
+
+
+def test_ring_same_tenant_same_replica():
+    router = _router(policy="consistent_hash")
+    for tenant in ("acme", "globex", "t0", "t1"):
+        first = {router._route_order("/predict", _body(tenant))[0].rid
+                 for _ in range(5)}
+        assert len(first) == 1, f"tenant {tenant!r} flapped across {first}"
+
+
+def test_ring_spreads_tenants_and_falls_back_to_body_digest():
+    router = _router(policy="consistent_hash")
+    placed = {
+        router._route_order("/predict", _body(f"tenant-{i}"))[0].rid
+        for i in range(64)
+    }
+    assert len(placed) == len(router.replicas)  # every replica owns keys
+    # no tenant field: the key is a digest of the body, so different
+    # bodies may land differently but the SAME body is sticky
+    a = router._route_order("/predict", _body(None, n=4))[0].rid
+    b = router._route_order("/predict", _body(None, n=4))[0].rid
+    assert a == b
+    # integer tenant ids hash like their decimal string
+    import json
+
+    ibody = json.dumps({"tenant": 7, "points": []}).encode()
+    sbody = json.dumps({"tenant": "7", "points": []}).encode()
+    assert (router._route_order("/predict", ibody)[0].rid
+            == router._route_order("/predict", sbody)[0].rid)
+
+
+def test_ring_growth_moves_few_keys():
+    """Adding a replica re-homes ~1/N of tenants, not a rehash-everything
+    shuffle — the property that makes consistent hashing worth the ring."""
+    small = _router(replicas=4, policy="consistent_hash")
+    big = _router(replicas=5, policy="consistent_hash")
+    keys = [f"tenant-{i}" for i in range(400)]
+    moved = sum(
+        small._route_order("/predict", _body(k))[0].rid
+        != big._route_order("/predict", _body(k))[0].rid
+        for k in keys
+    )
+    # expectation is 1/5 = 80; anything under half rules out full rehash
+    assert moved < len(keys) // 2, f"{moved}/{len(keys)} keys moved"
+    assert moved > 0  # the new replica did take ownership of something
+
+
+def test_ring_down_replica_goes_last_but_stays_probed():
+    router = _router(policy="consistent_hash")
+    for r in router.replicas:
+        router._mark(r, True)
+    body = _body("sticky")
+    owner = router._route_order("/predict", body)[0]
+    router._mark(owner, False)
+    order = router._route_order("/predict", body)
+    assert order[0].rid != owner.rid
+    assert order[-1].rid == owner.rid  # still probed if everything else dies
+    router._mark(owner, True)
+    assert router._route_order("/predict", body)[0].rid == owner.rid
+
+
+# -- least-loaded ordering -----------------------------------------------------
+
+
+def test_least_loaded_orders_by_inflight_then_failures():
+    router = _router(policy="least_loaded")
+    r0, r1, r2, r3 = router.replicas
+    for r in router.replicas:
+        r.up = True
+    r0.in_flight, r1.in_flight, r2.in_flight, r3.in_flight = 3, 0, 0, 1
+    r1.failures, r2.failures = 2, 0
+    order = [r.rid for r in router._route_order("/predict", _body())]
+    assert order == ["2", "1", "3", "0"]
+    r2.up = False  # down: last despite zero load
+    order = [r.rid for r in router._route_order("/predict", _body())]
+    assert order == ["1", "3", "0", "2"]
+
+
+# -- device pinning ------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "platform,var",
+    [("tpu", "TPU_VISIBLE_CHIPS"), ("cuda", "CUDA_VISIBLE_DEVICES")],
+)
+def test_replica_environ_pins_devices(platform, var):
+    router = _router(
+        replicas=4, devices=2, replica_env={"JAX_PLATFORMS": platform},
+    )
+    ordinals = [
+        router._replica_environ(r)[var] for r in router.replicas
+    ]
+    assert ordinals == ["0", "1", "0", "1"]  # i % devices
+    for r in router.replicas:
+        env = router._replica_environ(r)
+        assert env["HDBSCAN_TPU_REPLICA_ID"] == r.rid
+
+
+def test_replica_environ_cpu_leaves_devices_alone():
+    router = _router(devices=2, replica_env={"JAX_PLATFORMS": "cpu"})
+    env = router._replica_environ(router.replicas[0])
+    assert "TPU_VISIBLE_CHIPS" not in env
+    assert "CUDA_VISIBLE_DEVICES" not in env
+
+
+# -- close() drain bound -------------------------------------------------------
+
+
+def _attach_proc(router, code):
+    r = router.replicas[0]
+    r.proc = subprocess.Popen([sys.executable, "-c", code])
+    return r
+
+
+def test_close_reports_dirty_drain_on_sigterm_ignorer():
+    """A replica that shrugs off SIGTERM is SIGKILLed at the drain bound
+    and close() returns False — serve_forever turns that into exit 1."""
+    router = _router(replicas=1)
+    r = _attach_proc(
+        router,
+        "import signal, time\n"
+        "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+        "time.sleep(120)\n",
+    )
+    time.sleep(0.3)  # let the child install its handler
+    t0 = time.monotonic()
+    assert router.close(drain_s=0.5) is False
+    assert time.monotonic() - t0 < 10.0
+    assert r.proc.poll() is not None  # SIGKILLed, not leaked
+    assert router.drain_ok is False
+    assert router.close() is False  # the first verdict sticks
+
+
+def test_close_clean_drain_returns_true():
+    router = _router(replicas=1)
+    r = _attach_proc(router, "import time; time.sleep(120)")
+    assert router.close(drain_s=10.0) is True
+    assert r.proc.returncode == -signal.SIGTERM
+    assert router.drain_ok is True
+
+
+# -- TenantRegistry ------------------------------------------------------------
+
+
+class _FakeModel:
+    def __init__(self, path):
+        self.path = path
+        self.selected_ids = np.arange(3)
+
+    @classmethod
+    def load(cls, path):
+        return cls(path)
+
+
+class _FakePredictor:
+    max_bucket = 64
+
+    def __init__(self, model, **kw):
+        self.model = model
+
+    def warmup(self):
+        return {"jit_compiles": 0}
+
+    def bucket_for(self, n):
+        return 16
+
+    def predict(self, X, with_membership=False):
+        n = len(X)
+        return np.full(n, 1), np.full(n, 0.5)
+
+
+@pytest.fixture
+def fake_serving(monkeypatch):
+    """TenantRegistry loads through serve.artifact/serve.predict at call
+    time; swap in cheap fakes so LRU/quota/generation logic runs without
+    real artifacts or jit warmups."""
+    from hdbscan_tpu.serve import artifact, predict
+
+    monkeypatch.setattr(artifact, "ClusterModel", _FakeModel)
+    monkeypatch.setattr(predict, "Predictor", _FakePredictor)
+
+
+class _ListTracer:
+    def __init__(self):
+        self.events = []
+
+    def __call__(self, stage, **fields):
+        self.events.append({"stage": stage, **fields})
+
+
+def _registry(n_tenants=4, **kw):
+    paths = {f"t{i}": f"/fake/t{i}.npz" for i in range(n_tenants)}
+    return TenantRegistry(paths, **kw)
+
+
+def test_tenant_registry_rejects_bad_knobs():
+    with pytest.raises(ValueError, match="lru_size"):
+        _registry(lru_size=0)
+    with pytest.raises(ValueError, match="quota_rps"):
+        _registry(quota_rps=-1.0)
+    with pytest.raises(ValueError, match="quota_rps"):
+        _registry(quota_rps=float("inf"))
+
+
+def test_tenant_lru_evicts_coldest_and_rewarm_bumps_generation(fake_serving):
+    tracer = _ListTracer()
+    reg = _registry(lru_size=2, tracer=tracer)
+    reg.checkout("t0")
+    reg.checkout("t1")
+    assert reg.resident() == ["t0", "t1"]
+    reg.checkout("t0")  # touch: t1 becomes coldest
+    reg.checkout("t2")  # miss at capacity -> t1 evicted
+    assert reg.resident() == ["t0", "t2"]
+    evicts = [e for e in tracer.events if e["stage"] == "tenant_evict"]
+    assert [e["tenant"] for e in evicts] == ["t1"]
+    assert evicts[0]["generation"] == 1 and evicts[0]["requests"] == 1
+    assert evicts[0]["resident"] == 2
+    # re-warm after eviction: a NEW generation, strictly increasing
+    assert reg.checkout("t1").generation == 2
+    assert reg.generation("t1") == 2
+    assert reg.generation("t0") == 1
+    loads = [e for e in tracer.events if e["stage"] == "tenant_load"]
+    assert all(e["resident"] >= 1 for e in loads)  # loaded tenant counts
+    with pytest.raises(KeyError, match="t99"):
+        reg.checkout("t99")
+
+
+def test_tenant_quota_sheds_429_with_retry_hint(fake_serving):
+    clock = [1000.0]
+    reg = _registry(lru_size=4, quota_rps=1.0, clock=lambda: clock[0])
+    reg.checkout("t0")  # burst token spent
+    with pytest.raises(ShedRequest) as exc:
+        reg.checkout("t0")
+    assert exc.value.status == 429
+    assert exc.value.retry_after_s > 0.0
+    assert exc.value.reason == "tenant_quota"
+    # quota is per-tenant: t1 is untouched
+    reg.checkout("t1")
+    # tokens refill at quota_rps: one second buys the next request
+    clock[0] += 1.0
+    reg.checkout("t0")
+    assert reg.stats()["shed"]["t0"] == 1
+
+
+def test_tenant_predict_info_and_slo_verdicts(fake_serving):
+    reg = _registry(lru_size=4)
+    X = np.zeros((8, 3))
+    out, info = reg.predict("t0", X)
+    assert len(out[0]) == 8
+    assert info["tenant"] == "t0" and info["generation"] == 1
+    assert info["bucket"] == 16 and "selected_ids" not in info
+    _, info = reg.predict("t0", X, with_membership=True)
+    assert info["selected_ids"] == [0, 1, 2]
+    verdicts = reg.slo_verdicts()
+    assert set(verdicts) == {"t0"}
+    assert verdicts["t0"]["ok"] is True  # fake predict is instant
+    assert verdicts["t0"]["observed"]["requests"] == 2
+    assert "p50_s" in verdicts["t0"]["observed"]
+
+
+def test_tenant_swap_replaces_resident_and_bumps_generation(fake_serving):
+    reg = _registry(lru_size=4)
+    e1 = reg.checkout("t0")
+    e2 = reg.swap("t0", "/fake/t0-v2.npz")
+    assert e2.generation == e1.generation + 1
+    assert reg.checkout("t0").model.path == "/fake/t0-v2.npz"
+
+
+def test_from_dir_requires_artifacts(tmp_path):
+    with pytest.raises(ValueError, match="no .npz"):
+        TenantRegistry.from_dir(str(tmp_path))
+    (tmp_path / "acme.npz").write_bytes(b"x")
+    (tmp_path / "notes.txt").write_bytes(b"x")
+    reg = TenantRegistry.from_dir(str(tmp_path))
+    assert reg.tenants() == ["acme"]

@@ -202,6 +202,147 @@ def test_merge_folds_state_exactly():
         ha.merge(mismatched)
 
 
+def _replica_registry(rid, routes):
+    """A registry shaped like one serve replica's /metrics: request
+    counters over ``routes``, an in-flight gauge, a latency histogram."""
+    reg = MetricsRegistry()
+    c = reg.counter("t_requests_total", "reqs", labelnames=("route", "status"))
+    g = reg.gauge("t_in_flight", "inflight")
+    h = reg.histogram("t_latency_seconds", "lat", labelnames=("route",),
+                      buckets=DEFAULT_LATENCY_BUCKETS)
+    for i, route in enumerate(routes):
+        c.inc(10 * (int(rid) + 1) + i, route=route, status="200")
+        for v in (0.001 * (int(rid) + 1), 0.02, 0.3):
+            h.observe(v, route=route)
+    g.set(float(rid))
+    return reg
+
+
+def test_fleet_merge_n_registries_overlapping_and_disjoint_labels():
+    """The router's aggregation path: scrape N replicas, re-parse each
+    with a ``replica`` static label, fold into one registry. Replicas 0/1
+    overlap on /predict (disambiguated only by the replica label) while
+    replica 2 brings a disjoint /swap series; nothing collides, nothing
+    is lost, and the aggregate render is a valid exposition."""
+    from hdbscan_tpu.utils.metrics import registry_from_exposition
+
+    routes = {"0": ("/predict", "/ingest"), "1": ("/predict",),
+              "2": ("/swap",)}
+    agg = MetricsRegistry()
+    agg.counter("t_requests_total", "reqs",
+                labelnames=("replica", "route", "status")).inc(
+        1, replica="router", route="/predict", status="503")
+    for rid, rroutes in routes.items():
+        scrape = _replica_registry(rid, rroutes).render()
+        agg.merge(registry_from_exposition(scrape, {"replica": rid}))
+    c = agg.get("t_requests_total")
+    assert c.value(replica="0", route="/predict", status="200") == 10.0
+    assert c.value(replica="1", route="/predict", status="200") == 20.0
+    assert c.value(replica="2", route="/swap", status="200") == 30.0
+    assert c.value(replica="0", route="/ingest", status="200") == 11.0
+    # the router's own pre-existing series survives the merges
+    assert c.value(replica="router", route="/predict", status="503") == 1.0
+    g = agg.get("t_in_flight")
+    assert [g.value(replica=r) for r in ("0", "1", "2")] == [0.0, 1.0, 2.0]
+    parsed, errors = check_metrics.validate_exposition(agg.render(), "agg")
+    assert errors == []
+    # every replica-origin series carries the replica label
+    for (name, labels), _ in parsed["samples"].items():
+        assert dict(labels).get("replica"), (name, labels)
+
+
+def test_fleet_merge_histogram_state_exact():
+    """Histogram folding is exact at bucket resolution: cumulative bucket
+    counts, _sum, and _count of the aggregate equal the element-wise sums
+    of the replicas' — through the text round-trip the router uses."""
+    from hdbscan_tpu.utils.metrics import registry_from_exposition
+
+    regs = {rid: _replica_registry(rid, ("/predict",)) for rid in "012"}
+    agg = MetricsRegistry()
+    for rid, reg in regs.items():
+        agg.merge(registry_from_exposition(reg.render(), {"replica": rid}))
+    h = agg.get("t_latency_seconds")
+    for rid, reg in regs.items():
+        src = reg.get("t_latency_seconds")
+        assert h.count(replica=rid, route="/predict") == src.count(
+            route="/predict")
+        assert h.total(replica=rid, route="/predict") == pytest.approx(
+            src.total(route="/predict"))
+    # fold the per-replica series once more into a replica-less registry:
+    # overlapping label sets now MERGE instead of sitting side by side
+    flat_a = registry_from_exposition(regs["0"].render())
+    flat_a.merge(registry_from_exposition(regs["1"].render()))
+    fh = flat_a.get("t_latency_seconds")
+    assert fh.count(route="/predict") == 6
+    assert fh.total(route="/predict") == pytest.approx(
+        (0.001 + 0.02 + 0.3) + (0.002 + 0.02 + 0.3))
+    parsed, errors = check_metrics.validate_exposition(flat_a.render(), "flat")
+    assert errors == []
+    # cumulative bucket counts are the element-wise sum of the sources
+    buckets = {
+        dict(labels)["le"]: v
+        for (name, labels), v in parsed["samples"].items()
+        if name == "t_latency_seconds_bucket"
+    }
+    for le, v in buckets.items():
+        want = sum(
+            sum(1 for x in obs if x <= float(le))
+            for obs in ((0.001, 0.02, 0.3), (0.002, 0.02, 0.3))
+        )
+        assert v == want, (le, v, want)
+
+
+def test_registry_from_exposition_round_trips_render():
+    """parse(render(reg)) reproduces every sample value — the property
+    that makes scrape-text a faithful transport between processes."""
+    from hdbscan_tpu.utils.metrics import registry_from_exposition
+
+    reg = _replica_registry("0", ("/predict", "/ingest"))
+    parsed_src, errs_src = check_metrics.validate_exposition(
+        reg.render(), "src")
+    back = registry_from_exposition(reg.render())
+    parsed_back, errs_back = check_metrics.validate_exposition(
+        back.render(), "back")
+    assert errs_src == errs_back == []
+    assert parsed_back["samples"] == parsed_src["samples"]
+    # garbage fails loudly, not silently smaller
+    with pytest.raises(ValueError, match="unparseable"):
+        registry_from_exposition("t_requests_total{oops\n")
+    with pytest.raises(ValueError, match="TYPE"):
+        registry_from_exposition("no_type_line 1\n")
+
+
+def test_scrape_during_merge_stays_parseable():
+    """A /metrics scrape racing the router's aggregation merge must always
+    see a parseable exposition — merge() and render() share the registry
+    lock discipline."""
+    from hdbscan_tpu.utils.metrics import registry_from_exposition
+
+    scrapes = [
+        _replica_registry(str(i % 3), ("/predict",)).render()
+        for i in range(12)
+    ]
+    agg = MetricsRegistry()
+    errors, stop = [], threading.Event()
+
+    def scraper():
+        while not stop.is_set():
+            _, errs = check_metrics.validate_exposition(agg.render(), "live")
+            errors.extend(errs)
+
+    threads = [threading.Thread(target=scraper) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for i, text in enumerate(scrapes * 5):
+        agg.merge(registry_from_exposition(text, {"replica": str(i)}))
+    stop.set()
+    for t in threads:
+        t.join()
+    assert errors == []
+    _, errs = check_metrics.validate_exposition(agg.render(), "final")
+    assert errs == []
+
+
 def test_tracer_ring_buffer_bounds_memory_not_sinks():
     from hdbscan_tpu.utils.tracing import Tracer
 
