@@ -7,6 +7,9 @@ Telemetry: ``--trace-out PATH`` / ``--report PATH`` (same contract as the
 CLI, README "Observability") persist every pipeline stage event across the
 warm+timed runs as JSONL and write a run-report JSON with the manifest,
 per-phase aggregates, device memory samples and per-phase compile counts.
+A per-phase device-memory auditor (``hdbscan_tpu/obs``) is always installed:
+each leg's peak per-device bytes land in a ``mem_watermarks`` field of the
+JSON line and the report's ``memory.watermarks`` table.
 Flags absent = no telemetry file I/O: fit calls get a collect-only in-memory
 tracer (no sinks), which the bench itself needs to report ``tree_wall_s`` —
 the host finalize wall (merge forest + condense + extract, the ``tree_*``
@@ -232,9 +235,16 @@ def _slo(argv: list[str]) -> None:
     if argv:
         raise SystemExit(f"bench.py slo: unknown arguments {argv!r}")
 
-    _, model, _, sampler, fit_wall, n = _synthetic_model()
     sinks = [JsonlSink(trace_out, static={"process": 0})] if trace_out else []
     tracer = Tracer(sinks=sinks)
+    # Per-phase device-memory auditor (README "Observability"): installed
+    # BEFORE the synthetic fit so the leg's JSON line and report carry the
+    # fit's per-phase watermarks, not just start/end snapshots.
+    from hdbscan_tpu import obs
+
+    auditor = obs.MemoryAuditor(tracer=tracer)
+    obs.install(auditor=auditor)
+    _, model, _, sampler, fit_wall, n = _synthetic_model()
     srv = ClusterServer(model, max_batch=256, port=0, tracer=tracer).start()
     base = f"http://127.0.0.1:{srv.port}"
     try:
@@ -403,6 +413,9 @@ def _slo(argv: list[str]) -> None:
                 "slo_ok": verdict["ok"],
                 "slo_targets": verdict["targets"],
                 **fleet_fields,
+                "mem_watermarks": telemetry.json_sanitize(
+                    auditor.watermark_table()
+                ),
                 "platform": jax.devices()[0].platform,
                 "cpu_smoke": jax.devices()[0].platform != "tpu",
             }
@@ -421,6 +434,7 @@ def _slo(argv: list[str]) -> None:
                 ),
             ),
         )
+    obs.clear()
 
 
 def _chaos(argv: list[str]) -> None:
@@ -596,6 +610,14 @@ def main(argv: list[str] | None = None) -> None:
         if report_out is not None:
             mem_start = telemetry.sample_device_memory()
     tracer = Tracer(sinks=sinks, counters=counters)
+    # Per-phase device-memory auditor: every leg's fit phases land in one
+    # watermark table (printed in the JSON line, merged into the report by
+    # build_report) — replacing the start/end-only sampling of earlier
+    # rounds.
+    from hdbscan_tpu import obs
+
+    auditor = obs.MemoryAuditor(tracer=tracer)
+    obs.install(auditor=auditor)
 
     # Persistent XLA cache (r5): compiles are a one-time per-machine cost,
     # as in any production JAX deployment; the in-process median-of-3
@@ -972,10 +994,15 @@ def main(argv: list[str] | None = None) -> None:
                 **predict_fields,
                 **stream_fields,
                 **ring_fields,
+                "mem_watermarks": {
+                    phase: wm["max_device_bytes"]
+                    for phase, wm in auditor.watermark_table().items()
+                },
             }
         )
     )
 
+    obs.clear()
     tracer.close()
     if report_out is not None:
         from hdbscan_tpu.utils import telemetry

@@ -17,6 +17,7 @@ Usage mirrors the reference's documented contract (``main/Main.java:534-614``)::
         [mst_backend={auto,host,device}] \
         [consensus=N] [compat_cf={true,false}] \
         [clusterName={local,auto,<host:port>,<pid>,<np>}] \
+        [heartbeat=F] [watchdog=F] [--assert-not-replicated] \
         [--trace-out PATH] [--report PATH] [--compile-cache {auto,off,DIR}]
 
 Telemetry (README "Observability"): ``--trace-out PATH`` appends every
@@ -24,8 +25,20 @@ pipeline stage event as a schema-versioned JSON line (multi-host runs write
 one ``PATH``-derived file per process: ``trace.<process_index>.jsonl``);
 ``--report PATH`` writes a run-report JSON — manifest (config, backends,
 device topology, env overrides), per-phase wall/GFLOP/MFU/compile aggregates,
-sampled device memory, and per-host phase walls when several processes ran.
-With both flags absent no telemetry file I/O happens.
+per-phase device-memory watermarks, and per-host phase walls when several
+processes ran. With both flags absent no telemetry file I/O happens.
+
+Deep observability (README "Observability", ``hdbscan_tpu/obs/``): with
+either telemetry flag, a per-phase device-memory auditor samples every
+device around each traced fit phase (``mem_sample``/``mem_phase_peak``
+events + a ``memory.watermarks`` report table), and long-running phases
+emit periodic ``heartbeat`` events with monotone progress fractions and an
+ETA. ``heartbeat=F`` sets the emission cadence (seconds, default 1.0);
+``watchdog=F`` arms a hang watchdog that dumps every Python thread's stack
+to the trace and stderr when no phase beats within F seconds (0 = off).
+``--assert-not-replicated`` checks the audited watermarks after the fit and
+exits nonzero if any single device's memory grew by ~n*itemsize during a
+sharded phase — i.e. an O(n) buffer was replicated instead of sharded.
 
 ``knn_index`` picks the neighbor-graph TIER (README "Approximate
 neighbors"): ``exact`` (default) keeps the O(n²) scans bitwise-unchanged,
@@ -78,7 +91,8 @@ invocation still means ``fit`` (the reference-compatible form above)::
         [tenant_lru=N] [tenant_quota=F]
     python -m hdbscan_tpu fleet --model MODEL.npz [--host H] [--port P] \
         [--model-dir DIR] [--tenants-dir DIR] [--ingest] [--wal-root DIR] \
-        [--trace-out PATH] [--report PATH] [fleet_replicas=N] \
+        [--trace-out PATH] [--report PATH] [--replica-trace-dir DIR] \
+        [fleet_replicas=N] \
         [fleet_policy={consistent_hash,least_loaded}] \
         [fleet_health_interval=F] [fleet_drain=F] \
         [<replica serve knobs, forwarded verbatim>]
@@ -144,6 +158,12 @@ generations and a ``tenant_quota`` req/s token bucket (exceed = 429 +
 Retry-After); ``POST /predict`` bodies gain an optional ``"tenant"`` field.
 ``serve --port-file PATH`` writes the bound port to PATH after the socket
 binds (how the fleet router discovers each replica's ephemeral port).
+``fleet --replica-trace-dir DIR`` gives every replica its own
+``--trace-out`` file under DIR; the router stamps ``X-Request-Id`` on every
+proxied request and emits a ``router_span`` per request, so
+``scripts/check_trace.py --join ROUTER.jsonl DIR/replica_*.jsonl`` (or
+``hdbscan_tpu.obs.correlate.merge_fleet_traces``) reconstructs every
+router -> replica causal chain by request id.
 """
 
 from __future__ import annotations
@@ -214,6 +234,7 @@ def _main_fit(argv: list[str]) -> int:
         report_out = _pop_path_flag(argv, "--report")
         compile_cache_flag = _pop_path_flag(argv, "--compile-cache")
         model_out = _pop_path_flag(argv, "--model-out")
+        assert_not_replicated = _pop_bool_flag(argv, "--assert-not-replicated")
         params = HDBSCANParams.from_args(argv)
         if compile_cache_flag is not None:
             import dataclasses
@@ -306,6 +327,27 @@ def _main_fit(argv: list[str]) -> int:
         counters=counters,
         max_events=params.trace_max_events,
     )
+    # Deep observability (hdbscan_tpu/obs): the per-phase memory auditor and
+    # heartbeat/watchdog hub install once per fit when telemetry is on (or
+    # the replication gate was requested — it needs audited watermarks).
+    # Uninstalled, every fit-path obs call is a no-op attribute check.
+    from hdbscan_tpu import obs
+
+    installed_obs = False
+    if (telemetry_on or assert_not_replicated) and obs.auditor() is None:
+        from hdbscan_tpu.obs.audit import MemoryAuditor
+        from hdbscan_tpu.obs.heartbeat import Heartbeats
+
+        obs.install(
+            auditor=MemoryAuditor(tracer=tracer),
+            heartbeats=Heartbeats(
+                tracer,
+                heartbeat_s=params.heartbeat_s,
+                watchdog_s=params.watchdog_s,
+            ),
+        )
+        installed_obs = True
+
     mem_start = None
     if report_out is not None:
         from hdbscan_tpu.utils import telemetry
@@ -341,6 +383,27 @@ def _main_fit(argv: list[str]) -> int:
         wall = time.monotonic() - t0
         tracer("fit", mode=mode.split(" ")[0], rows=n, wall_s=round(wall, 6))
         fit_done = True
+
+        if assert_not_replicated:
+            from hdbscan_tpu.obs.audit import ReplicatedBufferError
+
+            try:
+                gate = obs.assert_not_replicated(n, data.dtype.itemsize)
+            except ReplicatedBufferError as e:
+                print(f"error: replicated device buffer: {e}", file=sys.stderr)
+                return 3
+            except RuntimeError as e:
+                # No audited phases (e.g. a path the auditor doesn't cover
+                # yet): the gate must fail loudly, not pass vacuously.
+                print(f"error: {e}", file=sys.stderr)
+                return 3
+            tracer(
+                "replication_gate",
+                ok=True,
+                threshold_bytes=int(gate["threshold_bytes"]),
+                worst_fraction=gate["worst_fraction"],
+                phases=len(gate["phases"]),
+            )
 
         if is_main:
             t0 = time.monotonic()
@@ -384,6 +447,10 @@ def _main_fit(argv: list[str]) -> int:
                 for line in summary.splitlines():
                     print(f"  {line}", file=sys.stderr)
     finally:
+        # Uninstall the fit's auditor/heartbeats (stops the watchdog thread)
+        # before the tracer flushes — nothing may emit after close.
+        if installed_obs:
+            obs.clear()
         # Flush/close trace sinks BEFORE the exit barrier: the coordinator
         # reads every rank's trace file right after the barrier releases.
         tracer.close()
@@ -644,6 +711,7 @@ def _main_fleet(argv: list[str], argv_full: list[str]) -> int:
         model_dir = _pop_path_flag(argv, "--model-dir")
         tenants_dir = _pop_path_flag(argv, "--tenants-dir")
         wal_root = _pop_path_flag(argv, "--wal-root")
+        replica_trace_dir = _pop_path_flag(argv, "--replica-trace-dir")
         ingest = _pop_bool_flag(argv, "--ingest")
         params = HDBSCANParams.from_args(argv)
         port = int(port) if port is not None else 0
@@ -677,6 +745,7 @@ def _main_fleet(argv: list[str], argv_full: list[str]) -> int:
             ingest=ingest,
             wal_root=wal_root,
             tracer=tracer,
+            replica_trace_dir=replica_trace_dir,
             verbose=True,
         )
         try:

@@ -347,6 +347,17 @@ class HDBSCANParams:
     #: burst = max(1, quota)); an over-quota request is refused with HTTP
     #: 429 + Retry-After. 0 = unlimited.
     tenant_quota_rps: float = 0.0
+    #: Minimum spacing between emitted ``heartbeat`` trace events per
+    #: progress task (``hdbscan_tpu/obs`` — Borůvka rounds, ring panel
+    #: sweeps, rpforest tree builds, refits). Beats arriving faster are
+    #: throttled; the liveness clock still refreshes on every beat.
+    heartbeat_s: float = 1.0
+    #: Hang-watchdog stall budget in seconds: with fit/refit tasks active
+    #: and no heartbeat for this long, a watchdog thread dumps every Python
+    #: thread's stack to the trace (``watchdog_stall``) and stderr, and
+    #: bumps ``hdbscan_tpu_watchdog_stalls_total``. 0 (default) disables
+    #: the watchdog thread.
+    watchdog_s: float = 0.0
     #: Bound on the Tracer's in-memory event list (0 = unbounded). Sinks
     #: (the on-disk JSONL trace) always see every event; the bound only
     #: rings the in-memory view so a long-running ``serve --ingest``
@@ -507,6 +518,15 @@ class HDBSCANParams:
                 "tenant_quota_rps must be >= 0 (0 = unlimited), "
                 f"got {self.tenant_quota_rps!r}"
             )
+        if not self.heartbeat_s > 0:
+            raise ValueError(
+                f"heartbeat_s must be > 0, got {self.heartbeat_s!r}"
+            )
+        if self.watchdog_s < 0:
+            raise ValueError(
+                "watchdog_s must be >= 0 (0 = watchdog off), "
+                f"got {self.watchdog_s!r}"
+            )
         if self.trace_max_events < 0:
             raise ValueError(
                 "trace_max_events must be >= 0 (0 = unbounded), "
@@ -618,6 +638,8 @@ FLAG_FIELDS = {
     "fleet_drain": ("fleet_drain_s", float),
     "tenant_lru": ("tenant_lru_size", int),
     "tenant_quota": ("tenant_quota_rps", float),
+    "heartbeat": ("heartbeat_s", float),
+    "watchdog": ("watchdog_s", float),
     "trace_max_events": ("trace_max_events", int),
     "max_samples": ("max_samples", int),
     "compat_cf": ("compat_cf_int_math", _bool),

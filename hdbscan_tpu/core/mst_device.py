@@ -418,36 +418,39 @@ def build_merge_forest_device(
         return None  # trivial pools: the host builder is already O(1)
     if point_weights is not None and not supports_inputs([], point_weights):
         return None  # non-integral weights: interval sums would diverge
+    from hdbscan_tpu import obs
+
     t0 = time.monotonic()
-    # Host pools pre-sort here (np.lexsort beats the device sort on CPU
-    # and the scan needs the canonical order either way); device-resident
-    # pools go through the in-program lexsort instead.
-    if not isinstance(u, jax.Array):
-        u = np.asarray(u)
-        v = np.asarray(v)
-        w = np.asarray(w)
-        # Without jax_enable_x64 (the production default) a float64 host
-        # pool would silently downcast to float32 on device and the forest
-        # dists would no longer be bitwise-equal to the host builder's.
-        # Decline unless the weights are exactly float32-representable
-        # (device-native f32 pools and lattice weights always are).
-        if w.dtype == np.float64 and not jax.config.jax_enable_x64:
-            if not np.array_equal(w, w.astype(np.float32).astype(np.float64)):
-                return None
-        order = np.lexsort((v, u, w))
-        out = forest_events_device(
-            jnp.asarray(u[order]),
-            jnp.asarray(v[order]),
-            jnp.asarray(w[order]),
-            n,
-            presorted=True,
-        )
-    else:
-        out = forest_events_device(u, v, w, n)
-    build_wall = time.monotonic() - t0
-    t0 = time.monotonic()
-    fetched = jax.device_get(out)
-    sync_wall = time.monotonic() - t0
+    with obs.mem_phase("tree_build_device"):
+        # Host pools pre-sort here (np.lexsort beats the device sort on CPU
+        # and the scan needs the canonical order either way); device-resident
+        # pools go through the in-program lexsort instead.
+        if not isinstance(u, jax.Array):
+            u = np.asarray(u)
+            v = np.asarray(v)
+            w = np.asarray(w)
+            # Without jax_enable_x64 (the production default) a float64 host
+            # pool would silently downcast to float32 on device and the forest
+            # dists would no longer be bitwise-equal to the host builder's.
+            # Decline unless the weights are exactly float32-representable
+            # (device-native f32 pools and lattice weights always are).
+            if w.dtype == np.float64 and not jax.config.jax_enable_x64:
+                if not np.array_equal(w, w.astype(np.float32).astype(np.float64)):
+                    return None
+            order = np.lexsort((v, u, w))
+            out = forest_events_device(
+                jnp.asarray(u[order]),
+                jnp.asarray(v[order]),
+                jnp.asarray(w[order]),
+                n,
+                presorted=True,
+            )
+        else:
+            out = forest_events_device(u, v, w, n)
+        build_wall = time.monotonic() - t0
+        t0 = time.monotonic()
+        fetched = jax.device_get(out)
+        sync_wall = time.monotonic() - t0
     if trace is not None:
         trace(
             "host_sync",
@@ -643,13 +646,15 @@ def boruvka_mst_device(
     Returns DEVICE arrays — callers feed them straight into
     ``forest_events_device`` and fetch once.
     """
+    from hdbscan_tpu import obs
     from hdbscan_tpu.ops.tiled import _pad_rows, _tile_sizes
 
     n = len(data)
     row_tile, col_tile, n_pad = _tile_sizes(n, row_tile, col_tile)
-    data_p = jnp.asarray(_pad_rows(np.asarray(data, dtype), n_pad))
-    core_p = jnp.asarray(_pad_rows(np.asarray(core, dtype), n_pad))
-    valid = jnp.asarray(np.arange(n_pad) < n)
-    return _boruvka_rounds_device(
-        data_p, core_p, valid, n, metric, row_tile, col_tile, max_rounds
-    )
+    with obs.mem_phase("boruvka_rounds_device"):
+        data_p = jnp.asarray(_pad_rows(np.asarray(data, dtype), n_pad))
+        core_p = jnp.asarray(_pad_rows(np.asarray(core, dtype), n_pad))
+        valid = jnp.asarray(np.arange(n_pad) < n)
+        return _boruvka_rounds_device(
+            data_p, core_p, valid, n, metric, row_tile, col_tile, max_rounds
+        )

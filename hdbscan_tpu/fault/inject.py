@@ -46,6 +46,7 @@ FAULT_SITES = (
     "batcher_submit",    # micro-batcher enqueue
     "http_reset",        # server drops the connection without a response
     "slow_request",      # server stalls delay_s before handling
+    "phase_stall",       # heartbeat beat() sleeps delay_s before refreshing liveness (watchdog tests)
 )
 
 

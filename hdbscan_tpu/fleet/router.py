@@ -49,6 +49,7 @@ from __future__ import annotations
 import asyncio
 import bisect
 import hashlib
+import itertools
 import json
 import os
 import re
@@ -134,7 +135,7 @@ class FleetRouter:
                  devices: int | None = None, restart: bool = True,
                  startup_timeout_s: float = 180.0, proxy_timeout_s: float = 30.0,
                  run_dir: str | None = None, tracer=None, metrics=None,
-                 verbose: bool = False):
+                 replica_trace_dir: str | None = None, verbose: bool = False):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas!r}")
         if policy not in POLICIES:
@@ -166,6 +167,16 @@ class FleetRouter:
         self.proxy_timeout_s = float(proxy_timeout_s)
         self.run_dir = run_dir or tempfile.mkdtemp(prefix="hdbscan_fleet_")
         self.tracer = tracer
+        # Per-replica JSONL traces (``--trace-out``): set a directory to
+        # have every replica write replica_<rid>.jsonl there, joinable with
+        # this router's ``router_span`` events on the propagated
+        # X-Request-Id (``obs/correlate.py``).
+        self.replica_trace_dir = replica_trace_dir
+        if replica_trace_dir:
+            os.makedirs(replica_trace_dir, exist_ok=True)
+        # Request ids this router mints when the client didn't send one:
+        # pid-qualified so several routers (tests) never collide in a trace.
+        self._rids = itertools.count(1)
         self.verbose = bool(verbose)
         self.replicas = [_Replica(str(i)) for i in range(self.n_replicas)]
         self._ring = sorted(
@@ -240,6 +251,11 @@ class FleetRouter:
             cmd.append(
                 f"wal_dir={os.path.join(self.wal_root, 'r' + r.rid)}"
             )
+        if self.replica_trace_dir:
+            cmd += [
+                "--trace-out",
+                os.path.join(self.replica_trace_dir, f"replica_{r.rid}.jsonl"),
+            ]
         cmd += self.replica_args
         return cmd
 
@@ -395,7 +411,15 @@ class FleetRouter:
 
     async def _proxy(self, route: str, headers: dict, body: bytes):
         self._requests[route] = self._requests.get(route, 0) + 1
-        fwd = {"Content-Type": headers.get("content-type", "application/json")}
+        # Correlation key: honor a client-supplied X-Request-Id, else mint
+        # one. The replica's request_span/request_shed carries the same id
+        # (serve/server.py), so the router_span joins it bitwise
+        # (obs/correlate.join_spans).
+        req_id = headers.get("x-request-id") or f"r{os.getpid()}-{next(self._rids)}"
+        fwd = {
+            "Content-Type": headers.get("content-type", "application/json"),
+            "X-Request-Id": req_id,
+        }
         timeout = self.proxy_timeout_s
         if headers.get("x-deadline-ms"):
             fwd["X-Deadline-Ms"] = headers["x-deadline-ms"]
@@ -406,12 +430,14 @@ class FleetRouter:
         order = self._route_order(route, body)
         t0 = time.perf_counter()
         attempts = 0
+        queue_s = 0.0
         last_rid = order[0].rid if order else "none"
         for r in order:
             if r.port is None:
                 continue
             attempts += 1
             last_rid = r.rid
+            queue_s = time.perf_counter() - t0
             r.in_flight += 1
             self._m_in_flight.set(r.in_flight, replica=r.rid)
             try:
@@ -425,7 +451,10 @@ class FleetRouter:
                 self._mark(r, False)
                 self._m_reroutes.inc(replica=r.rid, route=route)
                 if exc.sent and route == "/ingest":
-                    self._emit_route(route, r.rid, 502, attempts, t0)
+                    self._emit_route(
+                        route, r.rid, 502, attempts, t0,
+                        req_id, queue_s, replied=False,
+                    )
                     return 502, {}, _json_body(
                         {"error": f"replica {r.rid} failed mid-ingest: {exc}"}
                     )
@@ -434,20 +463,28 @@ class FleetRouter:
                 r.in_flight -= 1
                 self._m_in_flight.set(r.in_flight, replica=r.rid)
             self._mark(r, True)
-            self._emit_route(route, r.rid, status, attempts, t0)
+            self._emit_route(
+                route, r.rid, status, attempts, t0, req_id, queue_s,
+                replied=True,
+            )
             out_headers = {
                 k: v for k, v in rheaders.items() if k not in _HOP_HEADERS
                 and k != "content-length"
             }
             out_headers["x-replica"] = r.rid
+            out_headers["x-request-id"] = req_id
             return status, out_headers, rbody
-        self._emit_route(route, last_rid, 503, max(attempts, 1), t0)
+        self._emit_route(
+            route, last_rid, 503, max(attempts, 1), t0, req_id, queue_s,
+            replied=False,
+        )
         return 503, {"retry-after": f"{self.health_interval_s:.3f}"}, _json_body(
             {"error": "no replica available", "reason": "fleet_unavailable"}
         )
 
-    def _emit_route(self, route: str, rid: str, status: int,
-                    attempts: int, t0: float) -> None:
+    def _emit_route(self, route: str, rid: str, status: int, attempts: int,
+                    t0: float, req_id: str | None = None,
+                    queue_s: float = 0.0, replied: bool = False) -> None:
         wall = time.perf_counter() - t0
         self._m_requests.inc(replica=rid, route=route, status=str(status))
         if self.tracer is not None:
@@ -456,6 +493,17 @@ class FleetRouter:
                 status=int(status), attempts=int(attempts),
                 wall_s=round(wall, 9),
             )
+            if req_id is not None:
+                # router_span: the router's half of the per-request causal
+                # chain. ``replied=True`` iff a replica's response was
+                # relayed — only those joins a replica-side span
+                # (check_trace --join enforces exactly-one).
+                self.tracer(
+                    "router_span", request_id=req_id, route=route,
+                    policy=self.policy, replica=rid, status=int(status),
+                    attempts=int(attempts), queue_s=round(queue_s, 9),
+                    wall_s=round(wall, 9), replied=bool(replied),
+                )
 
     # -- health ------------------------------------------------------------
 

@@ -78,23 +78,26 @@ def mst_edges(
     from hdbscan_tpu.utils.flops import counter as _flops
     from hdbscan_tpu.utils.flops import phase_stats
 
+    from hdbscan_tpu import obs
+
     n = len(data)
     t0 = time.monotonic()
     fsnap = _flops.snapshot()
-    if resolve_scan_backend(scan_backend, mesh) == "ring":
-        from hdbscan_tpu.parallel.ring import ring_knn_core_distances
+    with obs.mem_phase("core_distances"):
+        if resolve_scan_backend(scan_backend, mesh) == "ring":
+            from hdbscan_tpu.parallel.ring import ring_knn_core_distances
 
-        core, _ = ring_knn_core_distances(
-            data, min_pts, metric, row_tile=row_tile, col_tile=col_tile,
-            dtype=dtype, fetch_knn=False, mesh=mesh, trace=trace,
-            knn_backend=knn_backend, index=index, index_opts=index_opts,
-        )
-    else:
-        core, _ = knn_core_distances(
-            data, min_pts, metric, row_tile=row_tile, col_tile=col_tile,
-            dtype=dtype, fetch_knn=False, backend=knn_backend,
-            index=index, index_opts=index_opts, trace=trace,
-        )
+            core, _ = ring_knn_core_distances(
+                data, min_pts, metric, row_tile=row_tile, col_tile=col_tile,
+                dtype=dtype, fetch_knn=False, mesh=mesh, trace=trace,
+                knn_backend=knn_backend, index=index, index_opts=index_opts,
+            )
+        else:
+            core, _ = knn_core_distances(
+                data, min_pts, metric, row_tile=row_tile, col_tile=col_tile,
+                dtype=dtype, fetch_knn=False, backend=knn_backend,
+                index=index, index_opts=index_opts, trace=trace,
+            )
     if trace is not None:
         wall = time.monotonic() - t0
         trace(
@@ -155,27 +158,35 @@ def mst_edges_from_core(
             dtype=dtype, mesh=mesh,
         )
 
+    from hdbscan_tpu import obs
+
     comp = np.arange(n, dtype=np.int64)
     eu, ev, ew = [], [], []
     n_comp = n
     rounds = 0
-    for rnd in range(max_rounds):
-        if n_comp <= 1:
-            break
-        bw, bj = scanner.min_outgoing(comp)
-        # Fully vectorized per-component selection + union (SURVEY.md §2.C
-        # row P9's host side): no per-edge Python even with millions of
-        # components in the early rounds.
-        emit, comp, new_count = _contract(comp, bj, bw)
-        if len(emit) == 0:
-            break  # disconnected pool (cannot happen for a full metric space)
-        eu.append(emit)
-        ev.append(bj[emit])
-        ew.append(bw[emit])
-        n_comp = new_count
-        rounds = rnd + 1
-        if trace is not None:
-            trace("boruvka_round", round=rnd, components=n_comp, edges_added=len(emit))
+    # Heartbeat progress = emitted-edge fraction (n-1 edges complete the
+    # tree): monotone by construction — n_comp only shrinks.
+    with obs.mem_phase("boruvka_mst"), obs.task(
+        "boruvka", total=max(n - 1, 1)
+    ) as hb:
+        for rnd in range(max_rounds):
+            if n_comp <= 1:
+                break
+            bw, bj = scanner.min_outgoing(comp)
+            # Fully vectorized per-component selection + union (SURVEY.md
+            # §2.C row P9's host side): no per-edge Python even with
+            # millions of components in the early rounds.
+            emit, comp, new_count = _contract(comp, bj, bw)
+            if len(emit) == 0:
+                break  # disconnected pool (cannot happen for a full metric space)
+            eu.append(emit)
+            ev.append(bj[emit])
+            ew.append(bw[emit])
+            n_comp = new_count
+            rounds = rnd + 1
+            hb.beat(n - n_comp)
+            if trace is not None:
+                trace("boruvka_round", round=rnd, components=n_comp, edges_added=len(emit))
     if trace is not None:
         wall = time.monotonic() - t0
         trace(
@@ -474,16 +485,19 @@ def _fit_device(
     from hdbscan_tpu.utils.flops import counter as _flops
     from hdbscan_tpu.utils.flops import phase_stats
 
+    from hdbscan_tpu import obs
+
     n = len(data)
     index, index_opts = resolve_index_for(params, n)
     t0 = time.monotonic()
     fsnap = _flops.snapshot()
-    core, _ = knn_core_distances(
-        data, params.min_points, params.dist_function, row_tile=row_tile,
-        col_tile=col_tile, dtype=dtype, fetch_knn=False,
-        backend=params.knn_backend, index=index, index_opts=index_opts,
-        trace=trace,
-    )
+    with obs.mem_phase("core_distances"):
+        core, _ = knn_core_distances(
+            data, params.min_points, params.dist_function, row_tile=row_tile,
+            col_tile=col_tile, dtype=dtype, fetch_knn=False,
+            backend=params.knn_backend, index=index, index_opts=index_opts,
+            trace=trace,
+        )
     if trace is not None:
         wall = time.monotonic() - t0
         trace(
@@ -491,30 +505,33 @@ def _fit_device(
         )
 
     t0 = time.monotonic()
-    res = boruvka_mst_device(
-        data, core, params.dist_function, row_tile=row_tile,
-        col_tile=col_tile, dtype=dtype,
-    )
-    # Padded (+inf, self-loop) tail rows pass straight through the forest
-    # scan as non-merges, so the event program consumes the fixed buffers
-    # without a host-side slice in between.
-    events = forest_events_device(res["u"], res["v"], res["w"], n)
-    t1 = time.monotonic()
-    fetched = jax.device_get(
-        {
-            "sw": events["sw"],
-            "ra": events["ra"],
-            "rb": events["rb"],
-            "u": res["u"],
-            "v": res["v"],
-            "w": res["w"],
-            "count": res["count"],
-            "rounds": res["rounds"],
-            "stat_comp": res["stat_comp"],
-            "stat_edges": res["stat_edges"],
-        }
-    )
-    sync_wall = time.monotonic() - t1
+    with obs.mem_phase("boruvka_mst_device"), obs.task(
+        "boruvka_device", total=1
+    ):
+        res = boruvka_mst_device(
+            data, core, params.dist_function, row_tile=row_tile,
+            col_tile=col_tile, dtype=dtype,
+        )
+        # Padded (+inf, self-loop) tail rows pass straight through the forest
+        # scan as non-merges, so the event program consumes the fixed buffers
+        # without a host-side slice in between.
+        events = forest_events_device(res["u"], res["v"], res["w"], n)
+        t1 = time.monotonic()
+        fetched = jax.device_get(
+            {
+                "sw": events["sw"],
+                "ra": events["ra"],
+                "rb": events["rb"],
+                "u": res["u"],
+                "v": res["v"],
+                "w": res["w"],
+                "count": res["count"],
+                "rounds": res["rounds"],
+                "stat_comp": res["stat_comp"],
+                "stat_edges": res["stat_edges"],
+            }
+        )
+        sync_wall = time.monotonic() - t1
     rounds = int(fetched["rounds"])
     count = int(fetched["count"])
     if trace is not None:

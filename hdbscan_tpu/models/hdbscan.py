@@ -92,15 +92,21 @@ def fit(
     n = len(data)
     if n == 0:
         raise ValueError("empty dataset")
+    from hdbscan_tpu import obs
+
     t0 = time.monotonic()
-    u, v, w, core = hdbscan_block_edges(data, params.min_points, params.dist_function)
+    with obs.mem_phase("block_edges"):
+        u, v, w, core = hdbscan_block_edges(
+            data, params.min_points, params.dist_function
+        )
     if trace is not None:
         trace("block_edges", n=n, wall_s=round(time.monotonic() - t0, 6))
     from hdbscan_tpu.models._finalize import finalize_clustering
 
-    tree, labels, scores, infinite = finalize_clustering(
-        n, u, v, w, core, params, num_constraints_satisfied, trace=trace
-    )
+    with obs.mem_phase("finalize"):
+        tree, labels, scores, infinite = finalize_clustering(
+            n, u, v, w, core, params, num_constraints_satisfied, trace=trace
+        )
     return HDBSCANResult(
         labels=labels,
         tree=tree,

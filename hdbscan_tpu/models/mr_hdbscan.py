@@ -46,6 +46,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hdbscan_tpu import obs
 from hdbscan_tpu.config import HDBSCANParams
 from hdbscan_tpu.core import tree as tree_mod
 from hdbscan_tpu.core.bubbles import bubble_stats
@@ -632,16 +633,17 @@ def _fit_rows(
                     index_opts=index_opts,
                 )
             else:
-                core, _ = knn_core_distances(
-                    data,
-                    params.min_points,
-                    metric,
-                    fetch_knn=False,
-                    backend=params.knn_backend,
-                    index=index,
-                    index_opts=index_opts,
-                    trace=trace,
-                )
+                with obs.mem_phase("global_cores"):
+                    core, _ = knn_core_distances(
+                        data,
+                        params.min_points,
+                        metric,
+                        fetch_knn=False,
+                        backend=params.knn_backend,
+                        index=index,
+                        index_opts=index_opts,
+                        trace=trace,
+                    )
     n_dev = 1
     if mesh is not None:
         n_dev = math.prod(mesh.devices.shape)
@@ -672,15 +674,16 @@ def _fit_rows(
             from hdbscan_tpu.ops.tiled import boruvka_glue_edges
 
             act = np.nonzero(~processed)[0]
-            gu_l, gv_l, gw_l = boruvka_glue_edges(
-                data[act],
-                subset[act],
-                metric,
-                core=core[act] if global_core else None,
-                mesh=mesh,
-                scan_backend=params.scan_backend,
-                trace=trace,
-            )
+            with obs.mem_phase("glue_harvest"):
+                gu_l, gv_l, gw_l = boruvka_glue_edges(
+                    data[act],
+                    subset[act],
+                    metric,
+                    core=core[act] if global_core else None,
+                    mesh=mesh,
+                    scan_backend=params.scan_backend,
+                    trace=trace,
+                )
             pool_u.append(act[gu_l])
             pool_v.append(act[gv_l])
             pool_w.append(gw_l)
@@ -699,9 +702,11 @@ def _fit_rows(
                 packed = pack_blocks(
                     data, group, cap_b, core=core if global_core else None
                 )
-                u, v, w, core_b = run_packed_blocks(
-                    packed, params.min_points, metric, mesh=mesh, batch_pad=n_dev
-                )
+                with obs.mem_phase("block_fit"):
+                    u, v, w, core_b = run_packed_blocks(
+                        packed, params.min_points, metric, mesh=mesh,
+                        batch_pad=n_dev,
+                    )
                 pool_u.append(u)
                 pool_v.append(v)
                 pool_w.append(w)
@@ -899,6 +904,9 @@ def _fit_rows(
             wall_s=time.monotonic() - t0,
         )
         level_stats.append(stats)
+        # Liveness + progress for the watchdog: frozen-point fraction is
+        # monotone across levels (points only ever freeze).
+        obs.beat("mr_levels", int(processed.sum()), total=n)
         if trace is not None:
             trace("level", **{k: getattr(stats, k) for k in stats.__dataclass_fields__})
         if checkpoint_dir is not None:

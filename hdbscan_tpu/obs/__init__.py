@@ -1,0 +1,171 @@
+"""Fit-path deep observability (README "Observability").
+
+Three layers, all opt-in and process-global (mirroring the
+``hdbscan_tpu/fault`` harness's install pattern):
+
+- :class:`~hdbscan_tpu.obs.audit.MemoryAuditor` — a per-phase device-memory
+  auditor. Instrumented pipeline sites wrap their work in
+  :func:`mem_phase`, which samples per-device bytes synchronously at entry/
+  exit plus on a background thread, emits ``mem_sample`` / ``mem_phase_peak``
+  trace events, and accumulates a per-phase watermark table for the run
+  report. ``assert_not_replicated(n, itemsize)`` turns ROADMAP item 1's
+  "no replicated O(n) buffer survives on any single device" into a hard
+  gate over those watermarks.
+- :class:`~hdbscan_tpu.obs.heartbeat.Heartbeats` — progress heartbeats and
+  a hang watchdog. Long loops (Borůvka rounds, ring panel sweeps, rpforest
+  tree builds, background refits) open a :func:`task` and ``beat(done,
+  total)`` each iteration; throttled ``heartbeat`` trace events carry a
+  monotone progress fraction and ETA, and a watchdog thread dumps every
+  Python thread's stack to the trace and stderr when no beat arrives
+  within ``watchdog_s``.
+- :mod:`~hdbscan_tpu.obs.correlate` — fleet trace correlation: joins the
+  router's ``router_span`` events with replica ``request_span`` /
+  ``request_shed`` events on the propagated ``X-Request-Id``, so one
+  request's causal chain reconstructs across processes.
+
+The uninstalled fast path is one module-attribute load + ``is None`` test
+per instrumented site (the same contract ``fault/inject.py`` keeps): fit
+paths pay nothing unless :func:`install` ran.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager, nullcontext
+
+from hdbscan_tpu.obs.audit import MemoryAuditor, ReplicatedBufferError
+from hdbscan_tpu.obs.correlate import join_spans, merge_fleet_traces
+from hdbscan_tpu.obs.heartbeat import Heartbeats
+
+__all__ = [
+    "MemoryAuditor",
+    "ReplicatedBufferError",
+    "Heartbeats",
+    "join_spans",
+    "merge_fleet_traces",
+    "install",
+    "clear",
+    "auditor",
+    "heartbeats",
+    "mem_phase",
+    "task",
+    "beat",
+    "watchdog_state",
+    "assert_not_replicated",
+]
+
+
+class _NullTask:
+    """No-op stand-in yielded by :func:`task` when heartbeats are off."""
+
+    __slots__ = ()
+
+    def beat(self, done, total=None) -> None:
+        pass
+
+
+_NULL_TASK = _NullTask()
+
+# Process-wide installs checked by every instrumented site. None = off: the
+# hot-path cost of the uninstalled layer is one attribute load + is-None.
+_AUDITOR: MemoryAuditor | None = None
+_HEARTBEATS: Heartbeats | None = None
+_INSTALL_LOCK = threading.Lock()
+
+
+def install(auditor=None, heartbeats=None) -> None:
+    """Install the process-wide auditor and/or heartbeat hub. Passing None
+    for either leaves that layer as it was (install them independently)."""
+    global _AUDITOR, _HEARTBEATS
+    with _INSTALL_LOCK:
+        if auditor is not None:
+            _AUDITOR = auditor
+        if heartbeats is not None:
+            _HEARTBEATS = heartbeats
+
+
+def clear() -> None:
+    """Remove both layers (instrumented sites go back to no-ops)."""
+    global _AUDITOR, _HEARTBEATS
+    with _INSTALL_LOCK:
+        if _HEARTBEATS is not None:
+            _HEARTBEATS.close()
+        _AUDITOR = None
+        _HEARTBEATS = None
+
+
+def auditor() -> MemoryAuditor | None:
+    return _AUDITOR
+
+
+def heartbeats() -> Heartbeats | None:
+    return _HEARTBEATS
+
+
+def mem_phase(name: str):
+    """Context manager auditing device memory around a traced phase; a
+    ``nullcontext`` when no auditor is installed."""
+    aud = _AUDITOR
+    if aud is None:
+        return nullcontext()
+    return aud.phase(name)
+
+
+def task(phase: str, total=None):
+    """Context manager opening a heartbeat task for a progress loop; yields
+    an object with ``beat(done, total=None)`` (a no-op when heartbeats are
+    off, so call sites never branch)."""
+    hb = _HEARTBEATS
+    if hb is None:
+        return nullcontext(_NULL_TASK)
+    return hb.task(phase, total=total)
+
+
+def beat(phase: str, done, total=None) -> None:
+    """One-shot heartbeat outside a :func:`task` scope (rarely needed —
+    prefer the task context so the watchdog knows what is in flight)."""
+    hb = _HEARTBEATS
+    if hb is None:
+        return
+    with hb.task(phase, total=total) as t:
+        t.beat(done, total=total)
+
+
+def watchdog_state() -> dict | None:
+    """The heartbeat hub's live state for ``/healthz``; None when off."""
+    hb = _HEARTBEATS
+    if hb is None:
+        return None
+    return hb.state()
+
+
+def assert_not_replicated(n, itemsize, slack=0.5, phases=None) -> dict:
+    """Delegate to the installed auditor's replication gate. Raises
+    :class:`RuntimeError` when no auditor is installed — a gate that was
+    requested but never armed must fail loudly, not pass vacuously."""
+    aud = _AUDITOR
+    if aud is None:
+        raise RuntimeError(
+            "assert_not_replicated: no MemoryAuditor installed "
+            "(obs.install(auditor=...) before the fit)"
+        )
+    return aud.assert_not_replicated(n, itemsize, slack=slack, phases=phases)
+
+
+@contextmanager
+def installed(auditor=None, heartbeats=None):
+    """Scoped install for tests: install, yield, restore previous layers."""
+    global _AUDITOR, _HEARTBEATS
+    with _INSTALL_LOCK:
+        prev = (_AUDITOR, _HEARTBEATS)
+        if auditor is not None:
+            _AUDITOR = auditor
+        if heartbeats is not None:
+            _HEARTBEATS = heartbeats
+    try:
+        yield
+    finally:
+        with _INSTALL_LOCK:
+            if heartbeats is not None and heartbeats is not prev[1]:
+                heartbeats.close()
+            _AUDITOR, _HEARTBEATS = prev

@@ -1,0 +1,321 @@
+"""Per-phase device-memory auditor.
+
+ROADMAP item 1's acceptance — "no replicated O(n) buffer survives on any
+single device" — needs per-device *peak* bytes inside each pipeline phase,
+not the start/end snapshot `telemetry.sample_device_memory()` gives. The
+:class:`MemoryAuditor` wraps every traced phase (via ``obs.mem_phase``):
+it samples synchronously at phase entry/exit and from a background thread
+in between, emits a ``mem_sample`` trace event per sample and one
+``mem_phase_peak`` at phase exit, and keeps a per-phase watermark table
+(merged by max across repeated phases) that lands in the run report and
+``bench.py`` output.
+
+Sampling sources, in order of fidelity:
+
+- ``memory_stats``: real accelerators expose ``Device.memory_stats()``
+  with ``bytes_in_use`` — cheap and includes everything resident.
+- ``live_arrays``: the CPU fallback (also forced in tests) walks
+  ``jax.live_arrays()`` and attributes each addressable shard's nbytes to
+  its device. It only sees arrays Python still references, but that is
+  exactly the population a replicated-buffer bug lives in.
+
+``assert_not_replicated(n, itemsize, slack)`` is the gate: any phase where
+a single device's peak *above its construction-time baseline* reaches
+``slack * n * itemsize`` implies an O(n) buffer was materialized whole on
+that device, and the fit fails with :class:`ReplicatedBufferError`. With
+the default ``slack=0.5``, a ring-sharded scan on an 8-device mesh
+(~n/8 per device) passes with 4x headroom while a fully replicated
+buffer (>= 1.0 * n * itemsize) trips it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+
+class ReplicatedBufferError(RuntimeError):
+    """A single device's phase peak implies a replicated O(n) buffer."""
+
+
+def _device_key(d) -> str:
+    return f"{d.platform}:{d.id}"
+
+
+def _memory_stats_sample(devices) -> dict[str, int] | None:
+    """Per-device bytes_in_use, or None when any device lacks the stat
+    (CPU backends return None / empty dicts)."""
+    out: dict[str, int] = {}
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            return None
+        if not stats or "bytes_in_use" not in stats:
+            return None
+        out[_device_key(d)] = int(stats["bytes_in_use"])
+    return out
+
+
+def _live_arrays_sample(devices) -> dict[str, int]:
+    """Attribute every live array's addressable shards to their devices."""
+    import jax
+
+    per_dev: dict[str, int] = {_device_key(d): 0 for d in devices}
+    for a in jax.live_arrays():
+        try:
+            shards = a.addressable_shards
+        except Exception:
+            continue
+        for sh in shards:
+            key = _device_key(sh.device)
+            try:
+                per_dev[key] = per_dev.get(key, 0) + int(sh.data.nbytes)
+            except Exception:
+                continue
+    return per_dev
+
+
+def sample_per_device(source: str = "auto") -> tuple[dict[str, int], str]:
+    """One sample of per-device resident bytes.
+
+    Returns ``(per_device_bytes, source_used)`` where ``source_used`` is
+    ``"memory_stats"`` or ``"live_arrays"``. ``source`` forces one
+    collector (tests force ``"live_arrays"`` for determinism on CPU).
+    """
+    if source not in ("auto", "memory_stats", "live_arrays"):
+        raise ValueError(
+            f"source must be auto|memory_stats|live_arrays, got {source!r}"
+        )
+    import jax
+
+    devices = jax.devices()
+    if source in ("auto", "memory_stats"):
+        stats = _memory_stats_sample(devices)
+        if stats is not None:
+            return stats, "memory_stats"
+        if source == "memory_stats":
+            raise RuntimeError(
+                "memory_stats unavailable on this backend "
+                "(CPU devices expose no bytes_in_use); use live_arrays"
+            )
+    return _live_arrays_sample(devices), "live_arrays"
+
+
+class MemoryAuditor:
+    """Samples per-device memory around traced phases, keeping watermarks.
+
+    Parameters
+    ----------
+    tracer:
+        Optional ``Tracer``; when set, every sample emits ``mem_sample``
+        and every phase exit emits ``mem_phase_peak``.
+    interval_s:
+        Background sampling period inside a phase. Phases shorter than
+        this still get the synchronous entry/exit samples.
+    source:
+        ``auto`` (default) picks memory_stats when available, else
+        live_arrays; tests force ``live_arrays``.
+    """
+
+    def __init__(self, tracer=None, interval_s: float = 0.05,
+                 source: str = "auto"):
+        if not interval_s > 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s!r}")
+        if source not in ("auto", "memory_stats", "live_arrays"):
+            raise ValueError(
+                f"source must be auto|memory_stats|live_arrays, got {source!r}"
+            )
+        self.tracer = tracer
+        self.interval_s = float(interval_s)
+        self._source_pref = source
+        self._lock = threading.Lock()
+        # phase -> watermark dict (merged by max across repeated phases)
+        self._watermarks: dict[str, dict] = {}
+        self._depth = 0
+        self.baseline, self.source = sample_per_device(source)
+
+    # -- sampling ----------------------------------------------------------
+
+    def _sample(self, phase: str, acc: dict) -> None:
+        per_dev, src = sample_per_device(self._source_pref)
+        max_dev = max(per_dev.values(), default=0)
+        total = sum(per_dev.values())
+        with self._lock:
+            acc["samples"] += 1
+            acc["source"] = src
+            acc["max_device_bytes"] = max(acc["max_device_bytes"], max_dev)
+            acc["total_bytes"] = max(acc["total_bytes"], total)
+            for key, v in per_dev.items():
+                if v > acc["per_device"].get(key, -1):
+                    acc["per_device"][key] = v
+        if self.tracer is not None:
+            self.tracer(
+                "mem_sample",
+                phase=phase,
+                source=src,
+                max_device_bytes=max_dev,
+                total_bytes=total,
+            )
+
+    @contextmanager
+    def phase(self, name: str):
+        """Audit device memory for the duration of the block."""
+        acc = {
+            "samples": 0,
+            "source": self.source,
+            "max_device_bytes": 0,
+            "total_bytes": 0,
+            "per_device": defaultdict(int),
+        }
+        stop = threading.Event()
+
+        def _pump():
+            while not stop.wait(self.interval_s):
+                try:
+                    self._sample(name, acc)
+                except Exception:
+                    return
+
+        t0 = time.monotonic()
+        self._sample(name, acc)
+        pump = threading.Thread(
+            target=_pump, name=f"mem-audit-{name}", daemon=True
+        )
+        pump.start()
+        try:
+            yield acc
+        finally:
+            stop.set()
+            pump.join(timeout=5.0)
+            self._sample(name, acc)
+            wall_s = time.monotonic() - t0
+            self._merge_watermark(name, acc, wall_s)
+            if self.tracer is not None:
+                self.tracer(
+                    "mem_phase_peak",
+                    phase=name,
+                    source=acc["source"],
+                    samples=acc["samples"],
+                    devices=len(acc["per_device"]),
+                    max_device_bytes=acc["max_device_bytes"],
+                    total_bytes=acc["total_bytes"],
+                    wall_s=round(wall_s, 9),
+                )
+
+    def _merge_watermark(self, name: str, acc: dict, wall_s: float) -> None:
+        with self._lock:
+            wm = self._watermarks.get(name)
+            if wm is None:
+                self._watermarks[name] = {
+                    "source": acc["source"],
+                    "samples": acc["samples"],
+                    "max_device_bytes": acc["max_device_bytes"],
+                    "total_bytes": acc["total_bytes"],
+                    "per_device": dict(acc["per_device"]),
+                    "wall_s": round(wall_s, 9),
+                }
+                return
+            wm["samples"] += acc["samples"]
+            wm["max_device_bytes"] = max(
+                wm["max_device_bytes"], acc["max_device_bytes"]
+            )
+            wm["total_bytes"] = max(wm["total_bytes"], acc["total_bytes"])
+            wm["wall_s"] = round(wm["wall_s"] + wall_s, 9)
+            for key, v in acc["per_device"].items():
+                if v > wm["per_device"].get(key, -1):
+                    wm["per_device"][key] = v
+
+    # -- reporting ---------------------------------------------------------
+
+    def watermark_table(self) -> dict[str, dict]:
+        """Per-phase watermarks (deep-copied; safe to serialize)."""
+        with self._lock:
+            return {
+                name: {**wm, "per_device": dict(wm["per_device"])}
+                for name, wm in self._watermarks.items()
+            }
+
+    def device_peaks(self) -> dict[str, int]:
+        """Per-device peak bytes across all audited phases (for gauges)."""
+        peaks: dict[str, int] = {}
+        with self._lock:
+            for wm in self._watermarks.values():
+                for key, v in wm["per_device"].items():
+                    if v > peaks.get(key, -1):
+                        peaks[key] = v
+        return peaks
+
+    # -- the gate ----------------------------------------------------------
+
+    def assert_not_replicated(self, n, itemsize, slack: float = 0.5,
+                              phases=None) -> dict:
+        """Fail if any device's phase peak implies a replicated O(n) buffer.
+
+        The threshold is ``slack * n * itemsize`` bytes of growth above the
+        device's construction-time baseline. Returns a summary dict
+        (threshold, phases checked, worst offender margin) on success;
+        raises :class:`ReplicatedBufferError` listing every offending
+        (phase, device, peak) otherwise.
+        """
+        n = int(n)
+        itemsize = int(itemsize)
+        if n <= 0:
+            raise ValueError(f"n must be > 0, got {n!r}")
+        if itemsize <= 0:
+            raise ValueError(f"itemsize must be > 0, got {itemsize!r}")
+        if not slack > 0:
+            raise ValueError(f"slack must be > 0, got {slack!r}")
+        threshold = slack * n * itemsize
+        table = self.watermark_table()
+        if phases is not None:
+            wanted = set(phases)
+            missing = wanted - set(table)
+            if missing:
+                raise ValueError(
+                    f"assert_not_replicated: phases never audited: "
+                    f"{sorted(missing)} (have {sorted(table)})"
+                )
+            table = {k: v for k, v in table.items() if k in wanted}
+        if not table:
+            raise RuntimeError(
+                "assert_not_replicated: no phases were audited — the gate "
+                "cannot pass vacuously"
+            )
+        devices = set()
+        for wm in table.values():
+            devices.update(wm["per_device"])
+        if len(devices) <= 1:
+            # One device holds the whole problem by definition — "replicated
+            # vs sharded" is only meaningful across a multi-device mesh.
+            return {
+                "threshold_bytes": threshold,
+                "phases": sorted(table),
+                "worst_fraction": 0.0,
+                "single_device": True,
+            }
+        offenders = []
+        worst = 0.0
+        for phase, wm in sorted(table.items()):
+            for dev, peak in sorted(wm["per_device"].items()):
+                growth = peak - self.baseline.get(dev, 0)
+                worst = max(worst, growth / threshold)
+                if growth >= threshold:
+                    offenders.append((phase, dev, peak, growth))
+        if offenders:
+            lines = "; ".join(
+                f"{phase}/{dev}: peak={peak}B growth={growth}B"
+                for phase, dev, peak, growth in offenders
+            )
+            raise ReplicatedBufferError(
+                f"replicated O(n) buffer: {len(offenders)} device-phase "
+                f"peak(s) grew >= slack*n*itemsize = {slack}*{n}*{itemsize} "
+                f"= {threshold:.0f}B above baseline ({lines})"
+            )
+        return {
+            "threshold_bytes": threshold,
+            "phases": sorted(table),
+            "worst_fraction": round(worst, 6),
+        }

@@ -273,14 +273,20 @@ def build_forest(
         pos_idx[j, width:] = e - 1  # pad by repeating the last position
         leaf_mask[j, :width] = True
 
+    from hdbscan_tpu import obs
+
     members = np.zeros((trees, len(leaves), lmax), np.int32)
     thresholds = np.zeros((trees, max(num_nodes, 1)), dtype)
-    for t in range(trees):
-        perm, thr = _build_one_tree(data_dev, jnp.asarray(normals[t]), geom)
-        perm = np.asarray(perm)
-        members[t] = perm[pos_idx]
-        if num_nodes:
-            thresholds[t, :num_nodes] = np.asarray(thr)
+    with obs.mem_phase("knn_index_build"), obs.task(
+        "rpforest_build", total=trees
+    ) as hb:
+        for t in range(trees):
+            perm, thr = _build_one_tree(data_dev, jnp.asarray(normals[t]), geom)
+            perm = np.asarray(perm)
+            members[t] = perm[pos_idx]
+            if num_nodes:
+                thresholds[t, :num_nodes] = np.asarray(thr)
+            hb.beat(t + 1)
     forest = RPForest(
         n=n,
         d=d,

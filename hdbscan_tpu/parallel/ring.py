@@ -47,6 +47,7 @@ try:
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
+from hdbscan_tpu import obs
 from hdbscan_tpu.core.distances import pairwise_distance
 from hdbscan_tpu.ops.tiled import _next_pow2, _pad_rows, _round_up
 from hdbscan_tpu.parallel.mesh import (
@@ -123,16 +124,20 @@ def _lex_merge_k(best_d, best_i, tile_d, tile_i, k: int):
     )
 
 
-def _per_device_walls(out, t0: float) -> list[tuple[int, float]]:
+def _per_device_walls(out, t0: float, beat=None) -> list[tuple[int, float]]:
     """Per-device completion walls: block on each addressable output shard
     in turn, timestamping as each lands. Single-controller approximation of
     per-chip timelines — good enough to surface a straggler device or a
-    non-overlapped ppermute in the trace (README "Scaling out")."""
+    non-overlapped ppermute in the trace (README "Scaling out").
+    ``beat(done)`` (an ``obs`` heartbeat) fires as each shard lands, so a
+    hung collective is distinguishable from a slow one."""
     walls = []
     shards = sorted(out.addressable_shards, key=lambda s: s.device.id)
-    for sh in shards:
+    for i, sh in enumerate(shards):
         jax.block_until_ready(sh.data)
         walls.append((int(sh.device.id), time.monotonic() - t0))
+        if beat is not None:
+            beat(i + 1)
     return walls
 
 
@@ -393,10 +398,13 @@ def ring_knn_core_distances(
     from hdbscan_tpu.utils.flops import counter as _flops
 
     _flops.add_scan(n_pad, n_pad, dm, row_tile=row_tile)
-    t0 = time.monotonic()
-    best_d, best_i = fn(rows, rows, n_arr)
-    walls = _per_device_walls(best_d, t0)
-    wall = time.monotonic() - t0
+    with obs.mem_phase("ring_knn_scan"), obs.task(
+        "ring_knn_scan", total=n_dev
+    ) as hb:
+        t0 = time.monotonic()
+        best_d, best_i = fn(rows, rows, n_arr)
+        walls = _per_device_walls(best_d, t0, beat=hb.beat)
+        wall = time.monotonic() - t0
 
     from hdbscan_tpu.parallel.mesh import fetch
 
@@ -478,10 +486,13 @@ def ring_knn_core_distances_rows(
     from hdbscan_tpu.utils.flops import counter as _flops
 
     _flops.add_scan(m_pad, n_pad, dm, row_tile=row_tile)
-    t0 = time.monotonic()
-    best_d, _ = fn(q, cols, n_arr)
-    walls = _per_device_walls(best_d, t0)
-    wall = time.monotonic() - t0
+    with obs.mem_phase("ring_rows_scan"), obs.task(
+        "ring_rows_scan", total=n_dev
+    ) as hb:
+        t0 = time.monotonic()
+        best_d, _ = fn(q, cols, n_arr)
+        walls = _per_device_walls(best_d, t0, beat=hb.beat)
+        wall = time.monotonic() - t0
 
     from hdbscan_tpu.parallel.mesh import fetch
 
@@ -723,12 +734,15 @@ class RingBoruvkaScanner:
         fn = _ring_boruvka_fn(
             self.mesh, self.metric, self.row_tile, self.col_tile, n_comp_pad
         )
-        t0 = time.monotonic()
-        w_all, lo_all, hi_all, n_cand = fn(
-            self._rows, self._rows, comp_rep, self._n_arr
-        )
-        walls = _per_device_walls(w_all, t0)
-        wall = time.monotonic() - t0
+        with obs.mem_phase("ring_boruvka_scan"), obs.task(
+            "ring_boruvka_scan", total=self.n_dev
+        ) as hb:
+            t0 = time.monotonic()
+            w_all, lo_all, hi_all, n_cand = fn(
+                self._rows, self._rows, comp_rep, self._n_arr
+            )
+            walls = _per_device_walls(w_all, t0, beat=hb.beat)
+            wall = time.monotonic() - t0
 
         from hdbscan_tpu.parallel.mesh import fetch
 

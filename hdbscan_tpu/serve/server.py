@@ -76,7 +76,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from hdbscan_tpu import obs
 from hdbscan_tpu.fault import inject
+from hdbscan_tpu.obs import heartbeat as obs_heartbeat
 from hdbscan_tpu.fault.policy import (
     CIRCUIT_STATE_VALUES,
     CircuitBreaker,
@@ -188,7 +190,10 @@ class _Handler(BaseHTTPRequestHandler):
         t0 = time.perf_counter()
         srv._m_in_flight.inc()
         code = 500
-        rid = srv.next_request_id()
+        # A fleet router (or any upstream) that stamped X-Request-Id wins:
+        # the replica's request_span then joins the router_span bitwise on
+        # the shared id (obs/correlate.py).
+        rid = self.headers.get("X-Request-Id") or srv.next_request_id()
         # meta is filled across threads (batcher worker) with the span
         # timestamps; the Future resolution inside predict/ingest is the
         # happens-before edge that publishes it back to this thread.
@@ -410,6 +415,30 @@ class ClusterServer:
             "Injected faults fired (fault harness), by site.",
             labelnames=("site",),
         )
+        self._m_watchdog = self.metrics.counter(
+            "hdbscan_tpu_watchdog_stalls_total",
+            "Watchdog stack dumps fired (no heartbeat within watchdog_s).",
+        )
+        self._m_device_peak = self.metrics.gauge(
+            "hdbscan_tpu_device_peak_bytes",
+            "Per-device peak resident bytes across audited fit phases.",
+            labelnames=("device",),
+        )
+        # Progress/watchdog layer (``hdbscan_tpu/obs``): arm the hub when
+        # config asks for a watchdog and none is installed yet (a CLI-built
+        # hub keeps priority); either way the installed hub feeds this
+        # server's stall counter so /metrics sees refit/fit hangs.
+        hub = obs.heartbeats()
+        if hub is None and float(knob("watchdog_s", 0.0)) > 0:
+            hub = obs_heartbeat.Heartbeats(
+                tracer=tracer,
+                heartbeat_s=float(knob("heartbeat_s", 1.0)),
+                watchdog_s=float(knob("watchdog_s", 0.0)),
+                stall_counter=self._m_watchdog,
+            )
+            obs.install(heartbeats=hub)
+        elif hub is not None and hub._stall_counter is None:
+            hub._stall_counter = self._m_watchdog
         plan = inject.plan()
         if plan is not None:
             if plan.tracer is None and tracer is not None:
@@ -958,6 +987,10 @@ class ClusterServer:
         counters and histograms accumulate at their event sites."""
         self._m_uptime.set(round(time.monotonic() - self._t0, 3))
         self._m_generation.set(float(self._handle.generation))
+        aud = obs.auditor()
+        if aud is not None:
+            for dev, peak in aud.device_peaks().items():
+                self._m_device_peak.set(float(peak), device=dev)
         return self.metrics.render()
 
     def health(self) -> dict:
@@ -988,6 +1021,9 @@ class ClusterServer:
         }
         if self.last_swap is not None:
             out["last_swap"] = self.last_swap
+        wd = obs.watchdog_state()
+        if wd is not None:
+            out["watchdog"] = wd
         if self.ingest_enabled:
             stats = self.buffer.stats()
             out["stream"] = {

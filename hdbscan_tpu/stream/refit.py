@@ -155,17 +155,20 @@ class Refitter:
             self._m_failures.inc()
 
     def _worker(self, points, reason: str, seq: int) -> None:
+        from hdbscan_tpu import obs
+
         t0 = time.perf_counter()
         try:
             if inject.maybe_fire("refit_fit") is not None:
                 raise inject.InjectedFault("injected refit_fit crash")
-            if self.fit_fn is not None:
-                result = self.fit_fn(points, self.params)
-            else:
-                from hdbscan_tpu.models import hdbscan
+            with obs.mem_phase("model_refit"), obs.task("model_refit", total=1):
+                if self.fit_fn is not None:
+                    result = self.fit_fn(points, self.params)
+                else:
+                    from hdbscan_tpu.models import hdbscan
 
-                result = hdbscan.fit(points, self.params)
-            model = result.to_cluster_model(points, self.params)
+                    result = hdbscan.fit(points, self.params)
+                model = result.to_cluster_model(points, self.params)
             os.makedirs(self.model_dir, exist_ok=True)
             path = os.path.join(self.model_dir, f"model_gen{seq:04d}.npz")
             # The fit is minutes of work; don't discard it over a transient

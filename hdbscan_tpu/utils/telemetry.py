@@ -25,8 +25,10 @@ import os
 from hdbscan_tpu.utils.tracing import TRACE_SCHEMA, Tracer
 
 #: Version tag carried by the run report. Bump the integer suffix on any
-#: backwards-incompatible report-shape change.
-REPORT_SCHEMA = "hdbscan-tpu-report/1"
+#: backwards-incompatible report-shape change. /2: ``memory`` gained the
+#: per-phase ``watermarks`` table (``obs/audit.MemoryAuditor`` peaks) next
+#: to the start/end samples.
+REPORT_SCHEMA = "hdbscan-tpu-report/2"
 
 #: Env vars echoed into the manifest when set: anything that changes what the
 #: run computes or how its figures are derived, without appearing in argv.
@@ -308,8 +310,12 @@ def build_report(
     spans = request_span_section(tracer)
     if spans is not None:
         report["request_spans"] = spans
-    if memory is not None:
-        report["memory"] = json_sanitize(memory)
+    watermarks = memory_watermark_section(tracer)
+    if memory is not None or watermarks is not None:
+        mem = dict(memory) if memory is not None else {}
+        if watermarks is not None:
+            mem["watermarks"] = watermarks
+        report["memory"] = json_sanitize(mem)
     if per_host is not None:
         report["per_host"] = per_host
     return report
@@ -499,6 +505,43 @@ def stream_section(tracer: Tracer) -> dict | None:
             max(float(e.fields.get("pause_s", e.wall_s)) for e in swaps), 9
         )
     return section
+
+
+def memory_watermark_section(tracer: Tracer) -> dict | None:
+    """The run report's ``memory.watermarks`` table: per-phase device-memory
+    peaks over every ``mem_phase_peak`` event the
+    :class:`~hdbscan_tpu.obs.audit.MemoryAuditor` emitted. Repeated phases
+    max-merge (peaks) and sum (samples, wall) — the same merge the auditor's
+    in-memory table applies — so the section reads as "the worst any single
+    device ever held during this phase, across the whole run". None when the
+    run was not audited (the section is omitted, not empty)."""
+    peaks = [e for e in tracer.events if e.name == "mem_phase_peak"]
+    if not peaks:
+        return None
+    table: dict[str, dict] = {}
+    for e in peaks:
+        f = e.fields
+        phase = str(f.get("phase", "?"))
+        row = table.setdefault(
+            phase,
+            {
+                "source": f.get("source"),
+                "samples": 0,
+                "devices": 0,
+                "max_device_bytes": 0,
+                "total_bytes": 0,
+                "wall_s": 0.0,
+            },
+        )
+        row["samples"] += int(f.get("samples", 0))
+        row["devices"] = max(row["devices"], int(f.get("devices", 0)))
+        row["max_device_bytes"] = max(
+            row["max_device_bytes"], int(f.get("max_device_bytes", 0))
+        )
+        row["total_bytes"] = max(row["total_bytes"], int(f.get("total_bytes", 0)))
+        row["wall_s"] = round(row["wall_s"] + float(e.wall_s), 9)
+    # Heaviest phases first, matching phase_aggregates' ordering convention.
+    return dict(sorted(table.items(), key=lambda kv: -kv[1]["max_device_bytes"]))
 
 
 def predict_latency_section(tracer: Tracer) -> dict | None:

@@ -9,6 +9,12 @@ progress, or schema-versioned JSON lines on disk (:class:`JsonlSink`) for the
 durable per-run artifact the report builder (``utils/telemetry.py``)
 aggregates. An optional ``jax.profiler`` context captures full XLA traces for
 TensorBoard.
+
+The deep-observability layer (``hdbscan_tpu/obs``) emits through the same
+Tracer: ``mem_sample``/``mem_phase_peak`` from the device-memory auditor,
+``heartbeat``/``watchdog_stall`` from the progress hub, and ``router_span``
+from the fleet router (joinable with replica ``request_span`` events on
+``request_id`` — ``scripts/check_trace.py --join``).
 """
 
 from __future__ import annotations

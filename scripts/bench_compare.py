@@ -1,0 +1,211 @@
+#!/usr/bin/env python3
+"""Bench-trajectory regression gate over the checked-in ``BENCH_r*.json``.
+
+Stdlib-only, like the other validators: normalizes every round's schema
+(the trajectory spans four generations — raw ``{"metric", "value"}``
+objects, ``{"parsed": {...}}`` wrappers, ``{"parsed": {"slo": {...},
+"chaos": {...}}}`` multi-leg wrappers, and tail-embedded JSON lines),
+extracts the headline metric series, and compares the LATEST round's
+metrics against the best prior round per metric. Exit nonzero when any
+headline metric regressed by more than the threshold.
+
+Direction matters: wall-clock and p99 metrics regress *upward*, rows/s
+regresses *downward*. Only metrics present in the latest round are gated
+— a round that doesn't run the exact-fit leg (no Skin dataset in the
+container) isn't failed for it.
+
+Threshold honesty: most rounds are recorded with ``cpu_smoke: true`` on
+a 1-core host, where run-to-run noise on short SLO legs routinely exceeds
+10% (r11's 6120 rows/s vs r10's 7721 on identical code paths). The gate
+therefore uses ``--threshold`` (default 0.10) when both sides are real
+hardware, and ``--smoke-threshold`` (default 0.25) when either side is a
+cpu_smoke round. Both are flags; tightening them on a real-TPU lane is
+the intent (ROADMAP item 5).
+
+Usage:
+    python scripts/bench_compare.py [--dir REPO] [--threshold 0.10]
+        [--smoke-threshold 0.25] [--latest BENCH_rNN.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+# Headline metric series -> direction ("lower" = bigger is worse).
+HEADLINE = {
+    "skin_nonskin_exact_hdbscan_wall_clock": "lower",
+    "skin_nonskin_exact_hdbscan_wall_clock_literal": "lower",
+    "serve_slo_p99_ms_synthetic_5k": "lower",
+    "serve_slo_rows_per_s_synthetic_5k": "higher",
+    "stream_ingest_rows_per_s_synthetic_5k": "higher",
+    "serve_chaos_p99_under_fault_ms_synthetic_5k": "lower",
+}
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def _records_from(obj) -> list[dict]:
+    """Metric records inside one parsed JSON value (dict with "metric",
+    or a dict of sub-leg dicts like r10's {"chaos": ..., "slo": ...})."""
+    if not isinstance(obj, dict):
+        return []
+    if "metric" in obj:
+        return [obj]
+    out = []
+    for v in obj.values():
+        out.extend(_records_from(v))
+    return out
+
+
+def _records_from_tail(tail) -> list[dict]:
+    """Salvage metric records from a "tail" field: string tails may embed
+    JSON lines; dict tails (r10) are already structured."""
+    if isinstance(tail, dict):
+        return _records_from(tail)
+    if not isinstance(tail, str):
+        return []
+    out = []
+    for line in tail.splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and '"metric"' in line):
+            continue
+        try:
+            out.extend(_records_from(json.loads(line)))
+        except json.JSONDecodeError:
+            continue
+    return out
+
+
+def load_round(path: str) -> dict:
+    """Normalize one BENCH_rNN.json into {round, cpu_smoke, metrics}.
+
+    ``metrics`` maps headline series name -> float value. A record's
+    primary value lands under its "metric" name; companion fields that
+    are themselves headline series (slo_rows_per_s rides inside the slo
+    p99 record) are lifted into their own series.
+    """
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    records = _records_from(doc)
+    if not records and isinstance(doc, dict):
+        records = _records_from(doc.get("parsed"))
+    if not records and isinstance(doc, dict):
+        records = _records_from_tail(doc.get("tail"))
+    metrics: dict[str, float] = {}
+    cpu_smoke = bool(doc.get("cpu_smoke")) if isinstance(doc, dict) else False
+    for rec in records:
+        name = rec.get("metric")
+        value = rec.get("value")
+        cpu_smoke = cpu_smoke or bool(rec.get("cpu_smoke"))
+        if name in HEADLINE and isinstance(value, (int, float)):
+            metrics[name] = float(value)
+        if name == "serve_slo_p99_ms_synthetic_5k":
+            rows = rec.get("slo_rows_per_s")
+            if isinstance(rows, (int, float)):
+                metrics["serve_slo_rows_per_s_synthetic_5k"] = float(rows)
+    m = _ROUND_RE.search(os.path.basename(path))
+    return {
+        "path": path,
+        "round": int(m.group(1)) if m else -1,
+        "cpu_smoke": cpu_smoke,
+        "metrics": metrics,
+    }
+
+
+def compare(rounds: list[dict], threshold: float,
+            smoke_threshold: float) -> tuple[list[str], list[str]]:
+    """Gate the last round against the best prior value per metric.
+
+    Returns (report_lines, regression_lines); the gate fails when
+    regression_lines is non-empty.
+    """
+    latest = rounds[-1]
+    prior = rounds[:-1]
+    report, regressions = [], []
+    for name, value in sorted(latest["metrics"].items()):
+        direction = HEADLINE[name]
+        best = None
+        best_round = None
+        for r in prior:
+            v = r["metrics"].get(name)
+            if v is None:
+                continue
+            better = (
+                best is None
+                or (direction == "lower" and v < best)
+                or (direction == "higher" and v > best)
+            )
+            if better:
+                best, best_round = v, r
+        if best is None:
+            report.append(f"  {name}: {value:g} (no prior round — baseline)")
+            continue
+        smoke = latest["cpu_smoke"] or best_round["cpu_smoke"]
+        limit = smoke_threshold if smoke else threshold
+        if direction == "lower":
+            delta = (value - best) / best
+        else:
+            delta = (best - value) / best
+        tag = "cpu_smoke" if smoke else "strict"
+        line = (
+            f"  {name}: {value:g} vs best prior {best:g} "
+            f"(r{best_round['round']:02d}) — "
+            f"{'regressed' if delta > 0 else 'improved/held'} "
+            f"{abs(delta) * 100:.1f}% [{tag} limit {limit * 100:.0f}%]"
+        )
+        report.append(line)
+        if delta > limit:
+            regressions.append(line.strip())
+    return report, regressions
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), help="repo root holding BENCH_r*.json")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="max fractional regression, real-hardware rounds")
+    ap.add_argument("--smoke-threshold", type=float, default=0.25,
+                    help="max fractional regression when either side is cpu_smoke")
+    ap.add_argument("--latest", default=None,
+                    help="explicit latest-round file (default: highest rNN)")
+    args = ap.parse_args(argv)
+    if not args.threshold > 0 or not args.smoke_threshold > 0:
+        ap.error("thresholds must be > 0")
+
+    paths = sorted(glob.glob(os.path.join(args.dir, "BENCH_r*.json")),
+                   key=lambda p: _ROUND_RE.search(p).group(1))
+    if args.latest:
+        latest_path = os.path.join(args.dir, os.path.basename(args.latest))
+        paths = [p for p in paths if p != latest_path] + [latest_path]
+    if len(paths) < 2:
+        print("bench_compare: need >= 2 BENCH_r*.json rounds to compare",
+              file=sys.stderr)
+        return 2
+    rounds = [load_round(p) for p in paths]
+    latest = rounds[-1]
+    if not latest["metrics"]:
+        print(f"bench_compare: latest round {latest['path']} carries no "
+              f"headline metrics", file=sys.stderr)
+        return 2
+    print(f"bench_compare: r{latest['round']:02d} vs {len(rounds) - 1} prior "
+          f"round(s)")
+    report, regressions = compare(rounds, args.threshold,
+                                  args.smoke_threshold)
+    for line in report:
+        print(line)
+    if regressions:
+        print(f"bench_compare: FAIL — {len(regressions)} metric(s) regressed "
+              f"beyond threshold", file=sys.stderr)
+        return 1
+    print("bench_compare: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

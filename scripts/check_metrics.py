@@ -26,6 +26,10 @@ carry their required labels with integral non-negative values,
 ``hdbscan_tpu_replica_up`` is a per-replica 0/1 gauge, the
 in-flight/resident gauges never go negative, and
 ``hdbscan_tpu_tenant_predict_seconds`` is a histogram labelled by tenant.
+The deep-observability families (README "Observability"):
+``hdbscan_tpu_watchdog_stalls_total`` must be an integral non-negative
+counter and ``hdbscan_tpu_device_peak_bytes`` a gauge carrying a
+``device`` label with non-negative byte values.
 
 With two files (two scrapes of the same server, second taken later): also
 checks counter monotonicity — every counter-type sample and every
@@ -370,6 +374,41 @@ def _check_fleet_metrics(parsed, where: str) -> list:
     return errors
 
 
+def _check_obs_metrics(parsed, where: str) -> list:
+    """Deep-observability family contracts (hdbscan_tpu/obs, serve/server.py):
+    the watchdog stall counter is an integral non-negative counter, and the
+    per-device peak-bytes gauge carries a ``device`` label with non-negative
+    values."""
+    errors: list = []
+    types, samples = parsed["types"], parsed["samples"]
+    fam = "hdbscan_tpu_watchdog_stalls_total"
+    if fam in types and types[fam] != "counter":
+        errors.append(f"{where}: {fam} declared {types[fam]!r}, want counter")
+    for (name, label_items), value in samples.items():
+        if name != fam:
+            continue
+        if value < 0 or value != int(value):
+            errors.append(
+                f"{where}: {fam}{dict(label_items)} value {value} not a "
+                f"non-negative integer"
+            )
+    fam = "hdbscan_tpu_device_peak_bytes"
+    if fam in types and types[fam] != "gauge":
+        errors.append(f"{where}: {fam} declared {types[fam]!r}, want gauge")
+    for (name, label_items), value in samples.items():
+        if name != fam:
+            continue
+        labels = dict(label_items)
+        if not labels.get("device"):
+            errors.append(f"{where}: {fam} sample lacks a 'device' label")
+        if value < 0 or value != int(value):
+            errors.append(
+                f"{where}: {fam}{labels} value {value} not a non-negative "
+                f"byte count"
+            )
+    return errors
+
+
 def validate_exposition(text: str, where: str = "metrics"):
     """Grammar + histogram-consistency + fault-family + fleet-family
     validation of one scrape. Returns ``(parsed, errors)``."""
@@ -377,6 +416,7 @@ def validate_exposition(text: str, where: str = "metrics"):
     errors += _check_histograms(parsed, where)
     errors += _check_fault_metrics(parsed, where)
     errors += _check_fleet_metrics(parsed, where)
+    errors += _check_obs_metrics(parsed, where)
     return parsed, errors
 
 

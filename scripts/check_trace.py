@@ -88,7 +88,34 @@ when its load event fires) and non-negative ``jit_compiles`` (0 on a
 re-warm against a warmed bucket ladder — the zero-steady-state-recompile
 contract across evictions); ``tenant_evict`` a non-empty string
 ``tenant``, positive ``generation`` and non-negative
-``resident``/``requests``. Given
+``resident``/``requests``.
+Deep-observability events (``hdbscan_tpu/obs``, README "Observability")
+add five schemas: ``mem_sample`` must carry a non-empty string ``phase``,
+a ``source`` in ``{memory_stats, live_arrays}`` and non-negative integer
+``max_device_bytes``/``total_bytes``; ``mem_phase_peak`` additionally
+positive ``samples``/``devices`` and a ``max_device_bytes`` that is >= the
+running max of every ``mem_sample`` seen for that (process, phase) since
+the previous peak — a phase's published peak can never under-report its
+own samples; ``heartbeat`` a non-empty string ``phase``, a positive
+integer ``task`` id, a ``progress`` in [0, 1] that is MONOTONE
+non-decreasing per (process, phase, task) — progress fractions never move
+backwards — plus an optional finite non-negative ``eta_s``;
+``watchdog_stall`` a positive ``stalled_s``, a positive integer
+``threads``, a non-empty ``phases`` list and a string ``stacks`` dump;
+``router_span`` (the fleet router's half of a request's causal chain) a
+non-empty string ``request_id``/``replica``, ``route`` in
+``{/predict, /ingest}``, ``policy`` in ``{consistent_hash, least_loaded}``,
+an HTTP ``status`` int, positive ``attempts``, a finite non-negative
+``queue_s`` and a boolean ``replied``.
+
+``check_trace.py --join ROUTER.jsonl REPLICA.jsonl [REPLICA.jsonl ...]``
+validates every file, then joins the router's ``router_span`` events
+against the replicas' ``request_span``/``request_shed`` events on
+``request_id``: every replied router span must match EXACTLY ONE replica
+event (100% causal-chain reconstruction), and a duplicate match (the same
+id answered by two replicas) is a violation.
+
+Given
 a report (``utils/telemetry.REPORT_SCHEMA``), additionally cross-checks
 that the report's per-phase wall totals equal the trace's per-stage wall
 sums within 1e-6, and — when the report carries a ``predict_latency``
@@ -149,6 +176,8 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
     last_swap_gen: dict = {}  # per-(process, server) model_swap generation
     seen_request_ids: dict = {}  # per-process ids across span + shed events
     last_wal_seq: dict = {}  # per-(process, wal) wal_append seq
+    mem_running_max: dict = {}  # per-(process, phase) mem_sample running max
+    hb_progress: dict = {}  # per-(process, phase, task) heartbeat progress
     with open(path, encoding="utf-8") as f:
         for lineno, line in enumerate(f, 1):
             line = line.strip()
@@ -329,6 +358,43 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
             if stage in ("fleet_route", "replica_health", "tenant_load",
                          "tenant_evict"):
                 errors += _check_fleet(path, lineno, stage, ev)
+            # Deep-observability invariants (hdbscan_tpu/obs): per-event
+            # schemas in the helper; the peak-covers-samples and monotone-
+            # progress checks need cross-event state so they live here.
+            if stage in ("mem_sample", "mem_phase_peak", "heartbeat",
+                         "watchdog_stall", "router_span"):
+                errors += _check_obs(path, lineno, stage, ev)
+                if stage == "mem_sample":
+                    mx = ev.get("max_device_bytes")
+                    if _nonneg_int(mx):
+                        key = (proc, ev.get("phase"))
+                        if mx > mem_running_max.get(key, -1):
+                            mem_running_max[key] = mx
+                elif stage == "mem_phase_peak":
+                    peak = ev.get("max_device_bytes")
+                    key = (proc, ev.get("phase"))
+                    running = mem_running_max.pop(key, None)
+                    if _nonneg_int(peak) and running is not None and (
+                        peak < running
+                    ):
+                        errors.append(
+                            f"{path}:{lineno}: mem_phase_peak "
+                            f"max_device_bytes {peak} < running sample max "
+                            f"{running} for phase {ev.get('phase')!r} — a "
+                            f"phase peak cannot under-report its own samples"
+                        )
+                elif stage == "heartbeat":
+                    p = ev.get("progress")
+                    if isinstance(p, (int, float)) and not isinstance(p, bool):
+                        key = (proc, ev.get("phase"), ev.get("task"))
+                        prev = hb_progress.get(key)
+                        if prev is not None and float(p) < prev:
+                            errors.append(
+                                f"{path}:{lineno}: heartbeat progress {p} "
+                                f"moved backwards (prev {prev}) for task "
+                                f"{key[1]!r}/{key[2]!r}"
+                            )
+                        hb_progress[key] = max(prev or 0.0, float(p))
             # Per-device wall events: each device's timeline must be ordered.
             device = ev.get("device")
             if isinstance(device, int) and isinstance(seq, int):
@@ -659,6 +725,106 @@ def _check_fleet(path: str, lineno: int, stage: str, ev: dict) -> list[str]:
     return errors
 
 
+def _finite_nonneg(val) -> bool:
+    return (
+        isinstance(val, (int, float))
+        and not isinstance(val, bool)
+        and math.isfinite(float(val))
+        and float(val) >= 0
+    )
+
+
+def _check_obs(path: str, lineno: int, stage: str, ev: dict) -> list[str]:
+    """The five deep-observability event schemas (hdbscan_tpu/obs). The
+    cross-event checks — peak >= running sample max, monotone heartbeat
+    progress — live in the main loop (they need shared state)."""
+    errors: list[str] = []
+    where = f"{path}:{lineno}: {stage}"
+    if stage in ("mem_sample", "mem_phase_peak"):
+        if not isinstance(ev.get("phase"), str) or not ev.get("phase"):
+            errors.append(f"{where} lacks a non-empty string 'phase'")
+        if ev.get("source") not in ("memory_stats", "live_arrays"):
+            errors.append(
+                f"{where} source={ev.get('source')!r} not in "
+                f"(memory_stats, live_arrays)"
+            )
+        for key in ("max_device_bytes", "total_bytes"):
+            if not _nonneg_int(ev.get(key)):
+                errors.append(
+                    f"{where} {key}={ev.get(key)!r} not a non-negative int"
+                )
+        if stage == "mem_phase_peak":
+            for key in ("samples", "devices"):
+                if not _pos_int(ev.get(key)):
+                    errors.append(
+                        f"{where} {key}={ev.get(key)!r} not a positive int"
+                    )
+    elif stage == "heartbeat":
+        if not isinstance(ev.get("phase"), str) or not ev.get("phase"):
+            errors.append(f"{where} lacks a non-empty string 'phase'")
+        if not _pos_int(ev.get("task")):
+            errors.append(f"{where} task={ev.get('task')!r} not a positive int")
+        p = ev.get("progress")
+        if not _finite_nonneg(p) or float(p) > 1.0:
+            errors.append(f"{where} progress={p!r} not in [0, 1]")
+        if "done" in ev and not _nonneg_int(ev.get("done")):
+            errors.append(
+                f"{where} done={ev.get('done')!r} not a non-negative int"
+            )
+        if "total" in ev and not _pos_int(ev.get("total")):
+            errors.append(
+                f"{where} total={ev.get('total')!r} not a positive int"
+            )
+        if "eta_s" in ev and not _finite_nonneg(ev.get("eta_s")):
+            errors.append(
+                f"{where} eta_s={ev.get('eta_s')!r} not a finite "
+                f"non-negative number"
+            )
+    elif stage == "watchdog_stall":
+        stalled = ev.get("stalled_s")
+        if not _finite_nonneg(stalled) or float(stalled) <= 0:
+            errors.append(f"{where} stalled_s={stalled!r} not a positive number")
+        if not _pos_int(ev.get("threads")):
+            errors.append(
+                f"{where} threads={ev.get('threads')!r} not a positive int"
+            )
+        phases = ev.get("phases")
+        if not isinstance(phases, list) or not phases:
+            errors.append(f"{where} phases={phases!r} not a non-empty list")
+        if not isinstance(ev.get("stacks"), str):
+            errors.append(f"{where} lacks a string 'stacks' dump")
+    else:  # router_span
+        for key in ("request_id", "replica"):
+            if not isinstance(ev.get(key), str) or not ev.get(key):
+                errors.append(f"{where} lacks a non-empty string {key!r}")
+        if ev.get("route") not in ("/predict", "/ingest"):
+            errors.append(
+                f"{where} route={ev.get('route')!r} not in (/predict, /ingest)"
+            )
+        if ev.get("policy") not in ("consistent_hash", "least_loaded"):
+            errors.append(
+                f"{where} policy={ev.get('policy')!r} not in "
+                f"(consistent_hash, least_loaded)"
+            )
+        status = ev.get("status")
+        if not isinstance(status, int) or isinstance(status, bool) or not (
+            100 <= status <= 599
+        ):
+            errors.append(f"{where} status={status!r} not an HTTP status int")
+        if not _pos_int(ev.get("attempts")):
+            errors.append(
+                f"{where} attempts={ev.get('attempts')!r} not a positive int"
+            )
+        if not _finite_nonneg(ev.get("queue_s")):
+            errors.append(
+                f"{where} queue_s={ev.get('queue_s')!r} not a finite "
+                f"non-negative number"
+            )
+        if not isinstance(ev.get("replied"), bool):
+            errors.append(f"{where} replied={ev.get('replied')!r} not a bool")
+    return errors
+
+
 #: The five telescoping segments of a request_span, in wall-clock order.
 SPAN_SEGMENTS = ("parse_s", "queue_s", "assemble_s", "predict_s", "respond_s")
 
@@ -836,8 +1002,61 @@ def _check_predict_latency(
     return errors
 
 
+def join_fleet(router_path: str, replica_paths: list[str]) -> int:
+    """Validate every file, then require the router→replica causal join to
+    be complete: each replied=true ``router_span`` must match exactly one
+    replica ``request_span``/``request_shed`` on request_id."""
+    errors: list[str] = []
+    router_events, router_errors = validate_trace(router_path)
+    errors += router_errors
+    replica_events: list[dict] = []
+    for path in replica_paths:
+        evs, errs = validate_trace(path)
+        errors += errs
+        replica_events += evs
+    spans = [e for e in router_events if e.get("stage") == "router_span"]
+    replied = [e for e in spans if e.get("replied")]
+    replica_ids: dict[str, int] = {}
+    for ev in replica_events:
+        if ev.get("stage") in ("request_span", "request_shed"):
+            rid = ev.get("request_id")
+            if isinstance(rid, str):
+                replica_ids[rid] = replica_ids.get(rid, 0) + 1
+    matched = 0
+    for ev in replied:
+        rid = ev.get("request_id")
+        count = replica_ids.get(rid, 0)
+        if count == 0:
+            errors.append(
+                f"{router_path}: router_span {rid!r} (replied) has no "
+                f"matching replica request_span/request_shed"
+            )
+        elif count > 1:
+            errors.append(
+                f"{router_path}: router_span {rid!r} matches {count} "
+                f"replica spans (expected exactly one)"
+            )
+        else:
+            matched += 1
+    for err in errors:
+        print(f"FAIL {err}", file=sys.stderr)
+    if errors:
+        print(f"{len(errors)} violation(s)", file=sys.stderr)
+        return 1
+    print(
+        f"OK join: {len(spans)} router_span(s), {len(replied)} replied, "
+        f"{matched} matched across {len(replica_paths)} replica trace(s)"
+    )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "--join":
+        if len(argv) < 3:
+            print(__doc__, file=sys.stderr)
+            return 1
+        return join_fleet(argv[1], argv[2:])
     if not argv or len(argv) > 2:
         print(__doc__, file=sys.stderr)
         return 1

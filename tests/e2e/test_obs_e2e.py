@@ -1,0 +1,172 @@
+"""Deep-observability end-to-end (README "Observability").
+
+Two legs:
+
+- **CLI fit**: a real ``python -m hdbscan_tpu`` subprocess with
+  ``--trace-out``/``--report``/``--assert-not-replicated`` and the
+  watchdog armed. The trace must satisfy ``scripts/check_trace.py``'s obs
+  schemas, the report must carry the per-phase memory watermark table
+  (schema ``hdbscan-tpu-report/2``), and the replication gate must pass
+  cleanly on the single-device run (the 8-device trip/pass legs live in
+  ``tests/unit/test_obs.py``).
+- **Fleet join** (slow lane): real replica subprocesses behind the router
+  with ``replica_trace_dir`` set — every routed request's ``router_span``
+  must join exactly one replica ``request_span`` on the propagated
+  ``X-Request-Id`` (100% causal-chain reconstruction), both through
+  ``obs.merge_fleet_traces`` and the ``check_trace.py --join`` CLI.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu import HDBSCANParams, obs
+from hdbscan_tpu.utils.telemetry import REPORT_SCHEMA
+from scripts import check_trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _blobs_csv(path, n=120, seed=5):
+    rng = np.random.default_rng(seed)
+    centers = np.asarray([(0.0, 0.0, 0.0), (5.0, 5.0, 5.0)])
+    pts = centers[np.arange(n) % 2] + rng.normal(0, 0.2, (n, 3))
+    np.savetxt(path, pts, delimiter=",")
+    return pts
+
+
+def test_cli_fit_deep_observability(tmp_path):
+    csv = str(tmp_path / "pts.csv")
+    trace = str(tmp_path / "trace.jsonl")
+    report = str(tmp_path / "report.json")
+    _blobs_csv(csv)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # Drop conftest's 8-device forcing: this leg is the single-device CLI
+    # story (gate passes via the one-device bypass, recorded in the event).
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "hdbscan_tpu",
+            f"file={csv}", "minPts=4", "minClSize=4",
+            f"out_dir={tmp_path}",
+            "--trace-out", trace, "--report", report,
+            "--assert-not-replicated", "watchdog=30", "heartbeat=0.2",
+        ],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    events, errors = check_trace.validate_trace(trace)
+    assert errors == [], errors
+    stages = {e["stage"] for e in events}
+    assert {"mem_sample", "mem_phase_peak", "heartbeat",
+            "replication_gate"} <= stages
+    # No stall on a healthy run: the watchdog produced zero dumps.
+    assert "watchdog_stall" not in stages
+
+    gates = [e for e in events if e["stage"] == "replication_gate"]
+    assert len(gates) == 1 and gates[0]["ok"] is True
+    assert gates[0]["phases"] >= 1
+
+    beats = [e for e in events if e["stage"] == "heartbeat"]
+    assert beats and all(0.0 <= e["progress"] <= 1.0 for e in beats)
+
+    with open(report, encoding="utf-8") as f:
+        rep = json.load(f)
+    assert rep["schema"] == REPORT_SCHEMA
+    watermarks = rep["memory"]["watermarks"]
+    assert watermarks, "report carries no per-phase memory watermarks"
+    peaks_by_phase = {
+        e["phase"]: e for e in events if e["stage"] == "mem_phase_peak"
+    }
+    for phase, wm in watermarks.items():
+        assert phase in peaks_by_phase
+        assert wm["samples"] >= 2
+        assert wm["max_device_bytes"] >= 0
+        assert wm["max_device_bytes"] <= wm["total_bytes"]
+    # The report pairs with its trace under the full validator.
+    _, rep_errors = check_trace.validate_report(report, trace_events=events)
+    assert rep_errors == [], rep_errors
+
+
+@pytest.fixture(scope="module")
+def obs_fleet_model(tmp_path_factory):
+    from hdbscan_tpu.models import hdbscan
+
+    rng = np.random.default_rng(17)
+    centers = np.asarray([(0.0, 0.0, 0.0), (6.0, 6.0, 6.0), (0.0, 8.0, 0.0)])
+    pts = centers[np.arange(360) % 3] + rng.normal(0, 0.25, (360, 3))
+    params = HDBSCANParams(
+        min_points=5, min_cluster_size=25, processing_units=512,
+    )
+    model = hdbscan.fit(pts, params).to_cluster_model(pts, params)
+    path = str(tmp_path_factory.mktemp("obs-fleet") / "model.npz")
+    model.save(path)
+    return path, pts
+
+
+@pytest.mark.slow
+def test_fleet_router_replica_join_is_complete(obs_fleet_model, tmp_path):
+    from hdbscan_tpu.fleet import FleetRouter
+    from hdbscan_tpu.utils.tracing import JsonlSink, Tracer
+
+    model_path, pts = obs_fleet_model
+    router_trace = str(tmp_path / "router.jsonl")
+    replica_dir = str(tmp_path / "replica-traces")
+    tracer = Tracer(sinks=[JsonlSink(router_trace)])
+    router = FleetRouter(
+        model_path, replicas=2, policy="least_loaded",
+        health_interval_s=0.5, replica_args=["predict_batch=64"],
+        tracer=tracer, replica_trace_dir=replica_dir,
+    )
+    X = pts[:8].tolist()
+    seen_ids = []
+    with router:
+        base = f"http://{router.host}:{router.port}"
+        for i in range(24):
+            headers = {"Content-Type": "application/json"}
+            if i % 4 == 0:  # every 4th request supplies its own id ...
+                headers["X-Request-Id"] = f"client-{i}"
+            req = urllib.request.Request(
+                base + "/predict", json.dumps({"points": X}).encode(), headers
+            )
+            with urllib.request.urlopen(req, timeout=120) as r:
+                assert r.status == 200
+                rid = r.headers["x-request-id"]
+            assert rid, "response lost its X-Request-Id"
+            if i % 4 == 0:  # ... and gets the SAME id back (propagated)
+                assert rid == f"client-{i}"
+            seen_ids.append(rid)
+    tracer.close()
+    assert len(set(seen_ids)) == 24  # ids are unique across the run
+
+    replica_traces = sorted(
+        os.path.join(replica_dir, f) for f in os.listdir(replica_dir)
+    )
+    assert len(replica_traces) == 2
+
+    # 100% causal-chain reconstruction, by the library join ...
+    merged = obs.merge_fleet_traces(router_trace, replica_traces)
+    join = merged["join"]
+    assert join["complete"] is True, join
+    assert join["matched"] == join["replied"] == 24
+    assert join["orphans"] == [] and join["duplicates"] == []
+    assert merged["router"]["events"] > 0
+    assert all(r["events"] > 0 for r in merged["replicas"].values())
+
+    # ... and by the standalone validator CLI (validates both sides too).
+    assert check_trace.join_fleet(router_trace, replica_traces) == 0
+
+    # The router's spans carry the client-supplied ids bitwise.
+    events, errors = check_trace.validate_trace(router_trace)
+    assert errors == [], errors
+    span_ids = {
+        e["request_id"] for e in events if e["stage"] == "router_span"
+    }
+    assert span_ids == set(seen_ids)

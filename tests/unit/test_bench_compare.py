@@ -1,0 +1,143 @@
+"""The bench-trajectory regression gate (scripts/bench_compare.py).
+
+Tier-1 keeps the gate honest three ways: the checked-in ``BENCH_r*.json``
+trajectory must PASS it (a regression recorded into the repo should have
+been caught before commit), a fabricated regressed round must FAIL it,
+and the four generations of round schema (raw records, ``parsed``
+wrappers, multi-leg wrappers, tail-embedded JSON lines) must all
+normalize to the same metric series.
+"""
+
+import json
+import os
+import shutil
+
+import pytest
+
+from scripts import bench_compare
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _checked_in_rounds():
+    return sorted(
+        f for f in os.listdir(REPO)
+        if bench_compare._ROUND_RE.search(f)
+    )
+
+
+def test_checked_in_trajectory_passes():
+    rounds = _checked_in_rounds()
+    if len(rounds) < 2:
+        pytest.skip("fewer than 2 checked-in bench rounds")
+    assert bench_compare.main(["--dir", REPO]) == 0
+
+
+def test_fabricated_regression_fails(tmp_path):
+    """A 2x-slower SLO p99 in the newest round must trip the gate even at
+    the loose cpu_smoke threshold."""
+    for f in _checked_in_rounds():
+        shutil.copy(os.path.join(REPO, f), tmp_path / f)
+    if len(_checked_in_rounds()) < 1:
+        pytest.skip("no checked-in bench rounds to regress against")
+    prior = bench_compare.load_round(
+        os.path.join(REPO, _checked_in_rounds()[-1])
+    )
+    p99 = prior["metrics"].get("serve_slo_p99_ms_synthetic_5k")
+    if p99 is None:
+        pytest.skip("latest checked-in round carries no SLO p99")
+    bad = {
+        "metric": "serve_slo_p99_ms_synthetic_5k",
+        "value": p99 * 2.0,
+        "cpu_smoke": True,
+    }
+    (tmp_path / "BENCH_r98.json").write_text(json.dumps(bad))
+    assert bench_compare.main(["--dir", str(tmp_path)]) == 1
+
+
+def test_improvement_passes(tmp_path):
+    base = {
+        "metric": "serve_slo_p99_ms_synthetic_5k",
+        "value": 100.0,
+        "slo_rows_per_s": 5000.0,
+        "cpu_smoke": True,
+    }
+    good = dict(base, value=50.0, slo_rows_per_s=9000.0)
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(base))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(good))
+    assert bench_compare.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_direction_matters(tmp_path):
+    """rows/s regresses DOWNWARD: halving throughput fails even though the
+    raw number "only" moved down."""
+    base = {
+        "metric": "serve_slo_p99_ms_synthetic_5k",
+        "value": 100.0,
+        "slo_rows_per_s": 8000.0,
+    }
+    worse = dict(base, slo_rows_per_s=4000.0)
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(base))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(worse))
+    assert bench_compare.main(["--dir", str(tmp_path)]) == 1
+
+
+def test_smoke_threshold_wider_than_strict(tmp_path):
+    """A 15% regression passes when either side is cpu_smoke (25% limit)
+    but fails a strict real-hardware comparison (10% limit)."""
+    base = {"metric": "serve_slo_p99_ms_synthetic_5k", "value": 100.0}
+    worse = dict(base, value=115.0)
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(dict(base, cpu_smoke=True)))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(worse))
+    assert bench_compare.main(["--dir", str(tmp_path)]) == 0
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(base))
+    assert bench_compare.main(["--dir", str(tmp_path)]) == 1
+
+
+def test_missing_leg_not_failed(tmp_path):
+    """Only metrics present in the latest round are gated: dropping the
+    exact-fit leg (no dataset in the container) is not a regression."""
+    full = {
+        "parsed": {
+            "slo": {"metric": "serve_slo_p99_ms_synthetic_5k", "value": 80.0},
+            "exact": {
+                "metric": "skin_nonskin_exact_hdbscan_wall_clock",
+                "value": 60.0,
+            },
+        }
+    }
+    slim = {"metric": "serve_slo_p99_ms_synthetic_5k", "value": 82.0}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(full))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(slim))
+    assert bench_compare.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_schema_generations_normalize(tmp_path):
+    """All four historical round shapes yield the same metric series."""
+    raw = {"metric": "serve_slo_p99_ms_synthetic_5k", "value": 42.0}
+    shapes = [
+        raw,
+        {"parsed": raw},
+        {"parsed": {"slo": raw}},
+        {"tail": "noise\n" + json.dumps(raw) + "\nmore noise"},
+    ]
+    for i, doc in enumerate(shapes):
+        p = tmp_path / f"BENCH_r{i:02d}.json"
+        p.write_text(json.dumps(doc))
+        out = bench_compare.load_round(str(p))
+        assert out["metrics"] == {"serve_slo_p99_ms_synthetic_5k": 42.0}, doc
+        assert out["round"] == i
+
+
+def test_needs_two_rounds(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps({"metric": "serve_slo_p99_ms_synthetic_5k", "value": 1.0})
+    )
+    assert bench_compare.main(["--dir", str(tmp_path)]) == 2
+
+
+def test_latest_without_headline_metrics_rejected(tmp_path):
+    ok = {"metric": "serve_slo_p99_ms_synthetic_5k", "value": 1.0}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(ok))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps({"tail": "no data"}))
+    assert bench_compare.main(["--dir", str(tmp_path)]) == 2

@@ -1,0 +1,433 @@
+"""Deep-observability layer (hdbscan_tpu/obs): memory auditor, replication
+gate, heartbeats + watchdog, and fleet span correlation.
+
+Covers the ISSUE acceptance legs that fit in the unit lane:
+
+- the ``live_arrays`` sampling fallback attributes a known buffer's bytes
+  to its device on CPU (where ``memory_stats`` is unavailable);
+- ``assert_not_replicated`` trips on a deliberately replicated buffer on
+  the 8-device virtual mesh (conftest forces
+  ``--xla_force_host_platform_device_count=8``) and passes the properly
+  sharded equivalent of the same logical array;
+- a stalled phase — injected through the existing fault harness's
+  ``phase_stall`` site — makes the watchdog dump thread stacks within
+  ``watchdog_s``, while a healthy beating loop produces zero stalls;
+- heartbeat progress is monotone and the emitted events satisfy
+  ``scripts/check_trace.py``'s obs schemas.
+"""
+
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hdbscan_tpu import obs
+from hdbscan_tpu.fault import inject
+from hdbscan_tpu.obs.audit import (
+    MemoryAuditor,
+    ReplicatedBufferError,
+    sample_per_device,
+)
+from hdbscan_tpu.obs.heartbeat import Heartbeats
+from hdbscan_tpu.utils.tracing import JsonlSink, Tracer
+
+
+@pytest.fixture(autouse=True)
+def _clean_installs():
+    """Never leak a process-global auditor/hub/fault-plan across tests."""
+    yield
+    obs.clear()
+    inject.clear()
+
+
+def _events(tracer, stage):
+    return [e for e in tracer.events if e.name == stage]
+
+
+# -- sampling ---------------------------------------------------------------
+
+
+def test_live_arrays_attributes_buffer_to_device():
+    keep = jnp.arange(4096, dtype=jnp.float64)  # 32 KiB pinned live
+    keep.block_until_ready()
+    per_dev, src = sample_per_device(source="live_arrays")
+    assert src == "live_arrays"
+    # A single-device array lands whole on cpu:0 of the virtual mesh.
+    assert per_dev.get("cpu:0", 0) >= keep.nbytes
+    assert set(per_dev) == {f"cpu:{d.id}" for d in jax.devices()}
+    del keep
+
+
+def test_memory_stats_forced_raises_on_cpu():
+    with pytest.raises(RuntimeError, match="memory_stats unavailable"):
+        sample_per_device(source="memory_stats")
+
+
+def test_sample_source_validated():
+    with pytest.raises(ValueError, match="source must be"):
+        sample_per_device(source="psutil")
+
+
+def test_auditor_phase_watermarks_and_events():
+    tracer = Tracer()
+    aud = MemoryAuditor(tracer=tracer, interval_s=0.005, source="live_arrays")
+    with aud.phase("alloc"):
+        keep = jnp.ones((2048, 8), dtype=jnp.float64)  # 128 KiB
+        keep.block_until_ready()
+    table = aud.watermark_table()
+    assert set(table) == {"alloc"}
+    wm = table["alloc"]
+    assert wm["source"] == "live_arrays"
+    assert wm["samples"] >= 2  # entry + exit at minimum
+    assert wm["max_device_bytes"] >= keep.nbytes
+    assert wm["per_device"]["cpu:0"] >= keep.nbytes
+    # peak event dominates every sample, as check_trace enforces.
+    samples = _events(tracer, "mem_sample")
+    peaks = _events(tracer, "mem_phase_peak")
+    assert len(peaks) == 1 and len(samples) == wm["samples"]
+    peak = peaks[0].fields
+    assert peak["max_device_bytes"] == wm["max_device_bytes"]
+    assert all(
+        s.fields["max_device_bytes"] <= peak["max_device_bytes"]
+        for s in samples
+    )
+    assert aud.device_peaks()["cpu:0"] >= keep.nbytes
+    del keep
+
+
+def test_auditor_merges_repeated_phases():
+    aud = MemoryAuditor(interval_s=0.005, source="live_arrays")
+    with aud.phase("p"):
+        pass
+    first = aud.watermark_table()["p"]["samples"]
+    with aud.phase("p"):
+        pass
+    assert aud.watermark_table()["p"]["samples"] >= first + 2
+
+
+def test_auditor_knob_validation():
+    with pytest.raises(ValueError, match="interval_s"):
+        MemoryAuditor(interval_s=0.0)
+    with pytest.raises(ValueError, match="source"):
+        MemoryAuditor(source="top")
+
+
+# -- the replication gate ---------------------------------------------------
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()), ("i",))
+
+
+def test_gate_trips_on_replicated_buffer():
+    """A buffer replicated whole onto all 8 virtual devices trips the gate
+    that the sharded version of the same array passes (ISSUE acceptance).
+
+    The host array goes in via numpy so no full-size jax intermediate ever
+    touches a device — the only device bytes are the ones under test."""
+    aud = MemoryAuditor(interval_s=0.005, source="live_arrays")
+    n, itemsize = 4096, 8  # threshold = 0.5 * 32768 = 16384 B/device
+    host = np.arange(n, dtype=np.float64)
+    with aud.phase("replicated"):
+        bad = jax.device_put(
+            host, NamedSharding(_mesh(), P())  # every device holds all n
+        )
+        bad.block_until_ready()  # live at the phase-exit sample
+    with pytest.raises(ReplicatedBufferError, match="replicated"):
+        aud.assert_not_replicated(n, itemsize)
+    del bad
+
+
+def test_gate_passes_sharded_buffer():
+    aud = MemoryAuditor(interval_s=0.005, source="live_arrays")
+    n, itemsize = 4096, 8
+    host = np.arange(n, dtype=np.float64)
+    with aud.phase("sharded"):
+        ok = jax.device_put(
+            host, NamedSharding(_mesh(), P("i"))  # n/8 rows per device
+        )
+        ok.block_until_ready()
+    out = aud.assert_not_replicated(n, itemsize)
+    assert out["phases"] == ["sharded"]
+    assert 0 < out["worst_fraction"] < 1.0
+    assert out["threshold_bytes"] == pytest.approx(0.5 * n * itemsize)
+    del ok
+
+
+def test_gate_single_device_watermarks_pass():
+    """With one device in the watermarks, "replicated vs sharded" is
+    meaningless — the gate reports the bypass instead of tripping."""
+    aud = MemoryAuditor(interval_s=0.005, source="live_arrays")
+    aud._watermarks["fit"] = {
+        "source": "live_arrays",
+        "samples": 2,
+        "max_device_bytes": 10**9,
+        "total_bytes": 10**9,
+        "per_device": {"cpu:0": 10**9},
+        "wall_s": 0.1,
+    }
+    out = aud.assert_not_replicated(n=4096, itemsize=8)
+    assert out["single_device"] is True
+    assert out["worst_fraction"] == 0.0
+
+
+def test_gate_refuses_to_pass_vacuously():
+    aud = MemoryAuditor(source="live_arrays")
+    with pytest.raises(RuntimeError, match="cannot pass vacuously"):
+        aud.assert_not_replicated(n=10, itemsize=8)
+    with pytest.raises(ValueError, match="never audited"):
+        with aud.phase("real"):
+            pass
+        aud.assert_not_replicated(n=10, itemsize=8, phases=["imaginary"])
+
+
+def test_gate_parameter_validation():
+    aud = MemoryAuditor(source="live_arrays")
+    with pytest.raises(ValueError, match="n must be"):
+        aud.assert_not_replicated(n=0, itemsize=8)
+    with pytest.raises(ValueError, match="itemsize"):
+        aud.assert_not_replicated(n=10, itemsize=0)
+    with pytest.raises(ValueError, match="slack"):
+        aud.assert_not_replicated(n=10, itemsize=8, slack=0.0)
+
+
+# -- heartbeats + watchdog --------------------------------------------------
+
+
+def test_heartbeat_progress_monotone_and_eta(tmp_path):
+    path = str(tmp_path / "hb.jsonl")
+    tracer = Tracer(sinks=[JsonlSink(path)])
+    hub = Heartbeats(tracer=tracer, heartbeat_s=1e-6)  # emit every beat
+    with hub.task("boruvka", total=10) as t:
+        for done in (2, 5, 4, 10):  # 4 then 10: progress must not regress
+            t.beat(done)
+    hub.close()
+    tracer.close()
+    beats = _events(tracer, "heartbeat")
+    progress = [e.fields["progress"] for e in beats]
+    assert progress[0] == 0.0 and progress[-1] == 1.0
+    assert progress == sorted(progress)
+    assert all(0.0 <= p <= 1.0 for p in progress)
+    # ETA appears once progress is positive, and the trace satisfies the
+    # validator's obs schemas (including the monotonicity cross-check).
+    assert any("eta_s" in e.fields for e in beats)
+    from scripts import check_trace
+
+    events, errors = check_trace.validate_trace(path)
+    assert errors == []
+    assert sum(1 for e in events if e.get("stage") == "heartbeat") == len(beats)
+
+
+def test_heartbeat_throttles_between_entry_and_exit():
+    tracer = Tracer()
+    hub = Heartbeats(tracer=tracer, heartbeat_s=60.0)
+    with hub.task("ring", total=100) as t:
+        for done in range(100):
+            t.beat(done)
+    hub.close()
+    beats = _events(tracer, "heartbeat")
+    assert len(beats) == 2  # entry + exit only; 100 beats all throttled
+    assert beats[-1].fields["progress"] == 1.0
+
+
+def test_task_ids_unique_across_tasks():
+    hub = Heartbeats(heartbeat_s=0.5)
+    ids = []
+    for _ in range(3):
+        with hub.task("p") as t:
+            ids.append(t.task_id)
+    hub.close()
+    assert len(set(ids)) == 3
+
+
+def test_watchdog_dumps_on_stalled_phase():
+    """ISSUE acceptance: a deliberately stalled phase (fault harness's
+    ``phase_stall`` site) triggers a stack dump within ``watchdog_s``."""
+
+    class Counter:
+        n = 0
+
+        def inc(self):
+            self.n += 1
+
+    tracer = Tracer()
+    counter = Counter()
+    inject.install("phase_stall:count=1,delay_s=0.5")
+    hub = Heartbeats(
+        tracer=tracer, heartbeat_s=0.01, watchdog_s=0.1, stall_counter=counter
+    )
+    with hub.task("stalled_phase", total=2) as t:
+        t.beat(1)  # injected 0.5 s stall before the liveness refresh
+    hub.close()
+    assert hub.stalls >= 1
+    assert counter.n == hub.stalls
+    stalls = _events(tracer, "watchdog_stall")
+    assert len(stalls) == hub.stalls
+    ev = stalls[0].fields
+    assert ev["phases"] == ["stalled_phase"]
+    assert ev["stalled_s"] > 0.1
+    assert ev["threads"] >= 2
+    # The dump contains actual Python stacks, including the stalled beat.
+    assert "--- thread" in ev["stacks"]
+    assert hub.state()["stalls"] == hub.stalls
+
+
+def test_watchdog_zero_false_positives_on_healthy_loop():
+    tracer = Tracer()
+    hub = Heartbeats(tracer=tracer, heartbeat_s=0.01, watchdog_s=0.3)
+    with hub.task("healthy", total=8) as t:
+        for done in range(8):
+            t.beat(done)
+            threading.Event().wait(0.05)  # 0.4 s of work, beats every 50 ms
+    hub.close()
+    assert hub.stalls == 0
+    assert _events(tracer, "watchdog_stall") == []
+
+
+def test_watchdog_idle_is_not_a_stall():
+    hub = Heartbeats(heartbeat_s=0.01, watchdog_s=0.05)
+    threading.Event().wait(0.2)  # no active tasks: silence is fine
+    hub.close()
+    assert hub.stalls == 0
+
+
+def test_heartbeat_knob_validation():
+    with pytest.raises(ValueError, match="heartbeat_s"):
+        Heartbeats(heartbeat_s=0.0)
+    with pytest.raises(ValueError, match="watchdog_s"):
+        Heartbeats(watchdog_s=-1.0)
+
+
+def test_config_knobs_validated_eagerly():
+    from hdbscan_tpu.config import HDBSCANParams
+
+    with pytest.raises(ValueError, match="heartbeat_s"):
+        HDBSCANParams(heartbeat_s=0.0)
+    with pytest.raises(ValueError, match="watchdog_s"):
+        HDBSCANParams(watchdog_s=-0.5)
+    p = HDBSCANParams(heartbeat_s=2.5, watchdog_s=30.0)
+    assert (p.heartbeat_s, p.watchdog_s) == (2.5, 30.0)
+
+
+# -- the facade -------------------------------------------------------------
+
+
+def test_facade_noops_when_uninstalled():
+    assert obs.auditor() is None and obs.heartbeats() is None
+    with obs.mem_phase("anything"):
+        pass
+    with obs.task("anything", total=3) as t:
+        t.beat(1)  # the null task swallows beats
+    obs.beat("anything", 1, total=2)
+    assert obs.watchdog_state() is None
+    with pytest.raises(RuntimeError, match="no MemoryAuditor installed"):
+        obs.assert_not_replicated(n=10, itemsize=8)
+
+
+def test_facade_scoped_install_restores():
+    aud = MemoryAuditor(source="live_arrays")
+    hub = Heartbeats(heartbeat_s=0.5)
+    with obs.installed(auditor=aud, heartbeats=hub):
+        assert obs.auditor() is aud and obs.heartbeats() is hub
+        with obs.mem_phase("scoped"):
+            pass
+        assert obs.watchdog_state()["active_tasks"] == []
+    assert obs.auditor() is None and obs.heartbeats() is None
+    assert "scoped" in aud.watermark_table()
+
+
+def test_facade_install_clear():
+    aud = MemoryAuditor(source="live_arrays")
+    obs.install(auditor=aud)
+    assert obs.auditor() is aud
+    assert obs.heartbeats() is None  # independent layers
+    obs.install(heartbeats=Heartbeats(heartbeat_s=1.0))
+    assert obs.auditor() is aud  # untouched by the second install
+    obs.clear()
+    assert obs.auditor() is None and obs.heartbeats() is None
+
+
+# -- fleet span correlation -------------------------------------------------
+
+
+def _router_span(rid, replied=True):
+    return {
+        "stage": "router_span",
+        "request_id": rid,
+        "route": "/predict",
+        "policy": "consistent_hash",
+        "replica": "replica_0",
+        "status": 200 if replied else 503,
+        "attempts": 1,
+        "queue_s": 0.001,
+        "wall_s": 0.002,
+        "replied": replied,
+    }
+
+
+def _replica_span(rid, stage="request_span"):
+    return {"stage": stage, "request_id": rid, "route": "/predict"}
+
+
+def test_join_spans_complete_chain():
+    router = [_router_span("r1-1"), _router_span("r1-2")]
+    replicas = [_replica_span("r1-1"), _replica_span("r1-2", "request_shed")]
+    out = obs.join_spans(router, replicas)
+    assert out["complete"] is True
+    assert out["matched"] == out["replied"] == 2
+    assert out["orphans"] == [] and out["duplicates"] == []
+
+
+def test_join_spans_flags_orphans_and_duplicates():
+    router = [
+        _router_span("r1-1"),
+        _router_span("r1-2"),
+        _router_span("r1-3", replied=False),  # 503: exempt from the join
+    ]
+    replicas = [_replica_span("r1-1"), _replica_span("r1-1")]
+    out = obs.join_spans(router, replicas)
+    assert out["complete"] is False
+    assert out["duplicates"] == ["r1-1"]
+    assert out["orphans"] == ["r1-2"]
+    assert out["router_spans"] == 3 and out["replied"] == 2
+
+
+def test_join_cli_mode(tmp_path):
+    """check_trace.py --join validates files then requires a 100% join."""
+    from scripts import check_trace
+
+    router_path = str(tmp_path / "router.jsonl")
+    replica_path = str(tmp_path / "replica.jsonl")
+    rt = Tracer(sinks=[JsonlSink(router_path)])
+    for ev in (_router_span("a"), _router_span("b", replied=False)):
+        ev = dict(ev)
+        rt(ev.pop("stage"), **ev)
+    rt.close()
+    rep = Tracer(sinks=[JsonlSink(replica_path)])
+    rep(
+        "request_span",
+        request_id="a",
+        route="/predict",
+        status=200,
+        rows=1,
+        bucket=1,
+        coalesced=1,
+        generation=1,
+        parse_s=0.0,
+        queue_s=0.0,
+        assemble_s=0.0,
+        predict_s=0.0,
+        respond_s=0.0,
+    )
+    rep.close()
+    assert check_trace.join_fleet(router_path, [replica_path]) == 0
+    # Drop the replica file's span: the replied router span goes orphan.
+    empty = str(tmp_path / "empty.jsonl")
+    et = Tracer(sinks=[JsonlSink(empty)])
+    et("noop")
+    et.close()
+    assert check_trace.join_fleet(router_path, [empty]) == 1
