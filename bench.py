@@ -61,6 +61,18 @@ latency of the requests that were served under fault, and the per-site
 fault counts — then a WAL recovery microbench (journal a 5k-row stream,
 abandon the server crash-style, time a fresh server's replay-to-serving
 wall). One JSON line.
+
+``bench.py maintain [--quick] [--full]`` runs the incremental-maintenance
+leg (README "Incremental maintenance"): device-bootstrapped
+``HierarchyMaintainer`` at 10k/30k (``--full`` adds 100k), a sustained
+drifting-insert window through insert + cadence splices — per-point
+maintenance wall p50/p99 per size (the flat-vs-n acceptance), ARI of the
+maintained labels vs a from-scratch device build over the same grown
+rows, the WAL rebuild digest check, and a served-ingest leg proving zero
+background re-fits while the maintainer absorbs the stream. One JSON
+line; headline p99 at the largest size, with
+``maintain_ari_vs_scratch`` lifted into its own headline series by
+``scripts/bench_compare.py``.
 """
 
 from __future__ import annotations
@@ -560,6 +572,220 @@ def _chaos(argv: list[str]) -> None:
     )
 
 
+def _maintain(argv: list[str]) -> None:
+    """The incremental-maintenance leg (README "Incremental maintenance"):
+    bootstrap a ``HierarchyMaintainer`` from the device artifacts (tiled
+    k-NN + Borůvka MST + rpforest planes) at several n, push a sustained
+    drifting-insert window through insert + cadence splices, and report
+    the per-point maintenance wall p50/p99 per size (flat-vs-n is the
+    acceptance), the splice/finalize walls, ARI of the maintained flat
+    labels vs a from-scratch device build over the SAME grown rows, the
+    WAL rebuild digest check, and a served-ingest leg proving zero
+    background re-fits while the maintainer absorbs the stream. One JSON
+    line; headline = per-point p99 (splice cost attributed to the insert
+    that triggered it) at the largest size.
+    ``bench.py maintain [--quick] [--full]``
+    """
+    import jax
+
+    from hdbscan_tpu.config import HDBSCANParams
+    from hdbscan_tpu.incremental import HierarchyMaintainer, finalize_from_mst
+    from hdbscan_tpu.models import exact
+    from hdbscan_tpu.ops import rpforest, tiled
+    from hdbscan_tpu.serve.server import ClusterServer
+    from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+    from hdbscan_tpu.utils.telemetry import latency_percentiles
+
+    refresh_every = 64
+    sizes, window = [10_000, 30_000], 1024  # window % refresh_every == 0
+    if "--quick" in argv:
+        argv.remove("--quick")
+        sizes, window = [5_000], 320
+    if "--full" in argv:
+        argv.remove("--full")
+        sizes = sizes + [100_000]
+    if argv:
+        raise SystemExit(f"bench.py maintain: unknown arguments {argv!r}")
+
+    min_pts = 8
+    params = HDBSCANParams(min_points=min_pts, min_cluster_size=50)
+    centers = np.asarray(
+        [(0.0, 0.0, 0.0), (6.0, 6.0, 6.0), (0.0, 8.0, 0.0)]
+    )
+    by_n: dict[str, dict] = {}
+    ari_val = None
+    recovery_bitwise = None
+    headline_p99_ms = 0.0
+    for n in sizes:
+        rng = np.random.default_rng(n)
+        base = centers[np.arange(n) % 3] + rng.normal(0, 0.25, (n, 3))
+        t0 = time.monotonic()
+        core, knn_d, knn_i = tiled.knn_core_distances(
+            base, min_pts, return_indices=True
+        )
+        u, v, _ = exact.mst_edges_from_core(base, core)
+        rpf = rpforest.build_forest(base, trees=4, leaf_size=1024, seed=0)
+        boot_wall = time.monotonic() - t0
+        m = HierarchyMaintainer(
+            base, min_pts=min_pts, knn_d=knn_d, knn_i=knn_i, core=core,
+            mst=(u, v), rpf=rpf, refresh_every=refresh_every,
+        )
+        # Drifting novel stream: a cluster born off-manifold marching away,
+        # so every row is genuinely novel and the dirty subtree moves.
+        rows = (
+            np.asarray((12.0, -6.0, 5.0))
+            + np.arange(window)[:, None] * np.asarray((0.004, 0.003, -0.002))
+            + rng.normal(0, 0.2, (window, 3))
+        )
+        insert_ms, splice_ms, point_ms = [], [], []
+        for row in rows:
+            out = m.insert(row)
+            cost = out["wall_ms"]
+            insert_ms.append(out["wall_ms"])
+            if m._since_splice >= m.refresh_every:
+                sp = m.splice()["wall_s"] * 1e3
+                splice_ms.append(sp)
+                cost += sp
+            point_ms.append(cost)
+        t0 = time.monotonic()
+        got = finalize_from_mst(
+            m.n, *m.mst_arrays(), m.core[: m.n], params
+        )
+        fin_wall = time.monotonic() - t0
+        ins = latency_percentiles([t / 1e3 for t in insert_ms])
+        spl = latency_percentiles([t / 1e3 for t in splice_ms])
+        pnt = latency_percentiles([t / 1e3 for t in point_ms])
+        headline_p99_ms = pnt["p99_s"] * 1e3
+        by_n[str(n)] = {
+            "point_p50_ms": round(pnt["p50_s"] * 1e3, 3),
+            "point_p99_ms": round(pnt["p99_s"] * 1e3, 3),
+            "insert_p50_ms": round(ins["p50_s"] * 1e3, 3),
+            "insert_p99_ms": round(ins["p99_s"] * 1e3, 3),
+            "splice_p50_ms": round(spl["p50_s"] * 1e3, 3),
+            "splice_p99_ms": round(spl["p99_s"] * 1e3, 3),
+            "bootstrap_s": round(boot_wall, 3),
+            "finalize_s": round(fin_wall, 3),
+        }
+        print(
+            f"[bench] maintain n={n}: point p50="
+            f"{by_n[str(n)]['point_p50_ms']}ms "
+            f"p99={by_n[str(n)]['point_p99_ms']}ms "
+            f"(insert p99={by_n[str(n)]['insert_p99_ms']}ms, "
+            f"splice p99={by_n[str(n)]['splice_p99_ms']}ms) "
+            f"bootstrap={by_n[str(n)]['bootstrap_s']}s "
+            f"finalize={by_n[str(n)]['finalize_s']}s",
+            file=sys.stderr,
+        )
+        if n == sizes[0]:
+            # ARI vs from-scratch: same grown rows through the same device
+            # bootstrap path + shared finalize tail. Gaussian data, so the
+            # maintained tree is compared by labeling, not bitwise (the
+            # bitwise contract lives in tests/unit/test_incremental.py on
+            # lattice data).
+            grown = np.asarray(m.data[: m.n])
+            core2, _ = tiled.knn_core_distances(grown, min_pts)
+            u2, v2, w2 = exact.mst_edges_from_core(grown, core2)
+            lo2 = np.minimum(np.asarray(u2), np.asarray(v2))
+            hi2 = np.maximum(np.asarray(u2), np.asarray(v2))
+            w2 = np.asarray(w2, np.float64)
+            order = np.lexsort((hi2, lo2, w2))
+            ref = finalize_from_mst(
+                m.n, lo2[order], hi2[order], w2[order],
+                np.asarray(core2, np.float64), params,
+            )
+            ari_val = float(
+                adjusted_rand_index(got[1], ref[1], noise_as_singletons=True)
+            )
+            # WAL recovery fold: a fresh maintainer from the same bootstrap
+            # replays the row sequence and must land on the SAME digests.
+            wm = m.state_dict()
+            rec = HierarchyMaintainer(
+                base, min_pts=min_pts, knn_d=knn_d, knn_i=knn_i, core=core,
+                mst=(u, v), rpf=rpf, refresh_every=refresh_every,
+            )
+            rec.rebuild(rows, verify_at=(wm["inserts"], wm))
+            recovery_bitwise = rec.state_dict() == wm
+            print(
+                f"[bench] maintain: ARI-vs-scratch={ari_val:.4f} at n={n} "
+                f"(+{window} drifted inserts), recovery bitwise="
+                f"{recovery_bitwise}",
+                file=sys.stderr,
+            )
+
+    # --- served-ingest leg: maintainer absorbs the stream, ZERO re-fits ---
+    # Budget small enough that every chunk would trigger a background
+    # re-fit without the maintainer; drift threshold stays unreached.
+    _, model, sparams, _, fit_wall, n_train = _synthetic_model()
+    leg_params = sparams.replace(
+        stream_maintain="incremental",
+        maintain_refresh_every=32,
+        stream_refit_budget=64,
+        stream_drift_threshold=50.0,
+    )
+    srv = ClusterServer(
+        model, max_batch=512, port=0, ingest=True, params=leg_params
+    )
+    try:
+        nrng = np.random.default_rng(11)
+        served_rows = 0
+        t0 = time.monotonic()
+        for i in range(24):
+            pts = (
+                np.asarray((12.0, -6.0, 5.0))
+                + 0.02 * i
+                + nrng.normal(0, 0.2, (16, 3))
+            )
+            served_rows += srv.ingest(pts)["rows"]
+        serve_wall = time.monotonic() - t0
+        health = srv.health()
+        mstats = health["stream"]["maintain"]
+        refits = srv.refitter.refits_ok + srv.refitter.refits_failed
+        refresh_compiles = (srv._handle.warmup_info or {}).get("jit_compiles")
+        generation = health["generation"]
+    finally:
+        srv.close()
+    print(
+        f"[bench] maintain serve: {served_rows} novel rows in "
+        f"{serve_wall:.2f}s ({served_rows / max(serve_wall, 1e-9):.0f} "
+        f"rows/s), refreshes={mstats['refreshes']} "
+        f"fallbacks={mstats['fallbacks']} refits={refits} "
+        f"generation={generation} refresh_jit_compiles={refresh_compiles}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "stream_maintain_p99_ms_synthetic",
+                "value": round(headline_p99_ms, 3),
+                "unit": "ms",
+                "maintain_sizes": sizes,
+                "maintain_window": window,
+                "maintain_refresh_every": refresh_every,
+                "maintain_by_n": by_n,
+                "maintain_ari_vs_scratch": (
+                    round(ari_val, 4) if ari_val is not None else None
+                ),
+                "maintain_ari_n": sizes[0],
+                "maintain_recovery_bitwise": recovery_bitwise,
+                "serve_maintain_rows": int(served_rows),
+                "serve_maintain_rows_per_s": round(
+                    served_rows / max(serve_wall, 1e-9), 1
+                ),
+                "serve_maintain_inserts": int(mstats.get("inserts", 0)),
+                "serve_maintain_refreshes": int(mstats["refreshes"]),
+                "serve_maintain_fallbacks": int(mstats["fallbacks"]),
+                "serve_maintain_refits": int(refits),
+                "serve_maintain_generation": int(generation),
+                "serve_maintain_refresh_jit_compiles": refresh_compiles,
+                "n_train": n_train,
+                "fit_wall_s": round(fit_wall, 3),
+                "platform": jax.devices()[0].platform,
+                "cpu_smoke": jax.devices()[0].platform != "tpu",
+            }
+        )
+    )
+
+
 def main(argv: list[str] | None = None) -> None:
     import jax
 
@@ -577,6 +803,9 @@ def main(argv: list[str] | None = None) -> None:
         return
     if argv and argv[0] == "chaos":
         _chaos(argv[1:])
+        return
+    if argv and argv[0] == "maintain":
+        _maintain(argv[1:])
         return
     if "--stream-synthetic" in argv:
         argv.remove("--stream-synthetic")

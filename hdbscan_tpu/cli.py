@@ -88,6 +88,8 @@ invocation still means ``fit`` (the reference-compatible form above)::
         [refit_budget=N] [stream_reload={auto,manual}] [trace_max_events=N] \
         [queue_bound=N] [deadline_ms=F] [faults=SPEC] [circuit_failures=N] \
         [circuit_reset=F] [wal_dir=DIR] [snapshot_every=N] \
+        [maintain={off,incremental}] [maintain_budget=F] \
+        [maintain_dirty_frac=F] [maintain_refresh=N] \
         [tenant_lru=N] [tenant_quota=F]
     python -m hdbscan_tpu fleet --model MODEL.npz [--host H] [--port P] \
         [--model-dir DIR] [--tenants-dir DIR] [--ingest] [--wal-root DIR] \
@@ -138,6 +140,17 @@ write-ahead log (snapshotted every ``snapshot_every`` appends) and
 replayed bit-identically on restart. ``faults=SPEC`` (or the
 ``HDBSCAN_TPU_FAULTS`` env var) installs the deterministic fault-injection
 harness — see ``hdbscan_tpu/fault/inject.py`` for the spec grammar.
+
+``maintain=incremental`` (README "Incremental maintenance") absorbs novel
+rows ONLINE instead of waiting for a re-fit: each buffered point updates a
+maintained mutual-reachability MST (``hdbscan_tpu/incremental``), and
+every ``maintain_refresh`` inserts the hierarchy re-finalizes and the
+served model hot-refreshes blue/green (no full fit, no AOT re-warm).
+``maintain_budget=F`` counts per-insert wall overruns (ms, 0 = unbounded),
+``maintain_dirty_frac=F`` caps the splice/finalize dirty share before the
+maintainer demotes to the circuit-gated re-fit ladder. With ``wal_dir``
+the snapshot carries a maintenance watermark that recovery re-verifies
+bitwise.
 
 Fleet (README "Fleet"): ``fleet`` spawns ``fleet_replicas`` independent
 ``serve`` subprocesses sharing the same ``--model`` (and ``--model-dir``

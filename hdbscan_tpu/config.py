@@ -322,6 +322,29 @@ class HDBSCANParams:
     #: Ingest WAL appends between state snapshots (each snapshot truncates
     #: the WAL, bounding recovery replay).
     stream_snapshot_every: int = 64
+    #: Online hierarchy maintenance (``hdbscan_tpu/incremental``): "off"
+    #: (default) keeps the PR-8 behavior — novel rows buffer until a full
+    #: background re-fit; "incremental" maintains the mutual-reachability
+    #: MST in place per novel point (bounded rp-forest candidate query,
+    #: cuSLINK-style cycle-edge replacement) and republishes the model via
+    #: a cheap handle refresh, demoting the full re-fit to the
+    #: circuit-gated fallback. Euclidean metric only.
+    stream_maintain: str = "off"
+    #: Per-point maintenance wall budget in milliseconds; an insert over
+    #: budget is *counted* (``hdbscan_tpu_maintain_total{outcome=
+    #: "over_budget"}``) but never changes state, so WAL replay stays a
+    #: deterministic fold. 0 = unbounded.
+    maintain_budget_ms: float = 0.0
+    #: Dirty-work ceiling for one maintenance step, as the fraction of MST
+    #: edges (and merge-forest nodes) the splice/finalize would have to
+    #: reprocess. Above it the step raises ``MaintainFallback`` and the
+    #: server falls back to the full re-fit. 1.0 = never refuse.
+    maintain_dirty_max_frac: float = 1.0
+    #: Inserts between maintained-model refreshes: the MST splice, the
+    #: dirty-subtree finalize, and the blue/green handle refresh run every
+    #: this many absorbed novel points (per-insert work stays O(candidates)
+    #: regardless).
+    maintain_refresh_every: int = 64
     #: Replica subprocesses behind the ``fleet`` CLI router
     #: (``hdbscan_tpu/fleet``): each is a full ``serve`` process sharing the
     #: model artifact / ``--model-dir``; the router spawns, health-checks,
@@ -491,6 +514,26 @@ class HDBSCANParams:
                 "stream_snapshot_every must be >= 1, "
                 f"got {self.stream_snapshot_every!r}"
             )
+        if self.stream_maintain not in ("off", "incremental"):
+            raise ValueError(
+                "stream_maintain must be 'off' or 'incremental', "
+                f"got {self.stream_maintain!r}"
+            )
+        if self.maintain_budget_ms < 0:
+            raise ValueError(
+                "maintain_budget_ms must be >= 0 (0 = unbounded), "
+                f"got {self.maintain_budget_ms!r}"
+            )
+        if not (0.0 < self.maintain_dirty_max_frac <= 1.0):
+            raise ValueError(
+                "maintain_dirty_max_frac must be in (0, 1], "
+                f"got {self.maintain_dirty_max_frac!r}"
+            )
+        if self.maintain_refresh_every < 1:
+            raise ValueError(
+                "maintain_refresh_every must be >= 1, "
+                f"got {self.maintain_refresh_every!r}"
+            )
         if self.fleet_replicas < 1:
             raise ValueError(
                 f"fleet_replicas must be >= 1, got {self.fleet_replicas!r}"
@@ -632,6 +675,10 @@ FLAG_FIELDS = {
     "circuit_reset": ("circuit_reset_s", float),
     "wal_dir": ("stream_wal_dir", str),
     "snapshot_every": ("stream_snapshot_every", int),
+    "maintain": ("stream_maintain", str),
+    "maintain_budget": ("maintain_budget_ms", float),
+    "maintain_dirty_frac": ("maintain_dirty_max_frac", float),
+    "maintain_refresh": ("maintain_refresh_every", int),
     "fleet_replicas": ("fleet_replicas", int),
     "fleet_policy": ("fleet_policy", str),
     "fleet_health_interval": ("fleet_health_interval_s", float),

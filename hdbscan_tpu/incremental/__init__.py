@@ -1,0 +1,35 @@
+"""Online hierarchy maintenance (ROADMAP 3): absorb the stream without
+re-fitting.
+
+Layered between ``stream/`` (which decides *what* is novel) and
+``serve/`` (which publishes models): :mod:`~hdbscan_tpu.incremental.insert`
+maintains the mutual-reachability MST under bounded per-point updates,
+:mod:`~hdbscan_tpu.incremental.subtree` re-finalizes the hierarchy with
+dirty-subtree reuse. The server drives both when
+``stream_maintain="incremental"``; a :class:`MaintainFallback` demotes
+the stream to the existing circuit-gated full re-fit.
+"""
+
+from hdbscan_tpu.incremental.insert import (
+    HierarchyMaintainer,
+    MaintainFallback,
+    f32_distances,
+    host_knn_rows,
+    host_mst,
+)
+from hdbscan_tpu.incremental.subtree import (
+    DirtySubtreeFinalizer,
+    ResumableForestBuilder,
+    finalize_from_mst,
+)
+
+__all__ = [
+    "HierarchyMaintainer",
+    "MaintainFallback",
+    "DirtySubtreeFinalizer",
+    "ResumableForestBuilder",
+    "finalize_from_mst",
+    "f32_distances",
+    "host_knn_rows",
+    "host_mst",
+]

@@ -590,6 +590,37 @@ def route_queries(queries, normals, thresholds, depth: int):
     return node
 
 
+def leaf_members_np(rpf, x) -> np.ndarray:
+    """Candidate member ids for ONE point: numpy mirror of
+    :func:`route_queries` over every tree, returning the union of the T
+    visited leaves' members (sorted unique int64).
+
+    ``rpf`` is either a built :class:`RPForest` or the ``serve/artifact``
+    packed dict (same field names) — the incremental maintenance layer
+    (``hdbscan_tpu/incremental``) routes against *stored* planes from a
+    model artifact and must stay jax-free, hence the scalar numpy walk:
+    one dot + compare per level per tree, O(trees · depth · d) per point.
+    """
+    get = (lambda k: getattr(rpf, k)) if isinstance(rpf, RPForest) else rpf.__getitem__
+    normals = np.asarray(get("normals"))
+    thresholds = np.asarray(get("thresholds"))
+    members = get("members")
+    leaf_mask = get("leaf_mask")
+    depth = int(get("depth"))
+    x32 = np.asarray(x, normals.dtype).reshape(-1)
+    parts = []
+    for t in range(int(get("trees"))):
+        node = 0
+        for level in range(depth):
+            heap = _heap_base(level) + node
+            proj = normals[t, heap] @ x32
+            node = node * 2 + int(proj >= thresholds[t, heap])
+        parts.append(members[t, node][leaf_mask[node]].astype(np.int64))
+    if not parts:
+        return np.zeros(0, np.int64)
+    return np.unique(np.concatenate(parts))
+
+
 # ---------------------------------------------------------------------------
 # Core-distance entry points (the ``ops.tiled`` return contracts).
 

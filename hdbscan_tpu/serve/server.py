@@ -104,6 +104,12 @@ _PIN_RETRIES = 8
 #: per-process request_span id uniqueness).
 _REQUEST_IDS = itertools.count(1)
 
+#: Inert-row sentinel for maintained models: padded rows sit at this
+#: coordinate with this core distance, so they can never be a query's
+#: nearest neighbor and never attach. Fits float32 comfortably (squared
+#: distances stay below f32 max), which the predict kernels rely on.
+_INERT_FILL = 1e18
+
 
 class _ModelHandle:
     """One served model generation: artifact + warmed predictor + batcher.
@@ -568,6 +574,7 @@ class ClusterServer:
         # directory (if it belongs to this model's digest), then keep
         # journaling every accepted ingest batch.
         self.journal = None
+        wal_info = None
         if self._wal_dir:
             self.journal = StreamJournal(
                 self._wal_dir,
@@ -575,11 +582,26 @@ class ClusterServer:
                 tracer=self.tracer,
                 metrics=self.metrics,
             )
-            self.journal.open(
+            wal_info = self.journal.open(
                 str(self.model.fingerprint.get("data") or ""),
                 self.buffer,
                 self.drift,
             )
+        # Incremental hierarchy maintenance (``stream_maintain=incremental``):
+        # novel rows fold into an online MST + dirty-subtree finalize instead
+        # of waiting for a full re-fit; the re-fit path demotes to the
+        # fallback ladder (drift / maintainer failure / circuit breaker).
+        self.maintain_mode = str(knob("stream_maintain", "off"))
+        self._maintain_budget_ms = float(knob("maintain_budget_ms", 0.0))
+        self._maintain_dirty_frac = float(knob("maintain_dirty_max_frac", 1.0))
+        self._maintain_refresh = int(knob("maintain_refresh_every", 64))
+        self.maintainer = None
+        self._finalizer = None
+        self.maintain_refreshes = 0
+        self.maintain_fallbacks = 0
+        self.maintain_last_error: str | None = None
+        if self.maintain_mode == "incremental":
+            self._init_maintainer(wal_info)
 
     def _refit_params(self, params):
         """Re-fit params: caller's knobs where given, but the fingerprint
@@ -588,6 +610,202 @@ class ClusterServer:
 
         base = params if params is not None else HDBSCANParams()
         return base.replace(**dict(self.model.params))
+
+    # -- incremental maintenance -------------------------------------------
+
+    def _init_maintainer(self, wal_info=None) -> None:
+        """Bootstrap the online hierarchy maintainer from the served model
+        (O(n² d) host k-NN + Prim: artifacts store no MST — documented
+        residual of ROADMAP item 3), then replay any WAL-recovered novel
+        rows through the deterministic maintenance fold and verify the
+        persisted watermark digests. Any failure demotes to the re-fit
+        ladder instead of raising into server construction."""
+        from hdbscan_tpu.incremental import (
+            DirtySubtreeFinalizer,
+            HierarchyMaintainer,
+            MaintainFallback,
+        )
+
+        model = self._handle.model
+        try:
+            self.maintainer = HierarchyMaintainer(
+                model.data,
+                min_pts=int(model.params.get("min_points", 2)),
+                metric=str(model.params.get("dist_function", "euclidean")),
+                rpf=model.rpf,
+                budget_ms=self._maintain_budget_ms,
+                dirty_max_frac=self._maintain_dirty_frac,
+                refresh_every=self._maintain_refresh,
+                tracer=self.tracer,
+                metrics=self.metrics,
+                name=self._server_id,
+            )
+            self._finalizer = DirtySubtreeFinalizer(
+                self._refit_params(self._params),
+                dirty_max_frac=self._maintain_dirty_frac,
+                tracer=self.tracer,
+                name=self._server_id,
+            )
+        except Exception as exc:
+            self._maintain_disable(f"bootstrap: {type(exc).__name__}: {exc}")
+            return
+        # WAL recovery: maintainer state is never journaled as events — it
+        # is a deterministic fold over the buffer's novel chunks, which
+        # ``journal.open()`` above just replayed (stream/wal.py docstring).
+        # Re-run the fold; the snapshot's "maintain" watermark (journal +
+        # MST sha256) must reproduce bitwise at the recorded insert count,
+        # else the maintainer stands down rather than serve a silently
+        # diverged hierarchy.
+        watermark = (wal_info or {}).get("maintain") or None
+        verify = None
+        if watermark and int(watermark.get("inserts", 0)) > 0:
+            verify = (int(watermark["inserts"]), watermark)
+        try:
+            for chunk in self.buffer.novel_chunks():
+                self.maintainer.rebuild(chunk, verify_at=verify)
+        except (MaintainFallback, Exception) as exc:
+            self._maintain_disable(f"recovery: {type(exc).__name__}: {exc}")
+
+    def _maintain_disable(self, error) -> None:
+        """Demote the stream to the re-fit ladder: drop the maintainer (the
+        ``budget`` trigger un-suppresses on the next ingest), record and
+        trace the demotion. Caller decides whether to kick a re-fit."""
+        m, self.maintainer, self._finalizer = self.maintainer, None, None
+        self.maintain_fallbacks += 1
+        self.maintain_last_error = str(error)
+        if m is not None:
+            m._count("fallback")
+        if self.tracer is not None:
+            self.tracer(
+                "maintain_fallback",
+                maintainer=self._server_id,
+                generation=int(self._handle.generation),
+                n=int(m.n) if m is not None else 0,
+                inserts=int(m.inserts) if m is not None else 0,
+                error=str(error),
+            )
+
+    def _maintain_batch(self, chunk_start: int):
+        """Fold this batch's novel rows into the maintainer (caller holds
+        ``_ingest_lock``). Per row: bounded insert; when the splice cadence
+        fires, MST splice + dirty-subtree finalize + maintained-model build
+        — but NOT the handle swap, which needs ``_swap_lock`` and is done
+        by the caller after releasing the ingest lock (lock order:
+        ``swap_model`` takes swap → ingest, so never the reverse).
+
+        The per-row cadence check mirrors ``HierarchyMaintainer.rebuild``
+        exactly — live fold and WAL recovery fold are the same function of
+        the novel-row sequence, which is what makes the snapshot watermark
+        verifiable bitwise.
+
+        Returns ``(stats_dict, maintained_model_or_None)``; a failure
+        demotes via :meth:`_maintain_disable` and reports ``fallback`` in
+        the stats — ingest itself never fails on maintenance."""
+        from hdbscan_tpu.incremental import MaintainFallback
+
+        m = self.maintainer
+        inserted = spliced = 0
+        over_budget = False
+        new_model = None
+        try:
+            for idx in range(chunk_start, self.buffer.novel_chunk_count):
+                for row in self.buffer.novel_chunk(idx):
+                    info = m.insert(row)
+                    inserted += 1
+                    over_budget = over_budget or info["over_budget"]
+                    if m._since_splice >= m.refresh_every:
+                        m.splice()
+                        spliced += 1
+            if spliced:
+                with obs.task("stream_maintain", total=1) as t:
+                    lo, hi, w = m.mst_arrays()
+                    tree, labels, _scores, _inf = self._finalizer.finalize(
+                        m.n, lo, hi, w, m.core[: m.n]
+                    )
+                    new_model = self._maintained_model(tree, labels)
+                    t.beat(1)
+        except (MaintainFallback, Exception) as exc:
+            self._maintain_disable(f"{type(exc).__name__}: {exc}")
+            return (
+                {"inserted": inserted, "spliced": spliced, "fallback": True},
+                None,
+            )
+        return (
+            {
+                "inserted": inserted,
+                "spliced": spliced,
+                "over_budget": over_budget,
+                "fallback": False,
+            },
+            new_model,
+        )
+
+    def _maintained_model(self, tree, labels):
+        """Serving artifact for the maintained hierarchy, shape-padded.
+
+        Rows pad to the maintainer's power-of-two capacity with inert
+        sentinels (coordinates and core at 1e18 — never the nearest
+        neighbor, never attach) so the predictor's train-side shapes stay
+        CONSTANT across maintenance refreshes: the module-level jit cache
+        hits and the handle rebuild costs no AOT re-warm until the
+        capacity actually doubles. ``rpf=None``: the stored planes only
+        index the bootstrap rows, so the padded model serves through the
+        exhaustive backend (plane refresh is a ROADMAP 3 residual)."""
+        from hdbscan_tpu.models._finalize import serving_tables
+        from hdbscan_tpu.utils.checkpoint import _data_digest
+
+        m = self.maintainer
+        base = self._handle.model
+        n, cap = m.n, m._cap
+        labels = np.asarray(labels, np.int64)
+        tables = serving_tables(tree, labels)
+        data = np.full((cap, m.dims), _INERT_FILL, np.float64)
+        data[:n] = m.data[:n]
+        core = np.full(cap, _INERT_FILL, np.float64)
+        core[:n] = m.core[:n]
+        lab = np.zeros(cap, np.int64)
+        lab[:n] = labels
+        last = np.zeros(cap, np.int64)
+        last[:n] = np.asarray(tree.point_last_cluster, np.int64)
+        fingerprint = dict(base.fingerprint)
+        fingerprint["n"] = int(cap)
+        fingerprint["data"] = _data_digest(data)
+        return ClusterModel(
+            mode=base.mode,
+            params=dict(base.params),
+            fingerprint=fingerprint,
+            data=data,
+            core=core,
+            labels=lab,
+            last_cluster=last,
+            parent=np.asarray(tree.parent, np.int64),
+            birth=np.asarray(tree.birth, np.float64),
+            selected=np.asarray(tree.selected, bool),
+            sel_anc=np.asarray(tables["sel_anc"], np.int64),
+            eps_min=np.asarray(tables["eps_min"], np.float64),
+            eps_max=np.asarray(tables["eps_max"], np.float64),
+            rpf=None,
+        )
+
+    def maintain_stats(self) -> dict:
+        """Maintenance block of ``/healthz``'s stream dict."""
+        out = {
+            "mode": self.maintain_mode,
+            "active": self.maintainer is not None,
+            "refreshes": int(self.maintain_refreshes),
+            "fallbacks": int(self.maintain_fallbacks),
+            "last_error": self.maintain_last_error,
+        }
+        if self.maintainer is not None:
+            m = self.maintainer
+            out.update(
+                n=int(m.n),
+                inserts=int(m.inserts),
+                splices=int(m.splices),
+                pending_edges=int(m.pending_edges),
+                over_budget=int(m.over_budget),
+            )
+        return out
 
     # -- handles -----------------------------------------------------------
 
@@ -604,6 +822,53 @@ class ClusterServer:
             predictor, linger_s=self._linger_s, max_queue=self._queue_bound
         )
         return _ModelHandle(model, predictor, batcher, generation, warmup_info)
+
+    def _install_handle(self, new_model, reason: str) -> tuple:
+        """Blue/green core shared by :meth:`swap_model` and the maintained
+        handle refresh: build + warm the new handle on the old model's
+        watch, swap under ``_swap_lock`` (one reference assignment),
+        account the swap, emit the ``model_swap`` trace, drain-close the
+        old batcher. Returns ``(new_handle, pause_s)``."""
+        new_handle = self._build_handle(new_model, generation=0)  # warm first
+        with self._swap_lock:
+            if self._closed:
+                raise RuntimeError("server is closed")
+            old = self._handle
+            new_handle.generation = old.generation + 1
+            t0 = time.perf_counter()
+            self._handle = new_handle  # the swap: one reference assignment
+            pause_s = time.perf_counter() - t0
+            self._swap_count += 1
+        self._m_swaps.inc()
+        self._m_generation.set(float(new_handle.generation))
+        if self.tracer is not None:
+            self.tracer(
+                "model_swap",
+                generation=int(new_handle.generation),
+                digest=str(new_handle.digest),
+                n_train=int(new_model.n_train),
+                reason=str(reason),
+                server=self._server_id,
+                pause_s=round(pause_s, 9),
+                wall_s=round(pause_s, 9),
+            )
+        old.batcher.close()  # graceful: every in-flight future completes
+        return new_handle, pause_s
+
+    def _publish_maintained(self, new_model) -> None:
+        """Handle refresh for a maintained model — NOT a swap: buffer,
+        drift sketches and journal keep their state, because the WAL
+        replay base is the bootstrap model plus the grow-only novel-chunk
+        log. Called with no locks held; the padded shapes make the
+        predictor rebuild hit the warm jit cache (no AOT re-warm)."""
+        try:
+            self._install_handle(new_model, reason="maintain")
+        except Exception as exc:
+            self._maintain_disable(f"publish: {type(exc).__name__}: {exc}")
+        else:
+            self.maintain_refreshes += 1
+            if self.maintainer is not None:
+                self.maintainer._count("refresh")
 
     @property
     def model(self):
@@ -820,23 +1085,49 @@ class ClusterServer:
             # current handle rather than polluting the fresh sketches.
         if not scored:
             raise RuntimeError("ingest retries exhausted during model swaps")
+        maintained = None
+        new_model = None
         with self._ingest_lock:
+            chunk_start = (
+                self.buffer.novel_chunk_count
+                if self.maintainer is not None else 0
+            )
             absorbed, buffered = self.buffer.absorb(points, labels, prob)
             self.drift.update(labels, score)
+            if self.maintainer is not None:
+                maintained, new_model = self._maintain_batch(chunk_start)
             if self.journal is not None:
                 # Write-ahead relative to the HTTP ack: the batch (with its
                 # predicted labels/prob/scores, so replay never re-predicts)
-                # is fsync'd before the 200 goes out.
+                # is fsync'd before the 200 goes out. The maintain watermark
+                # captures the state AFTER this batch's fold, so recovery
+                # verifies its replay at exactly this insert count.
                 self.journal.append_ingest(points, labels, prob, score)
-                self.journal.maybe_snapshot(self.buffer, self.drift)
+                self.journal.maybe_snapshot(
+                    self.buffer,
+                    self.drift,
+                    maintain=(
+                        self.maintainer.state_dict()
+                        if self.maintainer is not None else None
+                    ),
+                )
             check = self.drift.check(generation=handle.generation)
             self._m_drift_checks.inc()
             if check["drifted"]:
                 self._m_drift_flags.inc()
             trigger = None
-            if check["drifted"]:
+            if maintained is not None and maintained["fallback"]:
+                trigger = "maintain_fallback"
+            elif check["drifted"]:
                 trigger = "drift"
-            elif self.buffer.buffered_rows >= self._refit_budget:
+            elif (
+                self.maintainer is None
+                and self.buffer.buffered_rows >= self._refit_budget
+            ):
+                # An active maintainer suppresses the point-budget trigger:
+                # novel rows are already folded into the served hierarchy,
+                # so the full re-fit is reserved for drift and the fallback
+                # ladder (maintain_fallback / circuit breaker).
                 trigger = "budget"
             refit_started = False
             # Circuit gate: after repeated refit/swap failures the breaker
@@ -854,6 +1145,10 @@ class ClusterServer:
                 refit_started = self.refitter.request(pool, trigger)
                 if refit_started:
                     self._m_refit_kicks.inc(trigger=trigger)
+        if new_model is not None:
+            # Outside the ingest lock by necessity: the handle refresh takes
+            # _swap_lock, and swap_model's order is swap → ingest.
+            self._publish_maintained(new_model)
         if self.tracer is not None:
             self.tracer(
                 "stream_ingest",
@@ -863,14 +1158,17 @@ class ClusterServer:
                 generation=int(handle.generation),
                 wall_s=round(time.perf_counter() - t0, 6),
             )
-        return {
+        out = {
             "rows": int(len(points)),
             "absorbed": int(absorbed),
             "buffered": int(buffered),
-            "generation": int(handle.generation),
+            "generation": int(self._handle.generation),
             "drift": check,
             "refit_started": bool(refit_started),
         }
+        if maintained is not None:
+            out["maintained"] = maintained
+        return out
 
     # -- blue/green swap ---------------------------------------------------
 
@@ -930,30 +1228,7 @@ class ClusterServer:
                     f"{new_model.params.get(f)!r} != served "
                     f"{old_model.params.get(f)!r} — refusing to swap"
                 )
-        new_handle = self._build_handle(new_model, generation=0)  # warm first
-        with self._swap_lock:
-            if self._closed:
-                raise RuntimeError("server is closed")
-            old = self._handle
-            new_handle.generation = old.generation + 1
-            t0 = time.perf_counter()
-            self._handle = new_handle  # the swap: one reference assignment
-            pause_s = time.perf_counter() - t0
-            self._swap_count += 1
-        self._m_swaps.inc()
-        self._m_generation.set(float(new_handle.generation))
-        if self.tracer is not None:
-            self.tracer(
-                "model_swap",
-                generation=int(new_handle.generation),
-                digest=str(new_handle.digest),
-                n_train=int(new_model.n_train),
-                reason=str(reason),
-                server=self._server_id,
-                pause_s=round(pause_s, 9),
-                wall_s=round(pause_s, 9),
-            )
-        old.batcher.close()  # graceful: every in-flight future completes
+        new_handle, pause_s = self._install_handle(new_model, reason)
         if self.ingest_enabled:
             with self._ingest_lock:
                 self.buffer.reset(new_model)
@@ -967,6 +1242,14 @@ class ClusterServer:
                     # The old generation's stream state was consumed by the
                     # refit; re-key the journal to the new digest.
                     self.journal.restart(str(new_handle.digest or ""))
+                if self.maintain_mode == "incremental":
+                    # A real swap resets the maintenance fold's base: the
+                    # old maintainer's bootstrap model and novel log were
+                    # consumed by the re-fit. Re-bootstrap over the new fit
+                    # (O(n² d) host pass) under the ingest lock so no batch
+                    # folds into a stale maintainer meanwhile.
+                    self.maintainer = self._finalizer = None
+                    self._init_maintainer()
         info = {
             "ok": True,
             "generation": int(new_handle.generation),
@@ -1043,6 +1326,7 @@ class ClusterServer:
                 "circuit": self._refit_circuit.state_info(),
                 "reload": self.reload_mode,
                 "pending": self.pending,
+                "maintain": self.maintain_stats(),
             }
             if self.journal is not None:
                 out["stream"]["wal"] = self.journal.stats()

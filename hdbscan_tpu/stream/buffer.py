@@ -233,6 +233,33 @@ class IngestBuffer:
     def buffered_rows(self) -> int:
         return self._novel_rows
 
+    def novel_chunks(self) -> list:
+        """The buffered novel rows as the ordered list of per-batch chunks.
+
+        This list only ever GROWS between :meth:`reset` calls (chunks are
+        never drained or reordered), which is what makes it a replayable
+        event log: the incremental maintainer (``hdbscan_tpu/incremental``)
+        treats maintenance as a deterministic fold over exactly this
+        sequence, so WAL recovery re-inserting these chunks in order
+        reproduces the maintained MST bitwise. Returns copies.
+        """
+        with self._lock:
+            return [chunk.copy() for chunk in self._novel]
+
+    @property
+    def novel_chunk_count(self) -> int:
+        """Number of buffered novel chunks (one per :meth:`absorb` call that
+        produced novel rows). Comparing this across an ``absorb`` call is how
+        the server's maintenance fold picks up exactly the rows that call
+        buffered, without copying the whole log (:meth:`novel_chunks`)."""
+        with self._lock:
+            return len(self._novel)
+
+    def novel_chunk(self, index: int) -> np.ndarray:
+        """Copy of one novel chunk by position (see :attr:`novel_chunk_count`)."""
+        with self._lock:
+            return self._novel[index].copy()
+
     @property
     def absorbed_total(self) -> int:
         return self.absorbed_exact + self.absorbed_near

@@ -1,7 +1,10 @@
 """Refitter: background re-fit + schema-versioned artifact publication.
 
 When the server's drift detector flags shift (or the novelty buffer hits
-its point budget), the refitter runs a full fit over the re-fit pool —
+its point budget — or, under ``stream_maintain=incremental``, the online
+maintainer trips its dirty-work contract and demotes with
+``reason="maintain_fallback"``), the refitter runs a full fit over the
+re-fit pool —
 novel buffered rows + the stream reservoir + a sample of original training
 rows — on a daemon worker thread, so serving latency never sees fit wall.
 The result is distilled through the standard

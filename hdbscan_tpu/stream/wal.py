@@ -30,6 +30,27 @@ The journal is keyed to the served model's data digest: a digest mismatch
 on open (new model fitted between runs) or a blue/green swap
 (:meth:`restart`) wipes the journal rather than replaying stale state.
 
+**Reservoir-wrap guarantee.** The buffer's Vitter algorithm-R reservoir
+stays bitwise-recoverable arbitrarily far past capacity (n >> capacity):
+``state_dict`` captures both the monotone ``stream_index`` and the full
+reservoir RNG ``bit_generator`` state, so post-recovery replacement draws
+``j = rng.integers(0, i + 1)`` continue the *same* random sequence at the
+*same* stream positions as the uninterrupted process. No wrap counter or
+epoch is needed — the pair (index, RNG state) is the entire decision
+state of algorithm R. ``tests/unit/test_stream_wal.py`` pins this with a
+reservoir wrapped many times over.
+
+**Incremental-maintenance watermark.** When ``stream_maintain=
+incremental`` the snapshot carries an optional ``maintain`` dict — the
+maintainer's counters plus sha256 digests of its MST edit journal and
+canonical MST arrays (``incremental.HierarchyMaintainer.state_dict``).
+Maintenance is NOT replayed from the WAL directly: it is a deterministic
+fold over the buffer's novel chunks (``IngestBuffer.novel_chunks``),
+which the ordinary buffer recovery already restores bitwise. Recovery
+re-runs the fold and *verifies* it passes through the persisted digests
+at the recorded insert count; a mismatch demotes to the re-fit path
+rather than serving a silently-diverged hierarchy.
+
 Trace schemas (scripts/check_trace.py): ``wal_append`` per record with a
 per-``(process, wal)`` contiguous ``wal_seq``, and ``wal_recover`` once per
 open. Metrics: ``hdbscan_tpu_wal_appends_total`` /
@@ -154,29 +175,32 @@ class StreamJournal:
 
     # -- snapshot ----------------------------------------------------------
 
-    def maybe_snapshot(self, buffer, drift) -> bool:
+    def maybe_snapshot(self, buffer, drift, maintain: dict | None = None) -> bool:
         """Snapshot buffer+drift state if ``snapshot_every`` appends have
         accumulated; truncates the WAL on success. The caller must hold the
         same lock that orders its ``absorb``/``update`` calls (the server's
-        ingest lock) so the state captured matches the WAL watermark."""
+        ingest lock) so the state captured matches the WAL watermark.
+        ``maintain``: optional incremental-maintenance watermark dict
+        (see module docstring) captured under the same lock."""
         with self._lock:
             if self._since_snapshot < self.snapshot_every:
                 return False
-            self._snapshot_locked(buffer, drift)
+            self._snapshot_locked(buffer, drift, maintain)
             return True
 
-    def snapshot(self, buffer, drift) -> None:
+    def snapshot(self, buffer, drift, maintain: dict | None = None) -> None:
         """Unconditional snapshot + WAL truncation (same caller contract)."""
         with self._lock:
-            self._snapshot_locked(buffer, drift)
+            self._snapshot_locked(buffer, drift, maintain)
 
-    def _snapshot_locked(self, buffer, drift) -> None:
+    def _snapshot_locked(self, buffer, drift, maintain: dict | None = None) -> None:
         payload = {
             "schema": SNAPSHOT_SCHEMA,
             "digest": self._digest,
             "watermark": self._seq,
             "buffer": buffer.state_dict(),
             "drift": drift.state_dict() if drift is not None else None,
+            "maintain": maintain,
         }
         fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".tmp")
         try:
@@ -303,6 +327,9 @@ class StreamJournal:
             "stale_discarded": bool(stale),
             "torn_tail_dropped": bool(torn),
             "wall_s": round(wall_s, 6),
+            # Incremental-maintenance watermark (counters + digests) from
+            # the restored snapshot, for the server's replay verification.
+            "maintain": snap.get("maintain") if snapshot_used else None,
         }
         self.last_recover = info
         if self._m_recovered is not None and replayed:

@@ -44,6 +44,8 @@ HEADLINE = {
     "serve_slo_rows_per_s_synthetic_5k": "higher",
     "stream_ingest_rows_per_s_synthetic_5k": "higher",
     "serve_chaos_p99_under_fault_ms_synthetic_5k": "lower",
+    "stream_maintain_p99_ms_synthetic": "lower",
+    "stream_maintain_ari_vs_scratch": "higher",
 }
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
@@ -108,6 +110,10 @@ def load_round(path: str) -> dict:
             rows = rec.get("slo_rows_per_s")
             if isinstance(rows, (int, float)):
                 metrics["serve_slo_rows_per_s_synthetic_5k"] = float(rows)
+        if name == "stream_maintain_p99_ms_synthetic":
+            ari = rec.get("maintain_ari_vs_scratch")
+            if isinstance(ari, (int, float)):
+                metrics["stream_maintain_ari_vs_scratch"] = float(ari)
     m = _ROUND_RE.search(os.path.basename(path))
     return {
         "path": path,

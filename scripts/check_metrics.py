@@ -17,7 +17,9 @@ labelled ``site``, ``hdbscan_tpu_circuit_state`` a gauge whose every
 sample is exactly 0 (closed), 1 (half_open) or 2 (open) with a ``name``
 label, and ``hdbscan_tpu_refit_failures_total`` / the three
 ``hdbscan_tpu_wal_*_total`` families counters with integral non-negative
-values. Required labels are a SUBSET check: a fleet router's aggregated
+values; ``hdbscan_tpu_maintain_total`` (README "Incremental maintenance")
+is a counter labelled ``outcome`` counting maintenance steps by what
+happened to them (insert / splice / refresh / fallback). Required labels are a SUBSET check: a fleet router's aggregated
 scrape (README "Fleet") re-tags every replica-origin series with a
 ``replica`` label, which must not fail validation. Fleet families add
 their own contracts: the routing/health/tenant counters
@@ -250,6 +252,7 @@ _FAULT_COUNTERS = {
     "hdbscan_tpu_requests_shed_total": ("route", "reason"),
     "hdbscan_tpu_faults_injected_total": ("site",),
     "hdbscan_tpu_refit_failures_total": (),
+    "hdbscan_tpu_maintain_total": ("outcome",),
     "hdbscan_tpu_wal_appends_total": (),
     "hdbscan_tpu_wal_snapshots_total": (),
     "hdbscan_tpu_wal_recovered_records_total": (),

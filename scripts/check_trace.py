@@ -48,6 +48,18 @@ and positive ``rows``; ``model_swap`` a positive ``generation`` that
 STRICTLY INCREASES per (process, server) — the blue/green contract that a
 server process never swaps backwards or repeats a generation — plus a
 string ``digest`` and positive ``n_train``.
+Incremental-maintenance events (``hdbscan_tpu/incremental``, README
+"Incremental maintenance") add three schemas: ``mst_splice`` must carry a
+non-empty string ``maintainer``, positive ``n``, non-negative
+``inserts``/``candidates``/``spliced``/``evicted``, a ``dirty_frac`` in
+[0, 1], and edge counts that RECONCILE — ``edges_prev + spliced -
+evicted == edges`` per maintenance step, with ``edges == n - 1`` exactly
+(a splice always leaves one spanning tree); ``subtree_finalize`` a
+non-empty string ``maintainer``, positive ``n``, non-negative
+``nodes_total`` with ``0 <= nodes_dirty <= nodes_total``, ``dirty_frac``
+in [0, 1] and non-negative ``clusters``/``changed_clusters``;
+``maintain_fallback`` a non-empty string ``maintainer``/``error``,
+positive ``generation`` and non-negative ``n``/``inserts``.
 Request spans (``serve/server.py``, README "Observability") add one more
 schema: every ``request_span`` must carry a ``route`` in
 ``{/predict, /ingest}``, a non-empty string ``request_id`` that is UNIQUE
@@ -307,6 +319,12 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
                                 f"server {ev.get('server')!r}"
                             )
                         last_swap_gen[key] = gen
+            # Incremental-maintenance invariants (hdbscan_tpu/incremental):
+            # splice edge-count reconciliation, dirty-subtree bounds, and
+            # the fallback-event schema.
+            if stage in ("mst_splice", "subtree_finalize",
+                         "maintain_fallback"):
+                errors += _check_maintain(path, lineno, stage, ev)
             # Request-span invariants (serve/server.py): per-event schema
             # here; per-process request-id uniqueness needs cross-event
             # state so it lives in this loop.
@@ -568,6 +586,94 @@ def _check_stream(path: str, lineno: int, stage: str, ev: dict) -> list[str]:
             errors.append(f"{where} lacks a string 'digest'")
         if not _pos_int(ev.get("n_train")):
             errors.append(f"{where} n_train={ev.get('n_train')!r} not a positive int")
+    return errors
+
+
+def _check_maintain(path: str, lineno: int, stage: str, ev: dict) -> list[str]:
+    """The three incremental-maintenance event schemas
+    (hdbscan_tpu/incremental, serve/server.py).  The load-bearing check is
+    the ``mst_splice`` edge-count reconciliation: every splice starts from
+    one spanning tree, adds ``spliced`` new edges, evicts ``evicted`` old
+    ones, and must land on one spanning tree again — so
+    ``edges_prev + spliced - evicted == edges`` and ``edges == n - 1``
+    exactly.  A splice that doesn't reconcile means the maintainer lost or
+    duplicated an edge, which the server would only notice as a silently
+    wrong hierarchy."""
+    errors: list[str] = []
+    where = f"{path}:{lineno}: {stage}"
+    if not isinstance(ev.get("maintainer"), str) or not ev.get("maintainer"):
+        errors.append(f"{where} lacks a non-empty string 'maintainer'")
+    if stage == "mst_splice":
+        if not _pos_int(ev.get("n")):
+            errors.append(f"{where} n={ev.get('n')!r} not a positive int")
+        for key in ("inserts", "candidates", "spliced", "evicted",
+                    "edges_prev", "edges"):
+            if not _nonneg_int(ev.get(key)):
+                errors.append(
+                    f"{where} {key}={ev.get(key)!r} not a non-negative int"
+                )
+        frac = ev.get("dirty_frac")
+        if (
+            not isinstance(frac, (int, float))
+            or isinstance(frac, bool)
+            or not math.isfinite(float(frac))
+            or not (0.0 <= float(frac) <= 1.0)
+        ):
+            errors.append(f"{where} dirty_frac={frac!r} not in [0, 1]")
+        if all(
+            _nonneg_int(ev.get(key))
+            for key in ("spliced", "evicted", "edges_prev", "edges")
+        ):
+            if ev["edges_prev"] + ev["spliced"] - ev["evicted"] != ev["edges"]:
+                errors.append(
+                    f"{where} edges_prev={ev['edges_prev']} + "
+                    f"spliced={ev['spliced']} - evicted={ev['evicted']} != "
+                    f"edges={ev['edges']} — splice edge counts must reconcile"
+                )
+            if _pos_int(ev.get("n")) and ev["edges"] != ev["n"] - 1:
+                errors.append(
+                    f"{where} edges={ev['edges']} != n-1={ev['n'] - 1} — a "
+                    f"splice must leave exactly one spanning tree"
+                )
+    elif stage == "subtree_finalize":
+        if not _pos_int(ev.get("n")):
+            errors.append(f"{where} n={ev.get('n')!r} not a positive int")
+        total = ev.get("nodes_total")
+        dirty = ev.get("nodes_dirty")
+        if not _nonneg_int(total):
+            errors.append(f"{where} nodes_total={total!r} not a non-negative int")
+        if not _nonneg_int(dirty):
+            errors.append(f"{where} nodes_dirty={dirty!r} not a non-negative int")
+        elif _nonneg_int(total) and dirty > total:
+            errors.append(
+                f"{where} nodes_dirty={dirty} > nodes_total={total}"
+            )
+        frac = ev.get("dirty_frac")
+        if (
+            not isinstance(frac, (int, float))
+            or isinstance(frac, bool)
+            or not math.isfinite(float(frac))
+            or not (0.0 <= float(frac) <= 1.0)
+        ):
+            errors.append(f"{where} dirty_frac={frac!r} not in [0, 1]")
+        for key in ("clusters", "changed_clusters"):
+            if not _nonneg_int(ev.get(key)):
+                errors.append(
+                    f"{where} {key}={ev.get(key)!r} not a non-negative int"
+                )
+    else:  # maintain_fallback
+        if not isinstance(ev.get("error"), str) or not ev.get("error"):
+            errors.append(f"{where} lacks a non-empty string 'error'")
+        if not _pos_int(ev.get("generation")):
+            errors.append(
+                f"{where} generation={ev.get('generation')!r} not a "
+                f"positive int"
+            )
+        for key in ("n", "inserts"):
+            if not _nonneg_int(ev.get(key)):
+                errors.append(
+                    f"{where} {key}={ev.get(key)!r} not a non-negative int"
+                )
     return errors
 
 

@@ -1,0 +1,320 @@
+"""End-to-end acceptance for incremental hierarchy maintenance
+(README "Incremental maintenance").
+
+Three legs:
+
+- online absorption through the real HTTP server: a drifting stream is
+  absorbed by the maintainer (``stream_maintain=incremental``) behind
+  blue/green handle refreshes — generation advances with
+  ``model_swap reason="maintain"``, a predict at the novel mass attaches
+  non-noise, ZERO re-fits run even though the buffered rows blow through
+  ``stream_refit_budget`` (an active maintainer suppresses the budget
+  trigger), the second refresh at an unchanged capacity re-warms with
+  ``jit_compiles == 0`` (no AOT re-warm), and the trace/metrics artifacts
+  pass scripts/check_trace.py / scripts/check_metrics.py,
+- the fallback ladder: a maintainer tripping its dirty-work contract
+  demotes the stream to the circuit-gated full re-fit
+  (``maintain_fallback`` trace event, re-fit with
+  ``reason="maintain_fallback"``) while the server keeps serving,
+- SIGKILL chaos: a WAL writer folding the maintainer alongside the buffer
+  is killed mid-stream; recovery replays the buffer, re-verifies the
+  snapshot's maintenance watermark digests, finishes the stream, and lands
+  BITWISE on the uninterrupted run's state (maintainer ``state_dict``
+  included — MST sha, edit-journal sha, every counter). The driver is
+  jax-free (exhaustive candidates), which ``incremental/`` guarantees.
+"""
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu import HDBSCANParams
+from hdbscan_tpu.models import hdbscan
+from hdbscan_tpu.serve.server import ClusterServer
+from hdbscan_tpu.utils.tracing import JsonlSink, Tracer
+from scripts import check_metrics, check_trace
+
+CENTERS = np.asarray([(0.0, 0.0, 0.0), (6.0, 6.0, 6.0), (0.0, 8.0, 0.0)])
+NOVEL = np.asarray((12.0, -6.0, 5.0))
+SPREAD = 0.25
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.default_rng(0)
+    params = HDBSCANParams(
+        min_points=8, min_cluster_size=25, processing_units=1024
+    )
+    truth = np.arange(600) % len(CENTERS)
+    train = CENTERS[truth] + rng.normal(0, SPREAD, (600, 3))
+    model = hdbscan.fit(train, params).to_cluster_model(train, params)
+    return model, params
+
+
+def _post(base, path, obj, timeout=60):
+    req = urllib.request.Request(
+        base + path, json.dumps(obj).encode(),
+        {"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get(base, path, timeout=30):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def test_maintain_absorbs_stream_online(fitted, tmp_path):
+    model, params0 = fitted
+    params = dataclasses.replace(
+        params0,
+        stream_maintain="incremental",
+        maintain_refresh_every=16,
+        stream_refit_budget=32,  # tiny: only suppression keeps refits at 0
+        stream_drift_threshold=50.0,
+    )
+    trace_path = tmp_path / "trace.jsonl"
+    tracer = Tracer(sinks=[JsonlSink(str(trace_path))])
+    srv = ClusterServer(
+        model, max_batch=64, port=0, tracer=tracer, ingest=True,
+        params=params, model_dir=str(tmp_path / "models"),
+    ).start()
+    base = f"http://{srv.host}:{srv.port}"
+    try:
+        rng = np.random.default_rng(4)
+        for _ in range(10):
+            pts = NOVEL + rng.normal(0, SPREAD, (16, 3))
+            out = _post(base, "/ingest", {"points": pts.tolist()})
+            assert out["rows"] == 16
+        health = json.loads(_get(base, "/healthz"))
+        maintain = health["stream"]["maintain"]
+        assert maintain["mode"] == "incremental" and maintain["active"]
+        assert maintain["refreshes"] >= 2, maintain
+        assert maintain["fallbacks"] == 0 and maintain["inserts"] > 16
+
+        # Blue/green refresh advanced the served generation without a
+        # single re-fit, despite buffered novel rows >> refit budget.
+        assert health["generation"] >= 2
+        assert srv.refitter.refits_ok == 0 == srv.refitter.refits_failed
+        assert srv.buffer.stats()["buffered"] > params.stream_refit_budget
+
+        # The novel mass now attaches as a real cluster.
+        out = _post(base, "/predict", {"points": [NOVEL.tolist()]})
+        assert out["labels"][0] != -1
+        assert out["generation"] == health["generation"]
+
+        # Refresh is swap-cheap: capacity was unchanged after the first
+        # maintained publish, so the newest handle re-warmed on the shared
+        # jit cache with zero fresh compiles.
+        assert srv._handle.model.n_train > model.n_train  # padded capacity
+        assert srv._handle.warmup_info["jit_compiles"] == 0
+
+        scrape = _get(base, "/metrics")
+    finally:
+        srv.close()
+        tracer.close()
+
+    events, errors = check_trace.validate_trace(str(trace_path))
+    assert errors == [], errors
+    stages = {e["stage"] for e in events}
+    assert {"mst_splice", "subtree_finalize", "model_swap"} <= stages
+    assert "model_refit" not in stages
+    swaps = [e for e in events if e["stage"] == "model_swap"]
+    assert all(e["reason"] == "maintain" for e in swaps)
+
+    scrape_path = tmp_path / "scrape.txt"
+    scrape_path.write_text(scrape)
+    parsed, merrors = check_metrics.validate_exposition(scrape, "scrape")
+    assert merrors == [], merrors
+    outcomes = {
+        dict(labels).get("outcome")
+        for (name, labels) in parsed["samples"]
+        if name == "hdbscan_tpu_maintain_total"
+    }
+    assert {"inserted", "spliced", "refresh"} <= outcomes
+
+
+def test_maintain_fallback_demotes_to_refit(fitted, tmp_path):
+    """The fallback ladder: incremental -> circuit-gated re-fit -> pinned
+    generation. A dirty-work contract trip drops the maintainer, emits
+    ``maintain_fallback``, and kicks a re-fit with that reason."""
+    model, params0 = fitted
+    params = dataclasses.replace(
+        params0,
+        stream_maintain="incremental",
+        maintain_refresh_every=1,  # splice on the first novel insert
+        maintain_dirty_max_frac=1e-9,  # which then always over-trips
+        stream_refit_budget=100_000,
+        stream_drift_threshold=50.0,
+    )
+    trace_path = tmp_path / "trace.jsonl"
+    tracer = Tracer(sinks=[JsonlSink(str(trace_path))])
+    srv = ClusterServer(
+        model, max_batch=64, tracer=tracer, ingest=True,
+        params=params, model_dir=str(tmp_path / "models"),
+    )
+    try:
+        assert srv.maintainer is not None
+        rng = np.random.default_rng(5)
+        pts = NOVEL + rng.normal(0, SPREAD, (8, 3))
+        out = srv.ingest(pts)
+        assert out["maintained"]["fallback"] is True
+        assert srv.maintainer is None  # dropped, budget gate un-suppressed
+        stats = srv.maintain_stats()
+        assert stats["active"] is False and stats["fallbacks"] == 1
+        assert "dirty fraction" in stats["last_error"]
+        # The re-fit was kicked with the demotion reason and the server
+        # kept serving the pinned generation meanwhile.
+        assert out["refit_started"] is True
+        assert srv.generation >= 1
+        assert srv.refitter.join(timeout=120)
+        assert srv.refitter.refits_ok == 1
+    finally:
+        srv.close()
+        tracer.close()
+
+    events, errors = check_trace.validate_trace(str(trace_path))
+    assert errors == [], errors
+    falls = [e for e in events if e["stage"] == "maintain_fallback"]
+    assert len(falls) == 1 and "dirty fraction" in falls[0]["error"]
+    refits = [e for e in events if e["stage"] == "model_refit"]
+    assert refits and refits[0]["reason"] == "maintain_fallback"
+
+
+#: Stand-alone WAL + maintainer writer for the SIGKILL leg. Exhaustive
+#: candidate mode keeps it jax-free; every batch folds its novel chunks
+#: through the maintainer and snapshots carry the maintenance watermark.
+_KILL_CHILD = r"""
+import sys, types
+import numpy as np
+from hdbscan_tpu.incremental import HierarchyMaintainer
+from hdbscan_tpu.stream.buffer import IngestBuffer
+from hdbscan_tpu.stream.drift import DriftDetector
+from hdbscan_tpu.stream.wal import StreamJournal
+
+wal_dir = sys.argv[1]
+rng = np.random.default_rng(2)
+base = rng.integers(0, 48, (64, 3)).astype(np.float64) / 8.0
+model = types.SimpleNamespace(data=base)
+buf = IngestBuffer(model, reservoir_size=16, seed=0)
+drift = DriftDetector(rng.uniform(0, 1, 256), rng.integers(-1, 3, 256))
+jr = StreamJournal(wal_dir, snapshot_every=4)
+jr.open("maintain-digest", buf, drift)
+m = HierarchyMaintainer(base, min_pts=4, refresh_every=8)
+srng = np.random.default_rng(7)
+for i in range(10_000):
+    pts = 20.0 + srng.integers(0, 48, (4, 3)).astype(np.float64) / 8.0
+    labels = srng.integers(-1, 3, 4)
+    prob = srng.uniform(0, 1, 4)
+    scores = srng.uniform(0, 1, 4)
+    c0 = buf.novel_chunk_count
+    buf.absorb(pts, labels, prob)
+    drift.update(labels, scores)
+    for idx in range(c0, buf.novel_chunk_count):
+        m.rebuild(buf.novel_chunk(idx))
+    jr.append_ingest(pts, labels, prob, scores)
+    jr.maybe_snapshot(buf, drift, maintain=m.state_dict())
+    print(f"ACK {i + 1}", flush=True)
+"""
+
+
+def test_sigkill_recovery_is_bitwise(tmp_path):
+    """Kill the maintaining writer mid-stream; recovery must verify the
+    persisted watermark and finish bitwise-identical to an uninterrupted
+    run — buffer state AND maintainer digests."""
+    import types
+
+    from hdbscan_tpu.incremental import HierarchyMaintainer
+    from hdbscan_tpu.stream.buffer import IngestBuffer
+    from hdbscan_tpu.stream.drift import DriftDetector
+    from hdbscan_tpu.stream.wal import StreamJournal
+
+    total_batches = 20
+    rng = np.random.default_rng(2)
+    base = rng.integers(0, 48, (64, 3)).astype(np.float64) / 8.0
+    drift_ref = rng.uniform(0, 1, 256), rng.integers(-1, 3, 256)
+
+    def batches():
+        srng = np.random.default_rng(7)
+        for _ in range(total_batches):
+            yield (
+                20.0 + srng.integers(0, 48, (4, 3)).astype(np.float64) / 8.0,
+                srng.integers(-1, 3, 4),
+                srng.uniform(0, 1, 4),
+                srng.uniform(0, 1, 4),
+            )
+
+    def fold(buf, drift, m, batch, journal=None):
+        pts, labels, prob, scores = batch
+        c0 = buf.novel_chunk_count
+        buf.absorb(pts, labels, prob)
+        drift.update(labels, scores)
+        for idx in range(c0, buf.novel_chunk_count):
+            m.rebuild(buf.novel_chunk(idx))
+        if journal is not None:
+            journal.append_ingest(pts, labels, prob, scores)
+            journal.maybe_snapshot(buf, drift, maintain=m.state_dict())
+
+    # Uninterrupted reference run (no journal).
+    ref_buf = IngestBuffer(types.SimpleNamespace(data=base), reservoir_size=16, seed=0)
+    ref_drift = DriftDetector(*drift_ref)
+    ref_m = HierarchyMaintainer(base, min_pts=4, refresh_every=8)
+    for b in batches():
+        fold(ref_buf, ref_drift, ref_m, b)
+    assert ref_m.inserts > 0 and ref_m.splices > 0  # the stream is novel
+
+    # Crashed run: SIGKILL the child once it acks 7 durable batches.
+    wal_dir = tmp_path / "wal"
+    wal_dir.mkdir()
+    repo = Path(__file__).resolve().parents[2]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _KILL_CHILD, str(wal_dir)],
+        stdout=subprocess.PIPE, cwd=str(repo), env=env, text=True,
+    )
+    acked = 0
+    try:
+        for line in proc.stdout:
+            assert line.startswith("ACK ")
+            acked = int(line.split()[1])
+            if acked >= 7:
+                os.kill(proc.pid, signal.SIGKILL)
+                break
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
+    assert acked == 7
+
+    # Recovery: replay the WAL into fresh state machines, re-verify the
+    # maintenance watermark against the rebuilt maintainer, continue.
+    buf = IngestBuffer(types.SimpleNamespace(data=base), reservoir_size=16, seed=0)
+    drift = DriftDetector(*drift_ref)
+    jr = StreamJournal(str(wal_dir), snapshot_every=4)
+    info = jr.open("maintain-digest", buf, drift)
+    watermark = info["maintain"]
+    assert watermark is not None and watermark["inserts"] > 0
+    replayed = buf.stats()["rows_seen"] // 4
+    assert acked <= replayed <= acked + 2  # fsync-before-ack
+
+    m = HierarchyMaintainer(base, min_pts=4, refresh_every=8)
+    for chunk in buf.novel_chunks():
+        m.rebuild(chunk, verify_at=(watermark["inserts"], watermark))
+    remaining = list(batches())[replayed:]
+    for b in remaining:
+        fold(buf, drift, m, b, journal=jr)
+    jr.close()
+
+    # Bitwise: buffer (reservoir RNG included) and maintainer watermark —
+    # sha256 over the MST arrays and the edit journal, every counter.
+    assert buf.state_dict() == ref_buf.state_dict()
+    assert drift.state_dict() == ref_drift.state_dict()
+    assert m.state_dict() == ref_m.state_dict()

@@ -131,6 +131,50 @@ def test_valid_backend_values_construct():
         ).predict_backend == predict_backend
 
 
+def test_maintain_mode_validates_eagerly():
+    """``stream_maintain`` rejects unknown modes at construction with the
+    allowed list and the repr'd bad value in the message (README
+    "Incremental maintenance")."""
+    with pytest.raises(ValueError, match="stream_maintain") as exc:
+        HDBSCANParams(stream_maintain="eager")
+    msg = str(exc.value)
+    assert repr("eager") in msg
+    for value in ("off", "incremental"):
+        assert f"'{value}'" in msg, f"error must list {value!r}"
+    for value in ("off", "incremental"):
+        assert HDBSCANParams(stream_maintain=value).stream_maintain == value
+
+
+@pytest.mark.parametrize(
+    "field,bad",
+    [
+        ("maintain_budget_ms", -1.0),
+        ("maintain_dirty_max_frac", 0.0),
+        ("maintain_dirty_max_frac", -0.25),
+        ("maintain_dirty_max_frac", 1.5),
+        ("maintain_refresh_every", 0),
+        ("maintain_refresh_every", -3),
+    ],
+)
+def test_maintain_knob_ranges(field, bad):
+    with pytest.raises(ValueError, match=field) as exc:
+        HDBSCANParams(**{field: bad})
+    assert repr(bad) in str(exc.value)
+
+
+def test_valid_maintain_values_construct():
+    p = HDBSCANParams(
+        stream_maintain="incremental",
+        maintain_budget_ms=0.0,  # 0 = unbounded
+        maintain_dirty_max_frac=1.0,
+        maintain_refresh_every=1,
+    )
+    assert p.stream_maintain == "incremental"
+    assert p.maintain_budget_ms == 0.0
+    assert p.maintain_dirty_max_frac == 1.0
+    assert p.maintain_refresh_every == 1
+
+
 def test_flag_parsing_roundtrip():
     """The CLI flag table covers the new knobs (``FLAG_FIELDS``)."""
     from hdbscan_tpu.config import FLAG_FIELDS
@@ -153,5 +197,9 @@ def test_flag_parsing_roundtrip():
         ("fleet_drain", "fleet_drain_s", float),
         ("tenant_lru", "tenant_lru_size", int),
         ("tenant_quota", "tenant_quota_rps", float),
+        ("maintain", "stream_maintain", str),
+        ("maintain_budget", "maintain_budget_ms", float),
+        ("maintain_dirty_frac", "maintain_dirty_max_frac", float),
+        ("maintain_refresh", "maintain_refresh_every", int),
     ):
         assert FLAG_FIELDS.get(flag) == (field, conv)

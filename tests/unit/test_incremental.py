@@ -1,0 +1,257 @@
+"""Randomized parity sweep for incremental hierarchy maintenance
+(``hdbscan_tpu/incremental``).
+
+The contract is *bitwise*: after every single-point insert (eager
+``refresh_every=1`` splices) the maintained canonical MST — edge ids, raw
+distances AND mutual-reachability weights — equals a from-scratch host
+build (``host_knn_rows`` + ``host_mst``) over the same rows, and the
+condensed tree / flat labels produced through the shared finalize tail
+(``finalize_from_mst``) match field-for-field, mirroring
+``test_tree_vec.py``'s sweep style. Data is lattice-valued (multiples of
+1/8), the same parity-eligibility gate the device suites use, so float32
+distance math is exact and "bitwise" is meaningful.
+
+Also pinned here: the cuSLINK-style single-insert eviction invariant
+(``evicted == spliced - 1`` for an eager splice), cadence-splice edge
+reconciliation, the ResumableForestBuilder's bitwise pin against
+``tree.build_merge_forest`` with actual checkpoint resumes, rebuild/WAL
+watermark determinism, dirty-fraction fallback as a pre-mutation check,
+and a device-scratch (``models/exact.mst_edges``) comparison at trial end.
+"""
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.config import HDBSCANParams
+from hdbscan_tpu.core import tree as T
+from hdbscan_tpu.incremental import (
+    DirtySubtreeFinalizer,
+    HierarchyMaintainer,
+    MaintainFallback,
+    ResumableForestBuilder,
+    finalize_from_mst,
+    host_knn_rows,
+    host_mst,
+)
+
+TREE_FIELDS = (
+    "parent",
+    "birth",
+    "death",
+    "stability",
+    "has_children",
+    "num_members",
+    "point_exit_level",
+    "point_last_cluster",
+)
+
+
+def _lattice(rng, n, dims):
+    """Lattice-valued rows (multiples of 1/8): float32 distance math is
+    exact on these, so host/device/incremental all agree bitwise."""
+    return rng.integers(0, 48, (n, dims)).astype(np.float64) / 8.0
+
+
+def _scratch(data, min_pts):
+    """From-scratch host build over ``data``: (core, lo, hi, d, w)."""
+    core, _, _ = host_knn_rows(data, min_pts)
+    lo, hi, d, w = host_mst(data, core)
+    return core, lo, hi, d, w
+
+
+def _assert_mst_bitwise(m, data, min_pts, ctx=""):
+    core, lo, hi, d, w = _scratch(data, min_pts)
+    n = len(data)
+    assert m.core[:n].tobytes() == core.tobytes(), f"{ctx} core differs"
+    for name, a, b in (
+        ("lo", m.m_lo, lo),
+        ("hi", m.m_hi, hi),
+        ("d", m.m_d, d),
+        ("w", m.m_w, w),
+    ):
+        assert a.tobytes() == b.tobytes(), (
+            f"{ctx} mst {name} differs\n{a}\n{b}"
+        )
+    return core, lo, hi, w
+
+
+def _assert_trees_bitwise(ref, got, ctx=""):
+    rt, rlab, rsc, rinf = ref
+    gt, glab, gsc, ginf = got
+    for name in TREE_FIELDS:
+        a, b = np.asarray(getattr(rt, name)), np.asarray(getattr(gt, name))
+        assert a.dtype == b.dtype and a.shape == b.shape, f"{ctx} {name} shape"
+        assert a.tobytes() == b.tobytes(), f"{ctx} {name} differs"
+    np.testing.assert_array_equal(rlab, glab, err_msg=f"{ctx} labels")
+    np.testing.assert_array_equal(rsc, gsc, err_msg=f"{ctx} scores")
+    np.testing.assert_array_equal(rinf, ginf, err_msg=f"{ctx} infinite")
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_insert_parity_sweep(seed):
+    """24 trials x 42 eager single-point inserts (3 trials per seed):
+    after EVERY insert+splice the maintained MST is bitwise the
+    from-scratch host MST, the eager-splice eviction invariant holds, and
+    at checkpoints the full finalize tail agrees field-for-field."""
+    rng = np.random.default_rng(seed)
+    for trial in range(3):
+        n0 = int(rng.integers(12, 40))
+        dims = int(rng.integers(2, 4))
+        min_pts = int(rng.integers(3, 6))
+        data = _lattice(rng, n0, dims)
+        m = HierarchyMaintainer(data, min_pts=min_pts, refresh_every=1)
+        params = HDBSCANParams(min_points=min_pts, min_cluster_size=4)
+        fin = DirtySubtreeFinalizer(params)
+        rows = _lattice(rng, 42, dims)
+        for step, row in enumerate(rows):
+            m.insert(row)
+            stats = m.splice()
+            ctx = f"seed={seed} trial={trial} step={step} n={m.n}"
+            # cuSLINK cycle-edge replacement, one vertex at a time: the
+            # accepted edges connect the new vertex and every eviction
+            # breaks one cycle — so exactly spliced-1 old edges leave.
+            assert stats["evicted"] == stats["spliced"] - 1, (
+                f"{ctx}: {stats}"
+            )
+            assert (
+                stats["edges_prev"] + stats["spliced"] - stats["evicted"]
+                == stats["edges"]
+                == m.n - 1
+            ), f"{ctx}: {stats}"
+            grown = np.asarray(m.data[: m.n])
+            core, lo, hi, w = _assert_mst_bitwise(m, grown, min_pts, ctx)
+            if step % 14 == 13:  # full finalize parity at checkpoints
+                ref = finalize_from_mst(
+                    m.n, lo, hi, w, core, params
+                )
+                got = fin.finalize(m.n, *m.mst_arrays(), m.core[: m.n])
+                _assert_trees_bitwise(ref, got, ctx)
+
+
+def test_cadence_splice_parity():
+    """Deferred splices (refresh_every=8) land on the same canonical MST
+    as eager ones — and as from-scratch — with per-event edge counts that
+    reconcile even when evictions batch up."""
+    rng = np.random.default_rng(99)
+    data = _lattice(rng, 30, 3)
+    min_pts = 4
+    m = HierarchyMaintainer(data, min_pts=min_pts, refresh_every=8)
+    rows = _lattice(rng, 40, 3)
+    splices = []
+    for row in rows:
+        m.insert(row)
+        if m._since_splice >= m.refresh_every:
+            splices.append(m.splice())
+    assert len(splices) == 5 and m.pending_edges == 0
+    for s in splices:
+        assert s["edges_prev"] + s["spliced"] - s["evicted"] == s["edges"]
+        assert s["edges"] == s["n"] - 1
+    _assert_mst_bitwise(m, np.asarray(m.data[: m.n]), min_pts, "cadence")
+
+
+def test_resumable_builder_bitwise_pin():
+    """ResumableForestBuilder resumes from checkpoints (resume_pos > 0
+    after the first build) yet stays bitwise equal to a from-scratch
+    ``tree.build_merge_forest`` through the condense engine."""
+    rng = np.random.default_rng(7)
+    data = _lattice(rng, 40, 2)
+    min_pts = 3
+    m = HierarchyMaintainer(data, min_pts=min_pts, refresh_every=4)
+    builder = ResumableForestBuilder(checkpoints=6)
+    rows = _lattice(rng, 24, 2)
+    resumed = 0
+    for row in rows:
+        m.insert(row)
+        if m._since_splice >= m.refresh_every:
+            m.splice()
+            lo, hi, w = m.mst_arrays()
+            inc = builder.build(m.n, lo, hi, w)
+            if builder.last_stats["resume_pos"] > 0:
+                resumed += 1
+            ref = T.build_merge_forest(m.n, lo, hi, w)
+            a = T.condense_forest(ref, 3.0)
+            b = T.condense_forest(inc, 3.0)
+            for name in TREE_FIELDS:
+                x = np.asarray(getattr(a, name))
+                y = np.asarray(getattr(b, name))
+                assert x.tobytes() == y.tobytes(), f"{name} differs"
+    assert resumed >= 1, "builder never actually resumed from a checkpoint"
+
+
+def test_rebuild_matches_live_fold_bitwise():
+    """The WAL recovery fold (``rebuild``) is the SAME deterministic fold
+    as live maintenance: two maintainers from one bootstrap consuming one
+    row sequence — one per-row, one via rebuild with the first's persisted
+    watermark — agree on every state_dict field (sha256 of the edit
+    journal and MST arrays included)."""
+    rng = np.random.default_rng(5)
+    data = _lattice(rng, 24, 3)
+    rows = _lattice(rng, 30, 3)
+    live = HierarchyMaintainer(data, min_pts=4, refresh_every=8)
+    for row in rows:
+        live.insert(row)
+        if live._since_splice >= live.refresh_every:
+            live.splice()
+    watermark = live.state_dict()
+
+    rec = HierarchyMaintainer(data, min_pts=4, refresh_every=8)
+    rec.rebuild(rows, verify_at=(watermark["inserts"], watermark))
+    assert rec.state_dict() == watermark
+
+    # A corrupted watermark digest must be DETECTED, not served.
+    bad = dict(watermark)
+    bad["mst_sha"] = "0" * 64
+    rec2 = HierarchyMaintainer(data, min_pts=4, refresh_every=8)
+    with pytest.raises(MaintainFallback, match="diverged"):
+        rec2.rebuild(rows, verify_at=(watermark["inserts"], bad))
+
+
+def test_dirty_frac_fallback_preserves_state():
+    """A splice over ``maintain_dirty_max_frac`` raises BEFORE mutating:
+    the maintainer can hand the stream to the re-fit path with its arrays
+    still consistent."""
+    rng = np.random.default_rng(3)
+    data = _lattice(rng, 20, 2)
+    m = HierarchyMaintainer(
+        data, min_pts=3, refresh_every=64, dirty_max_frac=1e-9
+    )
+    # A point glued to row 0 shrinks cores deep in the prefix -> large
+    # dirty suffix share.
+    m.insert(np.asarray(data[0]) + 1.0 / 8.0)
+    before = m.state_dict()
+    with pytest.raises(MaintainFallback, match="dirty fraction"):
+        m.splice()
+    after = m.state_dict()
+    assert before == after
+
+
+def test_device_scratch_parity_at_trial_end():
+    """Eligibility-gated device comparison: on lattice data the maintained
+    MST weights equal the device Borůvka's (``models/exact.mst_edges``)
+    edge-for-edge after a full insert run — host maintenance reproduces
+    the same unique canonical tree the fit would have built."""
+    from hdbscan_tpu.models import exact
+
+    rng = np.random.default_rng(17)
+    min_pts = 4
+    data = _lattice(rng, 48, 2)
+    m = HierarchyMaintainer(data, min_pts=min_pts, refresh_every=1)
+    for row in _lattice(rng, 16, 2):
+        m.insert(row)
+        m.splice()
+    grown = np.asarray(m.data[: m.n])
+    u, v, w, core = exact.mst_edges(grown, min_pts)
+    lo = np.minimum(np.asarray(u), np.asarray(v))
+    hi = np.maximum(np.asarray(u), np.asarray(v))
+    w = np.asarray(w, np.float64)
+    order = np.lexsort((hi, lo, w))
+    np.testing.assert_array_equal(m.core[: m.n], np.asarray(core, np.float64))
+    np.testing.assert_array_equal(m.m_lo, lo[order])
+    np.testing.assert_array_equal(m.m_hi, hi[order])
+    np.testing.assert_array_equal(m.m_w, w[order])
+
+
+def test_non_euclidean_metric_rejected():
+    rng = np.random.default_rng(0)
+    with pytest.raises(ValueError, match="euclidean"):
+        HierarchyMaintainer(_lattice(rng, 8, 2), min_pts=3, metric="cosine")

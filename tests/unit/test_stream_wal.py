@@ -285,6 +285,125 @@ def test_trace_events_pass_check_trace(tmp_path):
     assert any("not contiguous" in e for e in errors)
 
 
+def test_wrapped_reservoir_recovery_bitwise(tmp_path):
+    """The recovery guarantee holds for a WRAPPED reservoir (n >> capacity,
+    see the wal.py module docstring): once algorithm R starts replacing
+    rows, every replacement decision consumes reservoir RNG state, so a
+    replay that diverged by even one draw would produce a visibly different
+    reservoir. reservoir_size=8 with 20 chunks x 16 rows = 320 candidate
+    rows wraps the reservoir ~40x over."""
+    model = _model()
+    rng = np.random.default_rng(11)
+    chunks = [_chunk(rng) for _ in range(20)]
+
+    def fresh():
+        buf = IngestBuffer(model, reservoir_size=8, seed=3)
+        r = np.random.default_rng(103)
+        drift = DriftDetector(r.uniform(0, 1, 512), r.integers(-1, 3, 512))
+        return buf, drift
+
+    ref_buf, ref_drift = fresh()
+    for c in chunks:
+        _ingest(None, ref_buf, ref_drift, c)
+    # Sanity: the reservoir really wrapped — it saw far more rows than it
+    # can hold, so replacement sampling ran.
+    state = ref_buf.state_dict()
+    assert state["rows_seen"] > 8 * 10 and len(state["reservoir"]) == 8
+
+    buf_a, drift_a = fresh()
+    jr_a = StreamJournal(str(tmp_path), snapshot_every=5)
+    jr_a.open("digest-w", buf_a, drift_a)
+    for c in chunks[:13]:  # crash mid-stream, reservoir already wrapped
+        _ingest(jr_a, buf_a, drift_a, c)
+
+    buf_b, drift_b = fresh()
+    jr_b = StreamJournal(str(tmp_path), snapshot_every=5)
+    info = jr_b.open("digest-w", buf_b, drift_b)
+    assert info["snapshot"] is True
+    for c in chunks[13:]:
+        _ingest(jr_b, buf_b, drift_b, c)
+
+    # Bitwise: contents AND RNG state, so all FUTURE absorbs agree too.
+    assert buf_b.state_dict() == ref_buf.state_dict()
+    assert drift_b.state_dict() == ref_drift.state_dict()
+    np.testing.assert_array_equal(
+        buf_b.refit_points(originals=16, seed=5),
+        ref_buf.refit_points(originals=16, seed=5),
+    )
+    jr_a.close()
+    jr_b.close()
+
+
+def test_wrapped_reservoir_snapshot_only_recovery(tmp_path):
+    """Same guarantee when recovery restores PURELY from the snapshot (no
+    WAL tail to replay): the snapshot's serialized RNG state alone must
+    resume the wrapped reservoir bitwise."""
+    model = _model()
+    rng = np.random.default_rng(12)
+    chunks = [_chunk(rng) for _ in range(12)]
+
+    def fresh():
+        buf = IngestBuffer(model, reservoir_size=8, seed=4)
+        r = np.random.default_rng(104)
+        drift = DriftDetector(r.uniform(0, 1, 512), r.integers(-1, 3, 512))
+        return buf, drift
+
+    ref_buf, ref_drift = fresh()
+    for c in chunks:
+        _ingest(None, ref_buf, ref_drift, c)
+
+    buf_a, drift_a = fresh()
+    jr_a = StreamJournal(str(tmp_path), snapshot_every=10_000)
+    jr_a.open("digest-s", buf_a, drift_a)
+    for c in chunks[:9]:
+        _ingest(jr_a, buf_a, drift_a, c)
+    jr_a.snapshot(buf_a, drift_a)  # crash lands exactly on the snapshot
+
+    buf_b, drift_b = fresh()
+    jr_b = StreamJournal(str(tmp_path), snapshot_every=10_000)
+    info = jr_b.open("digest-s", buf_b, drift_b)
+    assert info["snapshot"] is True and info["records"] == 0
+    for c in chunks[9:]:
+        _ingest(jr_b, buf_b, drift_b, c)
+
+    assert buf_b.state_dict() == ref_buf.state_dict()
+    assert drift_b.state_dict() == ref_drift.state_dict()
+    jr_a.close()
+    jr_b.close()
+
+
+def test_maintain_watermark_roundtrip(tmp_path):
+    """The optional incremental-maintenance watermark survives the snapshot
+    round trip verbatim, and recovery without a snapshot reports None."""
+    model = _model()
+    buf, drift = _fresh(model)
+    jr = StreamJournal(str(tmp_path), snapshot_every=10_000)
+    jr.open("digest-m", buf, drift)
+    rng = np.random.default_rng(13)
+    _ingest(jr, buf, drift, _chunk(rng))
+    watermark = {
+        "n": 80, "inserts": 16, "splices": 2, "spliced_edges": 18,
+        "evicted_edges": 3, "pending_edges": 0, "journal_len": 18,
+        "journal_sha": "ab" * 32, "mst_sha": "cd" * 32,
+    }
+    jr.snapshot(buf, drift, maintain=watermark)
+
+    buf_b, drift_b = _fresh(model)
+    jr_b = StreamJournal(str(tmp_path), snapshot_every=10_000)
+    info = jr_b.open("digest-m", buf_b, drift_b)
+    assert info["snapshot"] is True
+    assert info["maintain"] == watermark
+    jr.close()
+    jr_b.close()
+
+    # No snapshot -> no watermark.
+    buf_c, drift_c = _fresh(model)
+    jr_c = StreamJournal(str(tmp_path / "fresh"), snapshot_every=10_000)
+    info = jr_c.open("digest-m", buf_c, drift_c)
+    assert info["maintain"] is None
+    jr_c.close()
+
+
 def test_validation():
     with pytest.raises(ValueError):
         StreamJournal("/tmp/x-never-created", snapshot_every=0)
