@@ -73,6 +73,17 @@ background re-fits while the maintainer absorbs the stream. One JSON
 line; headline p99 at the largest size, with
 ``maintain_ari_vs_scratch`` lifted into its own headline series by
 ``scripts/bench_compare.py``.
+
+``bench.py mesh [--quick]`` runs the sharded-program scaling leg (README
+"One sharded program"): the SAME partitioned fit program timed on a
+1-device and the 8-device mesh — per-phase strong-scaling efficiency
+``t1 / (D * tD)`` for the ring k-NN core scan and the row-sharded Borůvka
+MST (headline = the worst phase, direction "higher"), bitwise edge
+parity across the meshes, per-phase per-device peak bytes from the
+memory auditor, and the ``--assert-not-replicated`` gate verdict. On a
+host with < 8 devices the leg self-provisions a hermetic 8-virtual-CPU
+child (the ``dryrun_multichip`` recipe); a 1-core smoke host serializes
+the virtual devices so its efficiency is honestly ~1/D (``cpu_smoke``).
 """
 
 from __future__ import annotations
@@ -786,6 +797,155 @@ def _maintain(argv: list[str]) -> None:
     )
 
 
+def _mesh_leg(argv: list[str]) -> None:
+    """The sharded-program scaling leg (README "One sharded program"):
+    the SAME partitioned fit program (``parallel/shard.py``) timed on a
+    1-device mesh and on the full 8-device mesh — per-phase strong-scaling
+    efficiency ``t1 / (D * tD)`` for the ring k-NN core scan and the
+    row-sharded Borůvka MST, bitwise edge parity across the two meshes,
+    per-phase per-device peak bytes from the memory auditor, and the
+    ``assert_not_replicated`` gate verdict, all in one JSON line.
+
+    Self-provisioning like ``dryrun_multichip``: on a host with fewer than
+    8 devices the leg re-execs itself in a hermetic 8-virtual-CPU-device
+    child. The 0.8x-linear acceptance targets real multi-chip hardware;
+    a 1-core CPU smoke host serializes the 8 virtual devices, so its
+    efficiency is honestly ~1/D and the row is flagged ``cpu_smoke``.
+    ``bench.py mesh [--quick]``
+    """
+    import os
+    import subprocess
+
+    quick = "--quick" in argv
+    if quick:
+        argv.remove("--quick")
+    child = "--_child" in argv
+    if child:
+        argv.remove("--_child")
+    if argv:
+        raise SystemExit(f"bench.py mesh: unknown arguments {argv!r}")
+
+    import jax
+
+    n_dev = 8
+    if len(jax.devices()) < n_dev:
+        if child:  # pragma: no cover - provisioning failed
+            raise SystemExit("bench.py mesh: child has < 8 devices")
+        from hdbscan_tpu.parallel.distributed import hermetic_child_env
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        cmd = [sys.executable, os.path.abspath(__file__), "mesh", "--_child"]
+        if quick:
+            cmd.append("--quick")
+        raise SystemExit(
+            subprocess.call(cmd, env=hermetic_child_env(n_dev, repo_root=repo))
+        )
+
+    from hdbscan_tpu import obs
+    from hdbscan_tpu.models import exact
+    from hdbscan_tpu.obs import MemoryAuditor
+    from hdbscan_tpu.parallel.mesh import get_mesh
+    from hdbscan_tpu.parallel.shard import shard_core_distances
+
+    n = 8_192 if quick else 16_384
+    min_pts = 5
+    rng = np.random.default_rng(0)
+    data = np.concatenate(
+        [
+            rng.normal(0.0, 1.0, (n // 2, 2)),
+            rng.normal(8.0, 1.0, (n - n // 2, 2)),
+        ]
+    )
+    rng.shuffle(data)
+
+    mesh1 = get_mesh(list(jax.devices())[:1])
+    mesh8 = get_mesh(list(jax.devices())[:n_dev])
+
+    def time_phases(mesh):
+        """(core_wall, mst_wall, edges) — warm run first, timed run second,
+        so compile cost never lands in the scaling ratio."""
+        walls = {}
+        for attempt in ("warm", "timed"):
+            t0 = time.monotonic()
+            core = shard_core_distances(data, min_pts, mesh=mesh)
+            walls["core"] = time.monotonic() - t0
+            t0 = time.monotonic()
+            edges = exact.mst_edges_from_core(
+                data, core, fit_sharding="sharded", mesh=mesh
+            )
+            walls["mst"] = time.monotonic() - t0
+        return walls["core"], walls["mst"], edges
+
+    core1_s, mst1_s, edges1 = time_phases(mesh1)
+    print(
+        f"[bench] mesh 1-device: core={core1_s:.3f}s mst={mst1_s:.3f}s "
+        f"(n={n})",
+        file=sys.stderr,
+    )
+
+    auditor = MemoryAuditor(source="auto")
+    obs.install(auditor=auditor)
+    try:
+        core8_s, mst8_s, edges8 = time_phases(mesh8)
+        gate = obs.assert_not_replicated(n, data.dtype.itemsize)
+    finally:
+        obs.clear()
+    parity_ok = all(
+        np.array_equal(a, b) for a, b in zip(edges1, edges8)
+    )
+    peaks = {
+        phase: wm["max_device_bytes"]
+        for phase, wm in auditor.watermark_table().items()
+    }
+    phases = {
+        "core_distances": {
+            "t1_s": round(core1_s, 3),
+            "t8_s": round(core8_s, 3),
+            "efficiency": round(core1_s / (n_dev * core8_s), 4),
+        },
+        "boruvka_mst": {
+            "t1_s": round(mst1_s, 3),
+            "t8_s": round(mst8_s, 3),
+            "efficiency": round(mst1_s / (n_dev * mst8_s), 4),
+        },
+    }
+    headline = min(p["efficiency"] for p in phases.values())
+    platform = jax.devices()[0].platform
+    print(
+        f"[bench] mesh 8-device: core={core8_s:.3f}s "
+        f"(eff {phases['core_distances']['efficiency']}) "
+        f"mst={mst8_s:.3f}s (eff {phases['boruvka_mst']['efficiency']}) "
+        f"parity={parity_ok} gate_ok=True "
+        f"worst_fraction={gate['worst_fraction']} "
+        f"peak_device_bytes={max(peaks.values())}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "mesh_scan_scaling_efficiency_8dev",
+                "value": headline,
+                "unit": "x",
+                "mesh_devices": n_dev,
+                "mesh_n": n,
+                "mesh_d": 2,
+                "mesh_min_pts": min_pts,
+                "mesh_phases": phases,
+                "mesh_edge_parity_bitwise": parity_ok,
+                "mesh_gate_ok": True,
+                "mesh_gate_threshold_bytes": int(gate["threshold_bytes"]),
+                "mesh_gate_worst_fraction": gate["worst_fraction"],
+                "mesh_gate_phases": gate["phases"],
+                "mesh_peak_device_bytes": peaks,
+                "mesh_peak_device_bytes_max": max(peaks.values()),
+                "mesh_linear_target": 0.8,
+                "platform": platform,
+                "cpu_smoke": platform != "tpu",
+            }
+        )
+    )
+
+
 def main(argv: list[str] | None = None) -> None:
     import jax
 
@@ -806,6 +966,9 @@ def main(argv: list[str] | None = None) -> None:
         return
     if argv and argv[0] == "maintain":
         _maintain(argv[1:])
+        return
+    if argv and argv[0] == "mesh":
+        _mesh_leg(argv[1:])
         return
     if "--stream-synthetic" in argv:
         argv.remove("--stream-synthetic")

@@ -13,6 +13,7 @@ Usage mirrors the reference's documented contract (``main/Main.java:534-614``)::
         [knn_index={auto,exact,rpforest}] [knn_index_threshold=N] \
         [rpf_trees=N] [rpf_leaf_size=N] [rpf_rescan=N] \
         [scan_backend={auto,host,ring}] \
+        [fit_sharding={auto,replicated,sharded}] \
         [tree_backend={auto,reference,vectorized}] \
         [mst_backend={auto,host,device}] \
         [consensus=N] [compat_cf={true,false}] \
@@ -50,7 +51,13 @@ leaves with ``rpf_rescan`` neighbor-of-neighbor repair rounds), and
 Borůvka sweeps (README "Scaling out"): ``host`` keeps the single-program
 tiled scans, ``ring`` shards rows over the mesh and circulates column
 panels via ``ppermute``, and ``auto`` selects ring only on a multi-device
-TPU mesh. ``tree_backend`` picks the host finalize engine for the condensed
+TPU mesh. ``fit_sharding`` picks the end-to-end partition tier (README "One
+sharded program", ``parallel/shard.py``): ``replicated`` keeps the existing
+engines, ``sharded`` routes the whole fit through ONE partitioned program —
+row-sharded core scans plus fully row-sharded Borůvka rounds, the path the
+``--assert-not-replicated`` gate certifies end to end — and ``auto`` picks
+sharded only on a multi-device TPU mesh. The run manifest records the
+partition-rule table. ``tree_backend`` picks the host finalize engine for the condensed
 tree (README "Finalize pipeline"): ``reference`` is the per-node Python
 walk, ``vectorized`` the array-level engine with bitwise-identical outputs,
 and ``auto`` uses vectorized with a reference fallback on unsupported
@@ -381,7 +388,19 @@ def _main_fit(argv: list[str]) -> int:
             wall_s=round(time.monotonic() - t0, 6),
         )
         t0 = time.monotonic()
-        if n <= params.processing_units:
+        from hdbscan_tpu.parallel.shard import resolve_fit_sharding
+
+        if resolve_fit_sharding(params.fit_sharding, mesh) == "sharded":
+            # The ONE partitioned program (``parallel/shard.py``): the
+            # whole exact fit runs row-sharded — the end-to-end path the
+            # ``--assert-not-replicated`` gate certifies. The mr pipeline's
+            # per-block packing would reintroduce replicated glue scans, so
+            # sharded routing always takes the exact program.
+            from hdbscan_tpu.models import exact
+
+            result = exact.fit(data, params, mesh=mesh, trace=tracer)
+            mode = "exact-sharded"
+        elif n <= params.processing_units:
             # Single-block exact path: dense local compute (no mesh to shard).
             result = hdbscan.fit(data, params, trace=tracer)
             mode = "exact"

@@ -226,6 +226,20 @@ class HDBSCANParams:
     #: meshes and host elsewhere. Outputs are bitwise identical across
     #: backends (ring parity tests, tests/unit/test_ring.py).
     scan_backend: str = "auto"
+    #: End-to-end partition tier for the exact fit (``parallel/shard.py``):
+    #: "replicated" keeps the existing engines (some phase somewhere holds a
+    #: full point-set copy per device — the pre-shard behavior),
+    #: "sharded" runs ONE partitioned program — row-sharded core distances
+    #: (ring k-NN or the per-shard rp-forest build + ring-circulated
+    #: candidate-panel exchange) feeding fully row-sharded Borůvka rounds
+    #: (component labels circulate as a second panel; per-round edge
+    #: all-gather only at the host contraction) — per-device HBM stays
+    #: O(n/devices · d) in every phase, the program the
+    #: ``--assert-not-replicated`` gate certifies. "auto" (default) picks
+    #: sharded on multi-device TPU meshes and replicated elsewhere. With
+    #: ``knn_index="exact"`` the sharded fit is bitwise identical to the
+    #: replicated one (forced-8-device parity tests).
+    fit_sharding: str = "auto"
     #: Host finalize engine for the condensed-tree tail (``core/tree.py`` vs
     #: ``core/tree_vec.py``): "reference" keeps the per-node Python
     #: condense/EOM/label walk (the parity oracle), "vectorized" runs the
@@ -422,6 +436,11 @@ class HDBSCANParams:
             raise ValueError(
                 "scan_backend must be 'auto', 'host' or 'ring', "
                 f"got {self.scan_backend!r}"
+            )
+        if self.fit_sharding not in ("auto", "replicated", "sharded"):
+            raise ValueError(
+                "fit_sharding must be 'auto', 'replicated' or 'sharded', "
+                f"got {self.fit_sharding!r}"
             )
         if self.tree_backend not in ("auto", "reference", "vectorized"):
             raise ValueError(
@@ -658,6 +677,7 @@ FLAG_FIELDS = {
     "rpf_leaf_size": ("rpf_leaf_size", int),
     "rpf_rescan": ("rpf_rescan_rounds", int),
     "scan_backend": ("scan_backend", str),
+    "fit_sharding": ("fit_sharding", str),
     "tree_backend": ("tree_backend", str),
     "mst_backend": ("mst_backend", str),
     "compile_cache": ("compile_cache", str),

@@ -196,6 +196,54 @@ def _forest_components(n: int, lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
     return comp
 
 
+def _merge_sorted_suffix(
+    plo: np.ndarray, phi: np.ndarray, pd: np.ndarray, pw: np.ndarray,
+    slo: np.ndarray, shi: np.ndarray, sd: np.ndarray, sw: np.ndarray,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Merge a canonically-sorted suffix into a canonically-sorted prefix.
+
+    Both inputs are sorted by the repo's total edge order ``(w, lo, hi)``;
+    the output is the sorted concatenation — bitwise what
+    ``np.lexsort((hi, lo, w))`` over the union produces, because tree edge
+    keys are unique (a (lo, hi) pair occurs at most once, and equal pairs
+    would carry equal weights). Cost is O(p + s log p) instead of the
+    O((p+s) log (p+s)) full re-sort: splices that touch a short journal
+    suffix no longer pay a full-tree sort (BENCH maintain leg).
+
+    Weight ties ACROSS the two inputs are real (mutual-reachability
+    weights collapse onto shared core distances), so equal-``w`` runs are
+    refined by the packed ``(lo, hi)`` pair key before placement.
+    """
+    p, s = len(pw), len(sw)
+    if s == 0:
+        return plo, phi, pd, pw
+    if p == 0:
+        return slo, shi, sd, sw
+    pos = np.searchsorted(pw, sw, side="left")
+    end = np.searchsorted(pw, sw, side="right")
+    tie = np.nonzero(pos < end)[0]
+    if len(tie):
+        # uint64 pair pack: lo, hi are vertex ids < 2**32.
+        pack_p = plo.astype(np.uint64) << np.uint64(32)
+        pack_p |= phi.astype(np.uint64)
+        pack_s = (slo[tie].astype(np.uint64) << np.uint64(32)) | shi[
+            tie
+        ].astype(np.uint64)
+        for j, a, b, q in zip(tie, pos[tie], end[tie], pack_s):
+            pos[j] = a + np.searchsorted(pack_p[a:b], q)
+    out_pos = pos + np.arange(s)
+    mask = np.ones(p + s, bool)
+    mask[out_pos] = False
+
+    def put(pv, sv):
+        out = np.empty(p + s, pv.dtype)
+        out[mask] = pv
+        out[out_pos] = sv
+        return out
+
+    return put(plo, slo), put(phi, shi), put(pd, sd), put(pw, sw)
+
+
 def _seeded_pool_mst(
     comp0: np.ndarray, lo: np.ndarray, hi: np.ndarray, w: np.ndarray
 ) -> np.ndarray:
@@ -573,13 +621,19 @@ class HierarchyMaintainer:
         new_pairs = pool_lo[acc] * (1 << 32) + pool_hi[acc]
         spliced = int(len(np.setdiff1d(new_pairs, old_pairs)))
         evicted = int(len(np.setdiff1d(old_pairs, new_pairs)))
-        nlo = np.concatenate([self.m_lo[:f], pool_lo[acc]])
-        nhi = np.concatenate([self.m_hi[:f], pool_hi[acc]])
-        nd = np.concatenate([self.m_d[:f], pool_d[acc]])
-        nw = np.concatenate([new_w[:f], pool_w[acc]])
-        order = np.lexsort((nhi, nlo, nw))
-        self.m_lo, self.m_hi = nlo[order], nhi[order]
-        self.m_d, self.m_w = nd[order], nw[order]
+        # Stable-prefix re-canonicalization: the prefix [:f] has unchanged
+        # weights by construction (f <= first re-weighted index), so its
+        # canonical (w, lo, hi) order is intact — sort only the accepted
+        # suffix and merge it in. Bitwise the old full
+        # ``np.lexsort((nhi, nlo, nw))`` over all n-1 edges (see
+        # :func:`_merge_sorted_suffix`), without the O(n log n) resort.
+        slo, shi = pool_lo[acc], pool_hi[acc]
+        sd, sw = pool_d[acc], pool_w[acc]
+        sord = np.lexsort((shi, slo, sw))
+        self.m_lo, self.m_hi, self.m_d, self.m_w = _merge_sorted_suffix(
+            self.m_lo[:f], self.m_hi[:f], self.m_d[:f], new_w[:f],
+            slo[sord], shi[sord], sd[sord], sw[sord],
+        )
         self._pend_lo, self._pend_hi, self._pend_d = [], [], []
         inserts = self._since_splice
         self._since_splice = 0

@@ -50,6 +50,7 @@ def mst_edges(
     scan_backend: str = "auto",
     index: str = "exact",
     index_opts: dict | None = None,
+    fit_sharding: str = "auto",
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Blocked Borůvka: (u, v, w) exact mutual-reachability MST + core distances.
 
@@ -71,10 +72,18 @@ def mst_edges(
     sub-quadratic rp-forest engine; the Borůvka rounds stay exact, so the
     tree is the exact MRD MST *under the approximate core vector* (the
     KNN-DBSCAN quality argument; the e2e ARI gate pins >= 0.99x exact).
+
+    ``fit_sharding="sharded"`` (``parallel/shard.py``) runs the end-to-end
+    partitioned program: row-sharded core scans (ring k-NN, or the
+    per-shard rp-forest build + panel exchange for ``index="rpforest"``)
+    and fully row-sharded Borůvka rounds — no phase replicates an O(n)
+    buffer per device. Bitwise identical to the replicated engines for
+    ``index="exact"``.
     """
     import time
 
     from hdbscan_tpu.parallel.ring import resolve_scan_backend
+    from hdbscan_tpu.parallel.shard import resolve_fit_sharding
     from hdbscan_tpu.utils.flops import counter as _flops
     from hdbscan_tpu.utils.flops import phase_stats
 
@@ -83,8 +92,17 @@ def mst_edges(
     n = len(data)
     t0 = time.monotonic()
     fsnap = _flops.snapshot()
+    sharded = resolve_fit_sharding(fit_sharding, mesh) == "sharded"
     with obs.mem_phase("core_distances"):
-        if resolve_scan_backend(scan_backend, mesh) == "ring":
+        if sharded:
+            from hdbscan_tpu.parallel.shard import shard_core_distances
+
+            core = shard_core_distances(
+                data, min_pts, metric, row_tile=row_tile, col_tile=col_tile,
+                dtype=dtype, mesh=mesh, trace=trace,
+                knn_backend=knn_backend, index=index, index_opts=index_opts,
+            )
+        elif resolve_scan_backend(scan_backend, mesh) == "ring":
             from hdbscan_tpu.parallel.ring import ring_knn_core_distances
 
             core, _ = ring_knn_core_distances(
@@ -114,6 +132,7 @@ def mst_edges(
         mesh=mesh,
         trace=trace,
         scan_backend=scan_backend,
+        fit_sharding=fit_sharding,
     )
     return u, v, w, core
 
@@ -129,23 +148,35 @@ def mst_edges_from_core(
     mesh=None,
     trace=None,
     scan_backend: str = "auto",
+    fit_sharding: str = "auto",
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """The Borůvka round loop of :func:`mst_edges` for PRE-COMPUTED core
     distances (the weighted/dedup path supplies multiset-weighted cores).
 
     ``scan_backend="ring"`` swaps the column-replicated scanner for the
     ring-systolic sharded one (``parallel/ring.py``) — same edges bitwise.
+    ``fit_sharding="sharded"`` goes further: the fully row-sharded scanner
+    (``parallel/shard.py`` — component labels circulate as a panel instead
+    of replicating) — still the same edges bitwise.
     """
     import time
 
     from hdbscan_tpu.parallel.ring import resolve_scan_backend
+    from hdbscan_tpu.parallel.shard import resolve_fit_sharding
     from hdbscan_tpu.utils.flops import counter as _flops
     from hdbscan_tpu.utils.flops import phase_stats
 
     n = len(data)
     t0 = time.monotonic()
     fsnap = _flops.snapshot()
-    if resolve_scan_backend(scan_backend, mesh) == "ring":
+    if resolve_fit_sharding(fit_sharding, mesh) == "sharded":
+        from hdbscan_tpu.parallel.shard import ShardBoruvkaScanner
+
+        scanner = ShardBoruvkaScanner(
+            data, core, metric, row_tile=row_tile, col_tile=col_tile,
+            dtype=dtype, mesh=mesh, trace=trace,
+        )
+    elif resolve_scan_backend(scan_backend, mesh) == "ring":
         from hdbscan_tpu.parallel.ring import RingBoruvkaScanner
 
         scanner = RingBoruvkaScanner(
@@ -166,27 +197,35 @@ def mst_edges_from_core(
     rounds = 0
     # Heartbeat progress = emitted-edge fraction (n-1 edges complete the
     # tree): monotone by construction — n_comp only shrinks.
-    with obs.mem_phase("boruvka_mst"), obs.task(
-        "boruvka", total=max(n - 1, 1)
-    ) as hb:
-        for rnd in range(max_rounds):
-            if n_comp <= 1:
-                break
-            bw, bj = scanner.min_outgoing(comp)
-            # Fully vectorized per-component selection + union (SURVEY.md
-            # §2.C row P9's host side): no per-edge Python even with
-            # millions of components in the early rounds.
-            emit, comp, new_count = _contract(comp, bj, bw)
-            if len(emit) == 0:
-                break  # disconnected pool (cannot happen for a full metric space)
-            eu.append(emit)
-            ev.append(bj[emit])
-            ew.append(bw[emit])
-            n_comp = new_count
-            rounds = rnd + 1
-            hb.beat(n - n_comp)
-            if trace is not None:
-                trace("boruvka_round", round=rnd, components=n_comp, edges_added=len(emit))
+    try:
+        with obs.mem_phase("boruvka_mst"), obs.task(
+            "boruvka", total=max(n - 1, 1)
+        ) as hb:
+            for rnd in range(max_rounds):
+                if n_comp <= 1:
+                    break
+                bw, bj = scanner.min_outgoing(comp)
+                # Fully vectorized per-component selection + union (SURVEY.md
+                # §2.C row P9's host side): no per-edge Python even with
+                # millions of components in the early rounds.
+                emit, comp, new_count = _contract(comp, bj, bw)
+                if len(emit) == 0:
+                    break  # disconnected pool (cannot happen for a full metric space)
+                eu.append(emit)
+                ev.append(bj[emit])
+                ew.append(bw[emit])
+                n_comp = new_count
+                rounds = rnd + 1
+                hb.beat(n - n_comp)
+                if trace is not None:
+                    trace("boruvka_round", round=rnd, components=n_comp, edges_added=len(emit))
+    finally:
+        # Release the scanner's device row shards eagerly (not all scanners
+        # hold device state; the sharded one does and the memory gate
+        # charges whatever deferred deletion leaves behind).
+        close = getattr(scanner, "close", None)
+        if close is not None:
+            close()
     if trace is not None:
         wall = time.monotonic() - t0
         trace(
@@ -389,16 +428,22 @@ def fit(
         )
     from hdbscan_tpu.core.mst_device import resolve_mst_backend
     from hdbscan_tpu.parallel.ring import resolve_scan_backend
+    from hdbscan_tpu.parallel.shard import resolve_fit_sharding
 
     # Device-resident MST -> forest pipeline (``core/mst_device.py``): every
     # Borůvka round and the union-find forest scan run in-jit, ONE host sync
     # downstream of the core-distance scan. The ring scanner shards its own
     # per-round host reduction, so the single-program device path only runs
-    # when the scan backend is the replicated one.
+    # when the scan backend is the replicated one — and never under the
+    # sharded program (its edge pool lives replicated on one device).
     if (
         resolve_mst_backend(params, n) == "device"
         and resolve_scan_backend(getattr(params, "scan_backend", "auto"), mesh)
         != "ring"
+        and resolve_fit_sharding(
+            getattr(params, "fit_sharding", "auto"), mesh
+        )
+        != "sharded"
     ):
         result = _fit_device(
             data,
@@ -426,6 +471,7 @@ def fit(
         knn_backend=params.knn_backend,
         scan_backend=getattr(params, "scan_backend", "auto"),
         index=index, index_opts=index_opts,
+        fit_sharding=getattr(params, "fit_sharding", "auto"),
     )
     from hdbscan_tpu.models._finalize import finalize_clustering
 
@@ -641,6 +687,8 @@ def _fit_dedup(
         dtype=dtype,
         mesh=mesh,
         trace=trace,
+        scan_backend=getattr(params, "scan_backend", "auto"),
+        fit_sharding=getattr(params, "fit_sharding", "auto"),
     )
     # Tree extraction over the expanded vertex set (see expand_heavy_groups:
     # groups heavy enough to pass minClusterSize must dissolve under tie

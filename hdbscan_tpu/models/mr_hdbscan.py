@@ -618,7 +618,28 @@ def _fit_rows(
             from hdbscan_tpu.parallel.ring import resolve_scan_backend
 
             index, index_opts = resolve_index_for(params, n)
-            if resolve_scan_backend(params.scan_backend, mesh) == "ring":
+            from hdbscan_tpu.parallel.shard import resolve_fit_sharding
+
+            if resolve_fit_sharding(params.fit_sharding, mesh) == "sharded":
+                # The partitioned program (``parallel/shard.py``): the
+                # global core scan runs row-sharded — ring k-NN, or the
+                # per-shard forest + panel exchange — with no per-device
+                # full data copy. (The per-level glue harvest keeps its
+                # selected scan engine; ROADMAP records the residual.)
+                from hdbscan_tpu.parallel.shard import shard_core_distances
+
+                with obs.mem_phase("global_cores"):
+                    core = shard_core_distances(
+                        data,
+                        params.min_points,
+                        metric,
+                        mesh=mesh,
+                        trace=trace,
+                        knn_backend=params.knn_backend,
+                        index=index,
+                        index_opts=index_opts,
+                    )
+            elif resolve_scan_backend(params.scan_backend, mesh) == "ring":
                 from hdbscan_tpu.parallel.ring import ring_knn_core_distances
 
                 core, _ = ring_knn_core_distances(
@@ -1083,7 +1104,18 @@ def _fit_rows(
             from hdbscan_tpu.parallel.ring import resolve_scan_backend
 
             index, index_opts = resolve_index_for(params, n)
-            if resolve_scan_backend(params.scan_backend, mesh) == "ring":
+            from hdbscan_tpu.parallel.shard import resolve_fit_sharding
+
+            if resolve_fit_sharding(params.fit_sharding, mesh) == "sharded":
+                from hdbscan_tpu.parallel.shard import (
+                    shard_core_distances_rows,
+                )
+
+                core_b = shard_core_distances_rows(
+                    data, bset, params.min_points, metric, mesh=mesh,
+                    trace=trace, index=index, index_opts=index_opts,
+                )
+            elif resolve_scan_backend(params.scan_backend, mesh) == "ring":
                 from hdbscan_tpu.parallel.ring import (
                     ring_knn_core_distances_rows,
                 )

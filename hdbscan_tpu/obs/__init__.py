@@ -33,13 +33,18 @@ from __future__ import annotations
 import threading
 from contextlib import contextmanager, nullcontext
 
-from hdbscan_tpu.obs.audit import MemoryAuditor, ReplicatedBufferError
+from hdbscan_tpu.obs.audit import (
+    MemoryAuditor,
+    ReplicatedBufferError,
+    donation_guard,
+)
 from hdbscan_tpu.obs.correlate import join_spans, merge_fleet_traces
 from hdbscan_tpu.obs.heartbeat import Heartbeats
 
 __all__ = [
     "MemoryAuditor",
     "ReplicatedBufferError",
+    "donation_guard",
     "Heartbeats",
     "join_spans",
     "merge_fleet_traces",

@@ -40,6 +40,30 @@ class ReplicatedBufferError(RuntimeError):
     """A single device's phase peak implies a replicated O(n) buffer."""
 
 
+# The live_arrays collector materializes ``addressable_shards`` views of
+# every resident array and pins buffers via ``unsafe_buffer_pointer``.
+# Neither may overlap a dispatch that DONATES a buffer: an external
+# reference acquired from the sampler thread mid-donation leaves PJRT
+# buffer ownership undefined. (This guard is exclusion for that latent
+# hazard; the garbage-MST corruption once blamed on it was traced to
+# donating zero-copy ``device_put`` views of host memory — see
+# ``parallel/shard._owned_row_panel``.) ``memory_stats`` never touches
+# buffers, so real accelerators don't need the guard. RLock, not Lock:
+# the main thread takes synchronous entry/exit samples inside its own
+# guarded dispatch window, and same-thread sampling cannot race
+# same-thread dispatch.
+_DONATION_GUARD = threading.RLock()
+
+
+@contextmanager
+def donation_guard():
+    """Hold while dispatching a computation with donated operands (from
+    operand creation until the outputs are known ready). Excludes the
+    live-arrays sampler thread for the duration; no-op cost off-thread."""
+    with _DONATION_GUARD:
+        yield
+
+
 def _device_key(d) -> str:
     return f"{d.platform}:{d.id}"
 
@@ -60,21 +84,46 @@ def _memory_stats_sample(devices) -> dict[str, int] | None:
 
 
 def _live_arrays_sample(devices) -> dict[str, int]:
-    """Attribute every live array's addressable shards to their devices."""
+    """Attribute every live array's addressable shards to their devices.
+
+    Shards are deduplicated by their underlying buffer pointer: a global
+    NamedSharding'd array and the per-device views jit dispatch creates of
+    it alias the SAME memory (so do donation-aliased outputs), and real
+    accelerators' ``bytes_in_use`` would count that memory once. Without
+    the dedup a concurrent sample taken mid-dispatch double-counts every
+    sharded operand and the replication gate trips on phantom bytes.
+    """
     import jax
 
     per_dev: dict[str, int] = {_device_key(d): 0 for d in devices}
-    for a in jax.live_arrays():
-        try:
-            shards = a.addressable_shards
-        except Exception:
-            continue
-        for sh in shards:
-            key = _device_key(sh.device)
+    seen: set[tuple[str, int]] = set()
+    # The whole walk sits inside the donation guard: ``addressable_shards``
+    # creates per-device views and ``unsafe_buffer_pointer`` pins the
+    # underlying buffer, and neither may overlap a dispatch that donates
+    # the buffer (see ``_DONATION_GUARD``).
+    with _DONATION_GUARD:
+        for a in jax.live_arrays():
             try:
-                per_dev[key] = per_dev.get(key, 0) + int(sh.data.nbytes)
+                if a.is_deleted():
+                    continue
+                shards = a.addressable_shards
             except Exception:
                 continue
+            for sh in shards:
+                key = _device_key(sh.device)
+                try:
+                    nbytes = int(sh.data.nbytes)
+                    try:
+                        ptr = sh.data.unsafe_buffer_pointer()
+                    except Exception:
+                        ptr = None
+                    if ptr is not None:
+                        if (key, ptr) in seen:
+                            continue
+                        seen.add((key, ptr))
+                    per_dev[key] = per_dev.get(key, 0) + nbytes
+                except Exception:
+                    continue
     return per_dev
 
 

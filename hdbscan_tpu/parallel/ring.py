@@ -177,6 +177,7 @@ _RING_KNN_CACHE: dict = {}
 def _ring_knn_fn(
     mesh, k: int, metric: str, row_tile: int, col_tile: int,
     fused: bool = False, interpret: bool = False,
+    kth_only: int | None = None,
 ):
     """Build (or fetch) the jitted shard_map ring k-NN program.
 
@@ -184,8 +185,15 @@ def _ring_knn_fn(
     ``(best_d P(blocks), best_i P(blocks))``: each device's query shard ends
     up with its k nearest columns over the WHOLE (unpadded) column set, ids
     global, (distance, id)-lex ascending, (+inf, -1) padded.
+
+    ``kth_only`` (a column index into the k-list) slices the per-device
+    result INSIDE the program: the fn returns just that ``(shard,)`` column
+    — the only thing core distances need — so the materialized output is
+    O(n/D) per device instead of O(n/D * k). Bitwise the same values as
+    slicing the full list on the host; the ``--assert-not-replicated``
+    fit-path gate budget is what makes the distinction matter.
     """
-    key = (mesh, k, metric, row_tile, col_tile, fused, interpret)
+    key = (mesh, k, metric, row_tile, col_tile, fused, interpret, kth_only)
     fn = _RING_KNN_CACHE.get(key)
     if fn is not None:
         return fn
@@ -303,12 +311,21 @@ def _ring_knn_fn(
         best, bidx = scan_panel(panel, (me - (n_dev - 1)) % n_dev, best, bidx)
         return best, bidx
 
+    if kth_only is None:
+        body, out_specs = per_device, (P(BATCH_AXIS), P(BATCH_AXIS))
+    else:
+
+        def body(q, panel0, n_arr):
+            best, _ = per_device(q, panel0, n_arr)
+            return best[:, kth_only]
+
+        out_specs = P(BATCH_AXIS)
     fn = jax.jit(
         shard_map(
-            per_device,
+            body,
             mesh=mesh,
             in_specs=(P(BATCH_AXIS), P(BATCH_AXIS), P()),
-            out_specs=(P(BATCH_AXIS), P(BATCH_AXIS)),
+            out_specs=out_specs,
         )
     )
     _RING_KNN_CACHE[key] = fn
@@ -393,7 +410,15 @@ def ring_knn_core_distances(
         data_p = lanes
     rows = jax.device_put(data_p, row_sharding(mesh))
     n_arr = jax.device_put(np.asarray(n, np.int32), replicated(mesh))
-    fn = _ring_knn_fn(mesh, k, metric, row_tile, col_tile, fused=fused)
+    kth_col = min(max(min_pts - 1, 1), n) - 1
+    fetch_knn = fetch_knn or return_indices
+    # Core-only callers get the kth-column program: the device output is
+    # (shard,) per device, not (shard, k) — the sharded fit path's
+    # replication-gate budget has no room for the full lists.
+    fn = _ring_knn_fn(
+        mesh, k, metric, row_tile, col_tile, fused=fused,
+        kth_only=None if fetch_knn else kth_col,
+    )
 
     from hdbscan_tpu.utils.flops import counter as _flops
 
@@ -402,16 +427,22 @@ def ring_knn_core_distances(
         "ring_knn_scan", total=n_dev
     ) as hb:
         t0 = time.monotonic()
-        best_d, best_i = fn(rows, rows, n_arr)
+        if fetch_knn:
+            best_d, best_i = fn(rows, rows, n_arr)
+        else:
+            best_d, best_i = fn(rows, rows, n_arr), None
         walls = _per_device_walls(best_d, t0, beat=hb.beat)
         wall = time.monotonic() - t0
 
     from hdbscan_tpu.parallel.mesh import fetch
 
-    kth_col = min(max(min_pts - 1, 1), n) - 1
-    fetch_knn = fetch_knn or return_indices
     if not fetch_knn:
-        kth = np.asarray(fetch(best_d[:, kth_col]), np.float64)[:n]
+        kth = np.asarray(fetch(best_d), np.float64)[:n]
+        # Release device state eagerly (not at gc): lingering pieces of the
+        # scan otherwise stay resident into the Borůvka phase and charge
+        # against the --assert-not-replicated budget there.
+        best_d.delete()
+        rows.delete()
         _emit_ring_trace(
             trace, "ring_knn_scan", wall, walls, n_dev, 0, rows=n, shard=shard
         )
@@ -419,6 +450,9 @@ def ring_knn_core_distances(
         return core, None
     knn = np.asarray(fetch(best_d), np.float64)[:n]
     idx = np.asarray(fetch(best_i), np.int64)[:n] if return_indices else None
+    best_d.delete()
+    best_i.delete()
+    rows.delete()
     _emit_ring_trace(
         trace, "ring_knn_scan", wall, walls, n_dev, 0, rows=n, shard=shard
     )
@@ -481,7 +515,10 @@ def ring_knn_core_distances_rows(
         _pad_rows(np.asarray(data_np[row_ids], dtype), m_pad), row_sharding(mesh)
     )
     n_arr = jax.device_put(np.asarray(n, np.int32), replicated(mesh))
-    fn = _ring_knn_fn(mesh, k, metric, row_tile, col_tile)
+    kth_col = min(max(min_pts - 1, 1), n) - 1
+    # Only the kth column ever leaves the device here (boundary rescan):
+    # slice it inside the program so the output is O(m/D) per device.
+    fn = _ring_knn_fn(mesh, k, metric, row_tile, col_tile, kth_only=kth_col)
 
     from hdbscan_tpu.utils.flops import counter as _flops
 
@@ -490,14 +527,16 @@ def ring_knn_core_distances_rows(
         "ring_rows_scan", total=n_dev
     ) as hb:
         t0 = time.monotonic()
-        best_d, _ = fn(q, cols, n_arr)
+        best_d = fn(q, cols, n_arr)
         walls = _per_device_walls(best_d, t0, beat=hb.beat)
         wall = time.monotonic() - t0
 
     from hdbscan_tpu.parallel.mesh import fetch
 
-    kth_col = min(max(min_pts - 1, 1), n) - 1
-    kth = np.asarray(fetch(best_d[:, kth_col]), np.float64)[:m]
+    kth = np.asarray(fetch(best_d), np.float64)[:m]
+    best_d.delete()
+    q.delete()
+    cols.delete()
     _emit_ring_trace(
         trace, "ring_rows_scan", wall, walls, n_dev, 0, rows=m, cols=n,
         shard=shard,

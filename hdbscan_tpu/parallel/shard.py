@@ -1,0 +1,860 @@
+"""One sharded program: the end-to-end partitioned fit layer (ROADMAP item 1).
+
+Every earlier scale-out tier still REPLICATES the point set somewhere:
+``ops/tiled`` broadcasts the column panel, the rp-forest build walks a full
+``data_dev`` copy per tree, and the ring Borůvka glue returns replicated
+(n_comp,) winner arrays that are O(n) in the first rounds. This module is the
+composition layer that removes the last copies — the paper's partitioned
+premise (MapReduce recursive sampling) restated in JAX sharding vocabulary:
+
+* an explicit PARTITION-RULE table (``PARTITION_RULES``): a regex ->
+  ``PartitionSpec`` map over the fit's logical pytree (points / neighbors /
+  edges / forest / comp / scalars), applied with
+  :func:`match_partition_rules` and pinned at phase boundaries with
+  ``with_sharding_constraint`` (:func:`constrain`) so XLA cannot silently
+  replicate an intermediate between phases;
+* a row-sharded rp-forest build (:func:`shard_forest_core_distances`): each
+  device builds T rank-split trees over ITS OWN row shard (shared replicated
+  hyperplane normals are O(T · 2^depth · d) — the only broadcast), then a
+  PANDA-style bounded k-NN exchange circulates (panel points, per-shard
+  thresholds, per-shard leaf members) around the ring — every query routes
+  down each visiting shard's trees and lex-merges that leaf's candidates, so
+  the per-device working set stays O(n/D · d) and n is no longer capped by
+  one chip's HBM;
+* a fully row-sharded Borůvka round (:class:`ShardBoruvkaScanner`): the
+  component labels shard WITH the rows and circulate as a second panel
+  (where the ring scanner replicated them), and the per-row (weight, column)
+  winners come back row-sharded — the only O(n) hop is the per-round fetch
+  to the host contraction (``utils/unionfind.contract_min_edges``), the
+  Wang-et-al EMST shape of "all-gather edges only at contraction".
+
+``fit_sharding={auto,replicated,sharded}`` (``config.HDBSCANParams``)
+threads the layer through ``models/exact.fit`` — "auto" turns it on only on
+multi-device TPU meshes (CPU/test defaults unchanged), and the sharded
+program is the first end-to-end fit that runs green under the
+``--assert-not-replicated`` device-memory gate on a forced-8-device mesh.
+
+Parity contract: with ``knn_index="exact"`` the sharded fit is BITWISE
+identical to the single-device path (ring k-NN parity + per-row (w, j)-lex
+Borůvka winners match the host scanner's first-tile-wins rule, so the host
+contraction sees identical inputs). The sharded rp-forest tier is
+approximate by construction (per-shard trees differ from global trees) and
+is gated by recall/ARI like the replicated rp-forest tier.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+from hdbscan_tpu import obs
+from hdbscan_tpu.core.distances import METRICS, pairwise_distance
+from hdbscan_tpu.ops.tiled import _next_pow2, _pad_rows
+from hdbscan_tpu.parallel.mesh import (
+    BATCH_AXIS,
+    device_count,
+    fetch,
+    get_mesh,
+    replicated,
+    ring_permutation,
+    row_sharding,
+)
+from hdbscan_tpu.parallel.ring import (
+    _emit_ring_trace,
+    _per_device_walls,
+    _ring_geometry,
+)
+
+#: Valid ``fit_sharding`` values (``config.HDBSCANParams.fit_sharding``).
+FIT_SHARDINGS = ("auto", "replicated", "sharded")
+
+
+def resolve_fit_sharding(fit_sharding: str, mesh) -> str:
+    """Map the ``fit_sharding`` knob to the concrete program.
+
+    "replicated" and "sharded" are literal. "auto" picks the sharded
+    program only on a multi-device TPU mesh — the same policy as
+    ``ring.resolve_scan_backend`` — so CPU meshes and single chips keep the
+    replicated default and test/CI behavior is unchanged unless a test
+    forces "sharded" (the forced-8-device parity/gate suites do).
+    """
+    if fit_sharding not in FIT_SHARDINGS:
+        raise ValueError(
+            f"unknown fit_sharding {fit_sharding!r}: auto | replicated | sharded"
+        )
+    if fit_sharding != "auto":
+        return fit_sharding
+    if mesh is None:
+        return "replicated"
+    if device_count(mesh) > 1 and mesh.devices.flat[0].platform == "tpu":
+        return "sharded"
+    return "replicated"
+
+
+# ---------------------------------------------------------------------------
+# Partition-rule table. The fit's device state is named as a slash-joined
+# pytree path; the FIRST matching regex supplies the PartitionSpec (the
+# match_partition_rules idiom of the big-model trainers, SNIPPETS.md [2]).
+
+#: (regex over pytree paths) -> PartitionSpec. Row-major O(n) state shards
+#: along the batch axis; O(1)/O(log n) broadcast state (hyperplane normals,
+#: scalars) replicates. Order matters: first match wins.
+PARTITION_RULES: tuple[tuple[str, P], ...] = (
+    (r"^points/", P(BATCH_AXIS)),       # (n_pad, d) rows + circulating panels
+    (r"^neighbors/", P(BATCH_AXIS)),    # (n_pad, k) per-point candidate lists
+    (r"^edges/", P(BATCH_AXIS)),        # (n_pad,) per-row Borůvka winners
+    (r"^comp/", P(BATCH_AXIS)),         # (n_pad,) component labels
+    (r"^forest/normals", P()),          # (T, 2^depth - 1, d): the only broadcast
+    (r"^forest/", P(BATCH_AXIS)),       # per-shard thresholds + leaf members
+    (r"^scalars/", P()),                # 0-d bookkeeping
+)
+
+
+def _tree_paths(tree):
+    """Slash-joined string path per leaf, leaf order = tree_flatten order."""
+    paths = []
+    for kp, _ in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        parts = []
+        for entry in kp:
+            if hasattr(entry, "key"):
+                parts.append(str(entry.key))
+            elif hasattr(entry, "idx"):
+                parts.append(str(entry.idx))
+            else:  # pragma: no cover - defensive
+                parts.append(str(entry))
+        paths.append("/".join(parts))
+    return paths
+
+
+def match_partition_rules(rules, tree):
+    """PartitionSpec pytree for ``tree``: first rule whose regex searches the
+    leaf's slash-joined path wins. Unmatched leaves raise — an unnamed fit
+    buffer is exactly the silent replication this layer exists to prevent."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    specs = []
+    for path in _tree_paths(tree):
+        for pat, spec in rules:
+            if re.search(pat, path):
+                specs.append(spec)
+                break
+        else:
+            raise ValueError(f"no partition rule matches pytree path {path!r}")
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def partition_rule_table() -> list[dict]:
+    """JSON-serializable rule table for the run manifest
+    (``utils/telemetry.run_manifest``): the reviewable record of which fit
+    state shards and which replicates."""
+    return [
+        {"path": pat, "spec": str(spec)} for pat, spec in PARTITION_RULES
+    ]
+
+
+def constrain(tree, mesh):
+    """Pin ``tree`` to its matched partition specs with
+    ``with_sharding_constraint`` — called at phase boundaries INSIDE the
+    jitted programs so XLA's layout search cannot replicate an O(n)
+    intermediate across a phase seam."""
+    specs = match_partition_rules(PARTITION_RULES, tree)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, s)
+        ),
+        tree,
+        specs,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Sharded rp-forest: per-shard tree builds + ring-circulated candidate panels.
+
+#: (mesh, shard, d, trees, depth, dtype, is_build) -> compiled program.
+_SHARD_FOREST_CACHE: dict = {}
+
+
+def _shard_geometry(n: int, n_dev: int) -> tuple[int, int]:
+    """Per-device row count and padded total: ``shard = ceil(n / n_dev)``.
+    No tile rounding — the forest programs gather, they don't tile — so the
+    padding is < n_dev rows, all on the last device."""
+    shard = -(-n // n_dev)
+    return shard, shard * n_dev
+
+
+def _forest_build_fn(mesh, shard: int, depth: int, lmax: int, dtype):
+    """Jitted shard_map program: every device builds T rank-split trees over
+    its own row shard. In: rows P(blocks) (n_pad, d), normals P() (T,
+    nodes, d). Out: per-shard leaf members (local row ids) and heap-ordered
+    thresholds, both sharded along the stacked (device · tree) axis."""
+    from hdbscan_tpu.ops.rpforest import (
+        _build_geom,
+        _build_one_tree,
+        _level_segments,
+    )
+
+    key = (mesh, shard, depth, lmax, np.dtype(dtype).str, "build")
+    fn = _SHARD_FOREST_CACHE.get(key)
+    if fn is not None:
+        return fn
+    geom = _build_geom(shard, depth)
+    leaves = _level_segments(shard, depth)[depth]
+    pos_idx = np.zeros((len(leaves), lmax), np.int64)
+    for j, (s, e) in enumerate(leaves):
+        width = e - s
+        pos_idx[j, :width] = np.arange(s, e)
+        pos_idx[j, width:] = e - 1  # pad by repeating the last position
+    pos_idx_j = jnp.asarray(pos_idx)
+
+    def per_device(rows, normals):
+        perms, thrs = jax.vmap(
+            lambda nrm: _build_one_tree(rows, nrm, geom)
+        )(normals)
+        members = jnp.take(perms, pos_idx_j, axis=1).astype(jnp.int32)
+        return members, thrs
+
+    shmapped = shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(BATCH_AXIS), P()),
+        out_specs=(P(BATCH_AXIS), P(BATCH_AXIS)),
+    )
+
+    def program(rows, normals):
+        members, thrs = shmapped(rows, normals)
+        out = constrain(
+            {"forest": {"members": members, "thresholds": thrs}}, mesh
+        )
+        return out["forest"]["members"], out["forest"]["thresholds"]
+
+    fn = jax.jit(program)
+    _SHARD_FOREST_CACHE[key] = fn
+    return fn
+
+
+def _forest_sweep_fn(
+    mesh,
+    n: int,
+    shard: int,
+    trees: int,
+    depth: int,
+    k: int,
+    metric: str,
+    leaf_mask: np.ndarray,
+    dtype,
+):
+    """Jitted shard_map program for the PANDA-style bounded k-NN exchange.
+
+    The circulating panel is the triple (panel rows, panel leaf members,
+    panel thresholds) — three ``ppermute``s per step, issued BEFORE the
+    visit so the ICI exchange overlaps the gather+distance work (the ring
+    overlap contract; accelerator-guide ring-collective pattern). Per step
+    each device routes its resident queries down the VISITING shard's T
+    trees and lex-merges the visited leaves' members into its k-best — a
+    bounded exchange: O(T · Lmax) candidate rows per query per shard, never
+    a full panel scan. n_dev - 1 permutes per sweep, like every ring scan.
+    """
+    from hdbscan_tpu.ops.rpforest import _dedup_lex_merge, route_queries
+
+    key = (
+        mesh, n, shard, trees, depth, k, metric,
+        leaf_mask.tobytes(), np.dtype(dtype).str, "sweep",
+    )
+    fn = _SHARD_FOREST_CACHE.get(key)
+    if fn is not None:
+        return fn
+    n_dev = device_count(mesh)
+    perm = ring_permutation(n_dev)
+    sentinel = n
+    mask_j = jnp.asarray(leaf_mask)
+
+    def per_device(rows, members, thrs, normals):
+        me = jax.lax.axis_index(BATCH_AXIS)
+        my_gid = (me * shard + jnp.arange(shard)).astype(jnp.int32)
+        valid_q = my_gid < n
+        inf = jnp.asarray(jnp.inf, rows.dtype)
+        # Seed with self at distance 0 — guaranteed even if threshold
+        # routing sends a boundary point to a sibling of its build leaf.
+        best_d = jnp.full((shard, k), jnp.inf, rows.dtype)
+        best_i = jnp.full((shard, k), sentinel, jnp.int32)
+        best_d = best_d.at[:, 0].set(jnp.where(valid_q, 0.0, jnp.inf))
+        best_i = best_i.at[:, 0].set(jnp.where(valid_q, my_gid, sentinel))
+
+        def visit(p_rows, p_mem, p_thr, src, bd, bi):
+            off = (src * shard).astype(jnp.int32)
+            for t in range(trees):
+                node = route_queries(rows, normals[t], p_thr[t], depth)
+                mem = p_mem[t][node]            # (shard, Lmax) panel-local
+                gid = off + mem
+                cpts = p_rows[mem]              # (shard, Lmax, d)
+                cd = jax.vmap(
+                    lambda q, c: pairwise_distance(q[None, :], c, metric)[0]
+                )(rows, cpts)
+                ok = mask_j[node] & (gid < n) & valid_q[:, None]
+                cd = jnp.where(ok, cd, inf)
+                ci = jnp.where(ok, gid, sentinel)
+                bd, bi = _dedup_lex_merge(
+                    jnp.concatenate([bd, cd], axis=1),
+                    jnp.concatenate([bi, ci], axis=1),
+                    k,
+                    sentinel,
+                )
+            return bd, bi
+
+        def step(s, carry):
+            p_rows, p_mem, p_thr, bd, bi = carry
+            # Overlap: issue the three panel permutes before the visit.
+            nr = jax.lax.ppermute(p_rows, BATCH_AXIS, perm)
+            nm = jax.lax.ppermute(p_mem, BATCH_AXIS, perm)
+            nt = jax.lax.ppermute(p_thr, BATCH_AXIS, perm)
+            bd, bi = visit(p_rows, p_mem, p_thr, (me - s) % n_dev, bd, bi)
+            return nr, nm, nt, bd, bi
+
+        p_rows, p_mem, p_thr, best_d, best_i = jax.lax.fori_loop(
+            0, n_dev - 1, step, (rows, members, thrs, best_d, best_i)
+        )
+        # Last panel: visit only — exactly n_dev - 1 ppermutes per sweep.
+        best_d, best_i = visit(
+            p_rows, p_mem, p_thr, (me - (n_dev - 1)) % n_dev, best_d, best_i
+        )
+        return best_d, best_i
+
+    shmapped = shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(BATCH_AXIS), P(BATCH_AXIS), P(BATCH_AXIS), P()),
+        out_specs=(P(BATCH_AXIS), P(BATCH_AXIS)),
+    )
+
+    def program(rows, members, thrs, normals):
+        out = constrain(
+            {
+                "points": {"rows": rows},
+                "forest": {
+                    "members": members,
+                    "thresholds": thrs,
+                    "normals": normals,
+                },
+            },
+            mesh,
+        )
+        bd, bi = shmapped(
+            out["points"]["rows"],
+            out["forest"]["members"],
+            out["forest"]["thresholds"],
+            out["forest"]["normals"],
+        )
+        pinned = constrain({"neighbors": {"dist": bd, "ids": bi}}, mesh)
+        return pinned["neighbors"]["dist"], pinned["neighbors"]["ids"]
+
+    # The leaf-member panel is consumed in rotated copies only — donating it
+    # lets XLA reuse its buffer for the circulating panel (SNIPPETS.md [1]
+    # donate_argnums idiom).
+    fn = jax.jit(program, donate_argnums=(1,))
+    _SHARD_FOREST_CACHE[key] = fn
+    return fn
+
+
+def _host_recall(data: np.ndarray, best_i: np.ndarray, k: int, sample: int):
+    """Sampled recall@k against a host numpy brute-force scan (euclidean
+    only). The replicated tier samples recall on device against the full
+    data copy it already holds; here a device-side oracle would be the very
+    O(n) replication the gate forbids, so the oracle runs on host."""
+    n = len(data)
+    rows = np.unique(np.linspace(0, n - 1, num=min(sample, n), dtype=np.int64))
+    hits = 0
+    for r in rows:
+        d = np.linalg.norm(data - data[r], axis=1)
+        exact = np.lexsort((np.arange(n), d))[:k]
+        hits += len(np.intersect1d(exact, best_i[r][best_i[r] < n]))
+    return float(hits) / float(len(rows) * k), int(len(rows))
+
+
+def shard_forest_core_distances(
+    data: np.ndarray,
+    min_pts: int,
+    metric: str = "euclidean",
+    k: int | None = None,
+    *,
+    trees: int = 4,
+    leaf_size: int = 1024,
+    seed: int = 0,
+    dtype=np.float32,
+    mesh=None,
+    trace=None,
+    recall_sample: int = 256,
+    **_ignored,
+):
+    """Row-sharded rp-forest core distances: per-shard tree builds + the
+    ring-circulated candidate-panel exchange (module docstring).
+
+    Returns (n,) float64 core distances (min_pts-th smallest with self
+    included, zeros at ``min_pts <= 1``) — the ``fetch_knn=False`` contract
+    of the other core-distance engines. Unlike the replicated rp-forest
+    tier there is no global neighbor-of-neighbor rescan (it would gather
+    arbitrary rows across shards, i.e. replicate); the cross-shard panel
+    visits are the recall repair, quality-gated by the sampled
+    ``recall_at_k`` counter and the e2e ARI tests. ``**_ignored`` swallows
+    replicated-tier-only index_opts (``rescan_rounds``) so call sites can
+    pass one opts dict to either engine.
+    """
+    from hdbscan_tpu.ops.rpforest import (
+        _heap_base,
+        _level_segments,
+        forest_depth,
+    )
+
+    if metric not in METRICS:
+        raise ValueError(f"unknown metric {metric!r}; expected one of {METRICS}")
+    data = np.asarray(data)
+    n, d = data.shape
+    mesh = mesh if mesh is not None else get_mesh()
+    n_dev = device_count(mesh)
+    k_eff = max(k or 0, max(min_pts - 1, 1))
+    k_eff = min(k_eff, n)
+    shard, n_pad = _shard_geometry(n, n_dev)
+    # Same clamp as the replicated tier, applied at SHARD scale: every
+    # per-shard leaf must be able to supply a full candidate list.
+    leaf_size = min(max(leaf_size, 2 * k_eff + 2, 8), max(shard, 2))
+    depth = forest_depth(shard, leaf_size)
+    leaves = _level_segments(shard, depth)[depth]
+    lmax = max(e - s for s, e in leaves)
+    leaf_mask = np.zeros((len(leaves), lmax), bool)
+    for j, (s, e) in enumerate(leaves):
+        leaf_mask[j, : e - s] = True
+    num_nodes = _heap_base(depth)
+    rng = np.random.default_rng(np.random.SeedSequence([seed, shard, depth]))
+    normals = rng.standard_normal((trees, max(num_nodes, 1), d))
+    normals /= np.maximum(np.linalg.norm(normals, axis=-1, keepdims=True), 1e-12)
+
+    rows = jax.device_put(
+        _pad_rows(np.asarray(data, dtype), n_pad), row_sharding(mesh)
+    )
+    normals_dev = jax.device_put(normals.astype(dtype), replicated(mesh))
+
+    t0 = time.monotonic()
+    with obs.mem_phase("shard_knn_build"), obs.task(
+        "shard_knn_build", total=1
+    ) as hb:
+        build = _forest_build_fn(mesh, shard, depth, lmax, dtype)
+        members, thrs = build(rows, normals_dev)
+        members.block_until_ready()
+        hb.beat(1)
+    if trace is not None:
+        trace(
+            "shard_knn_build",
+            wall_s=round(time.monotonic() - t0, 6),
+            devices=n_dev,
+            trees=trees,
+            depth=depth,
+            leaf_size=leaf_size,
+            max_leaf=lmax,
+            n=n,
+            d=d,
+        )
+
+    from hdbscan_tpu.utils.flops import counter as _flops
+
+    # Each query visits T leaves in each of D shards: T·D·Lmax candidates.
+    _flops.add_scan(n_pad * trees * n_dev, lmax, d)
+    sweep = _forest_sweep_fn(
+        mesh, n, shard, trees, depth, k_eff, metric, leaf_mask, dtype
+    )
+    with obs.mem_phase("shard_knn_scan"), obs.task(
+        "shard_knn_scan", total=n_dev
+    ) as hb:
+        t0 = time.monotonic()
+        # The leaf-member panel is donated to the sweep; exclude the
+        # live-arrays sampler from the dispatch window (obs.donation_guard)
+        # so no sampler-held shard view co-owns the buffer when the
+        # donation transaction claims it.
+        with obs.donation_guard():
+            best_d, best_i = sweep(rows, members, thrs, normals_dev)
+            walls = _per_device_walls(best_d, t0, beat=hb.beat)
+        wall = time.monotonic() - t0
+    _emit_ring_trace(
+        trace, "shard_panel_sweep", wall, walls, n_dev, 0,
+        rows=n, trees=trees, shard=shard,
+    )
+
+    kth_col = min(max(min_pts - 1, 1), n) - 1
+    t0 = time.monotonic()
+    kth = np.asarray(fetch(best_d[:, kth_col]), np.float64)[:n]
+    if trace is not None:
+        fields = dict(
+            n=n,
+            k=k_eff,
+            trees=trees,
+            devices=n_dev,
+            candidates=trees * n_dev * lmax,
+        )
+        if recall_sample and metric == "euclidean":
+            ids = np.asarray(fetch(best_i), np.int64)[:n]
+            recall, rows_used = _host_recall(data, ids, k_eff, recall_sample)
+            fields["recall_at_k"] = recall
+            fields["recall_rows"] = rows_used
+        trace(
+            "shard_knn_exchange",
+            wall_s=round(time.monotonic() - t0, 6),
+            **fields,
+        )
+    # Free every device buffer of the forest pass eagerly — deferred
+    # deletion would otherwise keep the (shard, k) lists and tree panels
+    # resident into the Borůvka phase, charging its replication budget.
+    for arr in (best_d, best_i, members, thrs, rows, normals_dev):
+        arr.delete()
+    if min_pts <= 1:
+        return np.zeros(n, np.float64)
+    return kth
+
+
+def shard_core_distances(
+    data: np.ndarray,
+    min_pts: int,
+    metric: str = "euclidean",
+    *,
+    row_tile: int = 1024,
+    col_tile: int = 8192,
+    dtype=np.float32,
+    mesh=None,
+    trace=None,
+    knn_backend: str = "auto",
+    index: str = "exact",
+    index_opts: dict | None = None,
+) -> np.ndarray:
+    """Core distances under the sharded program: (n,) float64.
+
+    ``index="exact"`` delegates to the ring k-NN scan — already fully
+    row-sharded (queries, panels and per-point lists all P(blocks); only
+    the scalar n replicates), bitwise identical to the host scan.
+    ``index="rpforest"`` runs the row-sharded forest build + panel
+    exchange (:func:`shard_forest_core_distances`) instead of the
+    replicated forest.
+    """
+    mesh = mesh if mesh is not None else get_mesh()
+    if index == "rpforest":
+        return shard_forest_core_distances(
+            data, min_pts, metric, dtype=dtype, mesh=mesh, trace=trace,
+            **(index_opts or {}),
+        )
+    if index != "exact":
+        raise ValueError(f"unknown knn index {index!r}")
+    from hdbscan_tpu.parallel.ring import ring_knn_core_distances
+
+    core, _ = ring_knn_core_distances(
+        data, min_pts, metric, row_tile=row_tile, col_tile=col_tile,
+        dtype=dtype, fetch_knn=False, mesh=mesh, trace=trace,
+        knn_backend=knn_backend,
+    )
+    return core
+
+
+def shard_core_distances_rows(
+    data: np.ndarray,
+    row_ids: np.ndarray,
+    min_pts: int,
+    metric: str = "euclidean",
+    *,
+    dtype=np.float32,
+    mesh=None,
+    trace=None,
+    index: str = "exact",
+    index_opts: dict | None = None,
+) -> np.ndarray:
+    """Core distances for SELECTED rows under the sharded program — the
+    mr-hdbscan boundary-rescan contract ((m,) float64 aligned with
+    ``row_ids``). Exact rows ride the ring rows-scan (queries row-shard,
+    panels circulate); the forest tier answers from a full sharded pass and
+    slices, same as the replicated rp-forest rows path."""
+    mesh = mesh if mesh is not None else get_mesh()
+    row_ids = np.asarray(row_ids)
+    if index == "rpforest":
+        core = shard_forest_core_distances(
+            data, min_pts, metric, dtype=dtype, mesh=mesh, trace=trace,
+            recall_sample=0, **(index_opts or {}),
+        )
+        return core[row_ids]
+    if index != "exact":
+        raise ValueError(f"unknown knn index {index!r}")
+    from hdbscan_tpu.parallel.ring import ring_knn_core_distances_rows
+
+    return ring_knn_core_distances_rows(
+        data, row_ids, min_pts, metric, dtype=dtype, mesh=mesh, trace=trace,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fully row-sharded Borůvka rounds.
+
+#: (mesh, metric, row_tile, col_tile) -> compiled per-round program.
+_SHARD_BORUVKA_CACHE: dict = {}
+
+
+def _shard_boruvka_fn(mesh, metric: str, row_tile: int, col_tile: int):
+    """Jitted shard_map Borůvka round with ROW-SHARDED component labels.
+
+    The ring scanner replicates the dense component vector ((n,) int32 on
+    every device — O(n) replicated, which trips the gate in the early
+    rounds where n_comp ≈ n). Here the labels shard with their rows and
+    circulate as a second panel next to the augmented data panel (two
+    ``ppermute``s per step, both issued before the tile scan). Outputs are
+    the per-ROW best outgoing (weight, column) under the explicit (w, j)
+    lex tie-break, row-sharded — bitwise the host scanner's per-point
+    arrays (its ascending-column first-tile-wins rule IS the (w, j)-lex
+    min), so the host contraction and the emitted MST edges are identical.
+    """
+    key = (mesh, metric, row_tile, col_tile)
+    fn = _SHARD_BORUVKA_CACHE.get(key)
+    if fn is not None:
+        return fn
+    n_dev = device_count(mesh)
+    perm = ring_permutation(n_dev)
+
+    def per_device(rows_aug, comp_rows, n_arr):
+        me = jax.lax.axis_index(BATCH_AXIS)
+        shard = rows_aug.shape[0]
+        n_row_tiles = shard // row_tile
+        n_col_tiles = shard // col_tile
+        dtype = rows_aug.dtype
+        inf = jnp.array(jnp.inf, dtype)
+        n_pts = n_arr.astype(jnp.int32)
+        my_off = (me * shard).astype(jnp.int32)
+
+        def scan_panel(p_aug, p_comp, src, bw, bj):
+            off = (src * shard).astype(jnp.int32)
+
+            def row_step(r, carry):
+                bw, bj = carry
+                xr = jax.lax.dynamic_slice_in_dim(
+                    rows_aug, r * row_tile, row_tile
+                )[:, :-1]
+                cr = jax.lax.dynamic_slice_in_dim(
+                    rows_aug, r * row_tile, row_tile
+                )[:, -1]
+                kr = jax.lax.dynamic_slice_in_dim(
+                    comp_rows, r * row_tile, row_tile
+                )
+                vr = (
+                    my_off + r * row_tile
+                    + jnp.arange(row_tile, dtype=jnp.int32)
+                ) < n_pts
+                bw_r = jax.lax.dynamic_slice_in_dim(bw, r * row_tile, row_tile)
+                bj_r = jax.lax.dynamic_slice_in_dim(bj, r * row_tile, row_tile)
+
+                def col_step(c, carry2):
+                    bw_r, bj_r = carry2
+                    xc = jax.lax.dynamic_slice_in_dim(
+                        p_aug, c * col_tile, col_tile
+                    )[:, :-1]
+                    cc = jax.lax.dynamic_slice_in_dim(
+                        p_aug, c * col_tile, col_tile
+                    )[:, -1]
+                    kc = jax.lax.dynamic_slice_in_dim(
+                        p_comp, c * col_tile, col_tile
+                    )
+                    col0 = off + c * col_tile
+                    vc = (
+                        col0 + jnp.arange(col_tile, dtype=jnp.int32)
+                    ) < n_pts
+                    d = pairwise_distance(xr, xc, metric)
+                    w = jnp.maximum(d, jnp.maximum(cr[:, None], cc[None, :]))
+                    out = (kr[:, None] != kc[None, :]) & vc[None, :] & vr[:, None]
+                    w = jnp.where(out, w, inf)
+                    tw = jnp.min(w, axis=1)
+                    tj = jnp.argmin(w, axis=1).astype(jnp.int32) + col0
+                    # Explicit (w, j) lex: rotated panel arrival order must
+                    # not change the winner (= host ascending-column rule).
+                    upd = (tw < bw_r) | ((tw == bw_r) & (tj < bj_r))
+                    return (
+                        jnp.where(upd, tw, bw_r),
+                        jnp.where(upd, tj, bj_r),
+                    )
+
+                bw_r, bj_r = jax.lax.fori_loop(
+                    0, n_col_tiles, col_step, (bw_r, bj_r)
+                )
+                bw = jax.lax.dynamic_update_slice_in_dim(
+                    bw, bw_r, r * row_tile, axis=0
+                )
+                bj = jax.lax.dynamic_update_slice_in_dim(
+                    bj, bj_r, r * row_tile, axis=0
+                )
+                return bw, bj
+
+            return jax.lax.fori_loop(0, n_row_tiles, row_step, (bw, bj))
+
+        bw0 = jnp.full_like(rows_aug[:, -1], jnp.inf)
+        bj0 = jnp.full_like(comp_rows, -1)
+
+        def step(s, carry):
+            p_aug, p_comp, bw, bj = carry
+            # Overlap: both panel permutes issued before the tile scan.
+            na = jax.lax.ppermute(p_aug, BATCH_AXIS, perm)
+            nc = jax.lax.ppermute(p_comp, BATCH_AXIS, perm)
+            bw, bj = scan_panel(p_aug, p_comp, (me - s) % n_dev, bw, bj)
+            return na, nc, bw, bj
+
+        p_aug, p_comp, bw, bj = jax.lax.fori_loop(
+            0, n_dev - 1, step, (rows_aug, comp_rows, bw0, bj0)
+        )
+        bw, bj = scan_panel(p_aug, p_comp, (me - (n_dev - 1)) % n_dev, bw, bj)
+        return bw, bj
+
+    shmapped = shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(BATCH_AXIS), P(BATCH_AXIS), P()),
+        out_specs=(P(BATCH_AXIS), P(BATCH_AXIS)),
+    )
+
+    def program(rows_aug, comp_rows, n_arr):
+        pinned = constrain(
+            {"points": {"aug": rows_aug}, "comp": {"rows": comp_rows}}, mesh
+        )
+        bw, bj = shmapped(
+            pinned["points"]["aug"], pinned["comp"]["rows"], n_arr
+        )
+        out = constrain({"edges": {"weight": bw, "src": bj}}, mesh)
+        return out["edges"]["weight"], out["edges"]["src"]
+
+    # The component panel is rewritten every round — donate it so the round
+    # reuses the buffer instead of holding both generations live. The
+    # caller MUST pass a runtime-owned panel (see ``_owned_row_panel``):
+    # donating a zero-copy ``device_put`` view of host memory is undefined
+    # behavior.
+    fn = jax.jit(program, donate_argnums=(1,))
+    _SHARD_BORUVKA_CACHE[key] = fn
+    return fn
+
+
+# Jitted materializing copy: the output buffer is allocated and owned by
+# the runtime, unlike the possibly zero-copy host view device_put returns.
+_OWNED_COPY = jax.jit(jnp.copy)
+
+
+def _owned_row_panel(host_rows: np.ndarray, mesh):
+    """Upload a host panel into a runtime-OWNED row-sharded buffer.
+
+    ``jax.device_put`` of an aligned numpy array on CPU backends is
+    zero-copy: the returned jax.Array borrows numpy's memory. Donating
+    that borrowed buffer to a round program is undefined behavior — the
+    donation hands XLA memory the Python allocator still owns and may
+    recycle while the round is in flight. On the forced-8-device CPU mesh
+    this corrupted roughly one run in three (garbage MST edge weights,
+    timing-dependent: any concurrent thread shifted the allocator enough
+    to expose it). The jitted copy materializes a buffer the runtime owns
+    outright, which is the precondition for donating it.
+    """
+    return _OWNED_COPY(jax.device_put(host_rows, row_sharding(mesh)))
+
+
+class ShardBoruvkaScanner:
+    """Fully row-sharded drop-in for :class:`ops.tiled.BoruvkaScanner`.
+
+    Same ``min_outgoing(comp) -> (best_w, best_j)`` contract and bitwise
+    the same per-point arrays as the host scanner (see
+    :func:`_shard_boruvka_fn`), but every O(n) buffer — points, cores,
+    component labels, per-row winners — lives row-sharded: per-device HBM
+    is O(n/D · d) in every round. The per-round fetch of the (n,) winner
+    arrays to the host contraction is the "all-gather edges only at
+    contraction" step of the parallel-EMST shape: host memory, where O(n)
+    is fine; the ``--assert-not-replicated`` gate measures device memory.
+    """
+
+    def __init__(
+        self,
+        data: np.ndarray,
+        core: np.ndarray,
+        metric: str = "euclidean",
+        row_tile: int = 1024,
+        col_tile: int = 8192,
+        dtype=np.float32,
+        mesh=None,
+        trace=None,
+    ):
+        n = len(data)
+        self.n = n
+        self.d = np.asarray(data).shape[1]
+        self.metric = metric
+        self.mesh = mesh if mesh is not None else get_mesh()
+        self.n_dev = device_count(self.mesh)
+        self.trace = trace
+        self.row_tile, self.col_tile, self.shard, n_pad = _ring_geometry(
+            n, self.n_dev, row_tile, col_tile
+        )
+        self.n_pad = n_pad
+        aug = np.concatenate(
+            [np.asarray(data, dtype), np.asarray(core, dtype)[:, None]], axis=1
+        )
+        self._rows = jax.device_put(
+            _pad_rows(aug, n_pad), row_sharding(self.mesh)
+        )
+        self._n_arr = jax.device_put(
+            np.asarray(n, np.int32), replicated(self.mesh)
+        )
+        self._round = 0
+
+    def close(self) -> None:
+        """Delete the scanner's device buffers NOW. Dropping the Python
+        references alone leaves the row shards to the runtime's deferred
+        deletion, which keeps them resident through a successor program's
+        first rounds — phantom bytes that read as replication to the
+        fit-path memory gate when two scanners run back to back."""
+        for arr in (self._rows, self._n_arr):
+            try:
+                arr.delete()
+            except Exception:
+                pass
+
+    def min_outgoing(self, comp: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Per-point (best_w, best_j): minimum outgoing mutual-reachability
+        edge of every point's component seen from that point, (w, j)-lex."""
+        from hdbscan_tpu.utils.flops import counter as _flops
+
+        _flops.add_scan(self.n_pad, self.n_pad, self.d, row_tile=self.row_tile)
+        comp = np.asarray(comp)
+        fn = _shard_boruvka_fn(
+            self.mesh, self.metric, self.row_tile, self.col_tile
+        )
+        with obs.mem_phase("shard_boruvka_scan"), obs.task(
+            "shard_boruvka_scan", total=self.n_dev
+        ) as hb:
+            # The component panel is donated to the round program: it must
+            # be runtime-owned (``_owned_row_panel``), and the live-arrays
+            # sampler stays out of the window between its creation and the
+            # round's outputs being ready (obs.donation_guard).
+            with obs.donation_guard():
+                # Component labels are vertex ids (< n): int32 panel.
+                comp_dev = _owned_row_panel(
+                    _pad_rows(comp.astype(np.int32), self.n_pad), self.mesh
+                )
+                t0 = time.monotonic()
+                bw_dev, bj_dev = fn(self._rows, comp_dev, self._n_arr)
+                walls = _per_device_walls(bw_dev, t0, beat=hb.beat)
+            wall = time.monotonic() - t0
+
+        bw = np.asarray(fetch(bw_dev), np.float64)[: self.n]
+        bj = np.asarray(fetch(bj_dev), np.int64)[: self.n]
+        # Free the round's device outputs NOW: the runtime's deferred
+        # deletion otherwise keeps every round's (shard,) pieces resident
+        # through the next round's scan, and the accumulated O(n·rounds/D)
+        # bytes read as replication to the fit-path memory gate.
+        bw_dev.delete()
+        bj_dev.delete()
+        _emit_ring_trace(
+            self.trace, "shard_boruvka_scan", wall, walls, self.n_dev,
+            self._round,
+            n_comp=int(len(np.unique(comp))),
+            candidates=int(np.sum(bj >= 0)),
+        )
+        self._round += 1
+        return bw, bj

@@ -180,6 +180,7 @@ def run_manifest(params=None, argv=None, extra: dict | None = None) -> dict:
             "default_backend": jax.default_backend(),
             "knn_backend": getattr(params, "knn_backend", None),
             "scan_backend": getattr(params, "scan_backend", None),
+            "fit_sharding": getattr(params, "fit_sharding", None),
             "tree_backend": getattr(params, "tree_backend", None),
             "mst_backend": getattr(params, "mst_backend", None),
         },
@@ -187,6 +188,16 @@ def run_manifest(params=None, argv=None, extra: dict | None = None) -> dict:
         "env": env_overrides(),
         "peak_flops": flops.PEAK_FLOPS,
     }
+    if getattr(params, "fit_sharding", None) is not None:
+        # The reviewable record of which fit state shards and which
+        # replicates — the partition-rule table the sharded program pins at
+        # phase boundaries (``parallel/shard.py``).
+        from hdbscan_tpu.parallel.shard import partition_rule_table
+
+        manifest["sharding"] = {
+            "fit_sharding": params.fit_sharding,
+            "partition_rules": partition_rule_table(),
+        }
     if extra:
         manifest.update(json_sanitize(extra))
     return manifest

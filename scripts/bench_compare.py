@@ -46,6 +46,11 @@ HEADLINE = {
     "serve_chaos_p99_under_fault_ms_synthetic_5k": "lower",
     "stream_maintain_p99_ms_synthetic": "lower",
     "stream_maintain_ari_vs_scratch": "higher",
+    # bench.py mesh leg (README "One sharded program"): strong-scaling
+    # efficiency t1/(D*tD) of the sharded scan phases — direction-aware,
+    # bigger is better — and the per-device peak the replication gate saw.
+    "mesh_scan_scaling_efficiency_8dev": "higher",
+    "mesh_peak_device_bytes_max": "lower",
 }
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
@@ -114,6 +119,10 @@ def load_round(path: str) -> dict:
             ari = rec.get("maintain_ari_vs_scratch")
             if isinstance(ari, (int, float)):
                 metrics["stream_maintain_ari_vs_scratch"] = float(ari)
+        if name == "mesh_scan_scaling_efficiency_8dev":
+            peak = rec.get("mesh_peak_device_bytes_max")
+            if isinstance(peak, (int, float)):
+                metrics["mesh_peak_device_bytes_max"] = float(peak)
     m = _ROUND_RE.search(os.path.basename(path))
     return {
         "path": path,

@@ -119,6 +119,22 @@ non-empty string ``request_id``/``replica``, ``route`` in
 ``{/predict, /ingest}``, ``policy`` in ``{consistent_hash, least_loaded}``,
 an HTTP ``status`` int, positive ``attempts``, a finite non-negative
 ``queue_s`` and a boolean ``replied``.
+Sharded-fit events (``parallel/shard.py``, README "One sharded program")
+add five schemas: ``shard_knn_build`` must carry positive integer
+``devices``/``trees``/``depth``/``leaf_size``/``n``/``d`` with
+``max_leaf <= leaf_size``; ``shard_panel_sweep`` positive
+``devices``/``rows``/``trees``/``shard`` (its ``ppermute_steps ==
+devices - 1`` rides the generic ring invariant above);
+``shard_knn_exchange`` positive ``n``/``k``/``trees``/``devices``/
+``candidates`` and, when sampled, ``recall_at_k`` in [0, 1];
+``shard_boruvka_scan`` positive ``devices``/``n_comp``, non-negative
+``round``/``candidates``, a ``round`` that is CONTIGUOUS per process
+(each scan is exactly prev + 1, resetting to 0 when a new scanner
+starts) and an ``n_comp`` that STRICTLY DECREASES across a scanner's
+rounds — Borůvka contracts components every round or the fit is looping;
+``replication_gate`` must carry ``ok == true`` (the event only exists on
+a passing gate), a positive ``threshold_bytes``/``phases`` and a
+``worst_fraction`` in [0, 1).
 
 ``check_trace.py --join ROUTER.jsonl REPLICA.jsonl [REPLICA.jsonl ...]``
 validates every file, then joins the router's ``router_span`` events
@@ -190,6 +206,7 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
     last_wal_seq: dict = {}  # per-(process, wal) wal_append seq
     mem_running_max: dict = {}  # per-(process, phase) mem_sample running max
     hb_progress: dict = {}  # per-(process, phase, task) heartbeat progress
+    last_shard_round: dict = {}  # per-process (round, n_comp) Borůvka state
     with open(path, encoding="utf-8") as f:
         for lineno, line in enumerate(f, 1):
             line = line.strip()
@@ -376,6 +393,33 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
             if stage in ("fleet_route", "replica_health", "tenant_load",
                          "tenant_evict"):
                 errors += _check_fleet(path, lineno, stage, ev)
+            # Sharded-fit invariants (parallel/shard.py): per-event schemas
+            # in the helper; the round-contiguity and component-contraction
+            # checks need cross-event state so they live in this loop.
+            if stage in ("shard_knn_build", "shard_panel_sweep",
+                         "shard_knn_exchange", "shard_boruvka_scan",
+                         "replication_gate"):
+                errors += _check_shard(path, lineno, stage, ev)
+                if stage == "shard_boruvka_scan":
+                    rnd, nc = ev.get("round"), ev.get("n_comp")
+                    if _nonneg_int(rnd) and _pos_int(nc):
+                        prev = last_shard_round.get(proc)
+                        if rnd == 0:
+                            pass  # a fresh scanner restarts the sequence
+                        elif prev is None or rnd != prev[0] + 1:
+                            errors.append(
+                                f"{path}:{lineno}: shard_boruvka_scan round "
+                                f"{rnd} not contiguous (prev "
+                                f"{None if prev is None else prev[0]})"
+                            )
+                        elif nc >= prev[1]:
+                            errors.append(
+                                f"{path}:{lineno}: shard_boruvka_scan "
+                                f"n_comp {nc} did not decrease (prev "
+                                f"{prev[1]}) — Borůvka must contract "
+                                f"components every round"
+                            )
+                        last_shard_round[proc] = (rnd, nc)
             # Deep-observability invariants (hdbscan_tpu/obs): per-event
             # schemas in the helper; the peak-covers-samples and monotone-
             # progress checks need cross-event state so they live here.
@@ -828,6 +872,64 @@ def _check_fleet(path: str, lineno: int, stage: str, ev: dict) -> list[str]:
                         f"{where} {key}={ev.get(key)!r} not a "
                         f"non-negative int"
                     )
+    return errors
+
+
+def _check_shard(path: str, lineno: int, stage: str, ev: dict) -> list[str]:
+    """The five sharded-fit event schemas (parallel/shard.py): forest
+    build/sweep, bounded k-NN exchange, row-sharded Borůvka scan rounds and
+    the replication-gate verdict."""
+    errors: list[str] = []
+    where = f"{path}:{lineno}: {stage}"
+
+    def pos(*keys):
+        for key in keys:
+            if not _pos_int(ev.get(key)):
+                errors.append(
+                    f"{where} {key}={ev.get(key)!r} not a positive int"
+                )
+
+    def nonneg(*keys):
+        for key in keys:
+            if not _nonneg_int(ev.get(key)):
+                errors.append(
+                    f"{where} {key}={ev.get(key)!r} not a non-negative int"
+                )
+
+    if stage == "shard_knn_build":
+        pos("devices", "trees", "depth", "leaf_size", "max_leaf", "n", "d")
+        ml, ls = ev.get("max_leaf"), ev.get("leaf_size")
+        if _pos_int(ml) and _pos_int(ls) and ml > ls:
+            errors.append(f"{where} max_leaf={ml} > leaf_size={ls}")
+    elif stage == "shard_panel_sweep":
+        pos("devices", "rows", "trees", "shard")
+    elif stage == "shard_knn_exchange":
+        pos("n", "k", "trees", "devices", "candidates")
+        recall = ev.get("recall_at_k")
+        if recall is not None and (
+            not isinstance(recall, (int, float)) or isinstance(recall, bool)
+            or not (0.0 <= float(recall) <= 1.0)
+        ):
+            errors.append(f"{where} recall_at_k={recall!r} not in [0, 1]")
+    elif stage == "shard_boruvka_scan":
+        pos("devices", "n_comp")
+        nonneg("round", "candidates")
+    else:  # replication_gate
+        if ev.get("ok") is not True:
+            errors.append(
+                f"{where} ok={ev.get('ok')!r} — the event is only emitted "
+                f"on a passing gate, so ok must be true"
+            )
+        pos("threshold_bytes", "phases")
+        frac = ev.get("worst_fraction")
+        if not isinstance(frac, (int, float)) or isinstance(frac, bool) or (
+            not 0.0 <= float(frac) < 1.0
+        ):
+            errors.append(
+                f"{where} worst_fraction={frac!r} not in [0, 1) — a passing "
+                f"gate's worst device-phase growth is strictly under the "
+                f"threshold"
+            )
     return errors
 
 

@@ -376,6 +376,7 @@ for i in range(100_000):
 """
 
 
+@pytest.mark.slow
 def test_sigkill_loses_nothing_acked(tmp_path):
     """SIGKILL a live WAL writer mid-stream: recovery replays every append
     the child acked (fsync-before-ack), at most one unacked extra."""
@@ -420,6 +421,7 @@ def test_sigkill_loses_nothing_acked(tmp_path):
     jr.close()
 
 
+@pytest.mark.slow
 def test_crash_recovery_matches_uninterrupted_stream(fitted, tmp_path):
     """Server crash-sim mid-stream: a WAL-recovered server finishes the
     stream with a refit pool bitwise identical to an uninterrupted run, so

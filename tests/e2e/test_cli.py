@@ -62,3 +62,77 @@ class TestCLI:
     def test_help(self, capsys):
         assert main(["--help"]) == 0
         assert "minPts" in capsys.readouterr().out
+
+
+def _two_blob_csv(tmp_path, n=8192):
+    """n=8192, d=2 is the smallest geometry where the DEFAULT ring tiles
+    give a padded shard of exactly n/8 rows per device on the forced
+    8-device mesh — the shape the README's HBM-ceiling math assumes and
+    the one the replication gate certifies with ~0.6x headroom."""
+    rng = np.random.default_rng(7)
+    pts = np.concatenate(
+        [
+            rng.normal(0.0, 1.0, (n // 2, 2)),
+            rng.normal(8.0, 1.0, (n - n // 2, 2)),
+        ]
+    )
+    rng.shuffle(pts)
+    path = tmp_path / "blobs.csv"
+    np.savetxt(path, pts, delimiter=",")
+    return path
+
+
+class TestShardedFitCLI:
+    """``fit_sharding=sharded`` + ``--assert-not-replicated``: the ISSUE
+    acceptance pair at the CLI surface (README "One sharded program")."""
+
+    def test_sharded_fit_green_under_gate(self, tmp_path, capsys):
+        trace = tmp_path / "trace.jsonl"
+        rc = main(
+            [
+                f"file={_two_blob_csv(tmp_path)}",
+                "minPts=5",
+                "minClSize=10",
+                "fit_sharding=sharded",
+                "--assert-not-replicated",
+                "--trace-out",
+                str(trace),
+                f"out_dir={tmp_path}",
+            ]
+        )
+        assert rc == 0
+        assert "exact-sharded" in capsys.readouterr().out
+        # The emitted trace must satisfy the sharded-fit event schemas
+        # (scripts/check_trace.py): ring rounds contiguous, Borůvka
+        # components strictly contracting, gate event ok=True.
+        from scripts import check_trace
+
+        events, errors = check_trace.validate_trace(str(trace))
+        assert not errors, errors
+        stages = {e.get("stage") for e in events}
+        # Exact sharded fit = ring k-NN scan for cores + sharded Borůvka
+        # for the MST, certified by the gate event (the rp-forest stages
+        # only appear under knn_index=rpforest).
+        assert {
+            "ring_knn_scan",
+            "shard_boruvka_scan",
+            "replication_gate",
+        } <= stages
+
+    @pytest.mark.slow
+    def test_replicated_fit_trips_gate(self, tmp_path, capsys):
+        """The negative control: the replicated program materializes O(n)
+        buffers whole on every device, and the SAME gate must refuse it
+        with the documented exit code 3."""
+        rc = main(
+            [
+                f"file={_two_blob_csv(tmp_path)}",
+                "minPts=5",
+                "minClSize=10",
+                "fit_sharding=replicated",
+                "--assert-not-replicated",
+                f"out_dir={tmp_path}",
+            ]
+        )
+        assert rc == 3
+        assert "replicated device buffer" in capsys.readouterr().err

@@ -1,0 +1,174 @@
+"""The one sharded program (``parallel/shard.py``): partition rules, the
+``fit_sharding`` resolver, bitwise parity of the end-to-end sharded fit vs
+the single-device path on the forced-8-device mesh, and the replication
+gate run over a REAL sharded fit (plus the deliberately-replicated control
+that must trip it)."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from hdbscan_tpu import obs
+from hdbscan_tpu.config import HDBSCANParams
+from hdbscan_tpu.models import exact
+from hdbscan_tpu.obs import MemoryAuditor, ReplicatedBufferError
+from hdbscan_tpu.parallel import shard
+from hdbscan_tpu.parallel.mesh import BATCH_AXIS, get_mesh
+from tests.conftest import make_blobs
+
+
+class TestPartitionRules:
+    def test_first_match_wins(self):
+        tree = {
+            "points": {"rows": 0},
+            "forest": {"normals": 1, "thresholds": 2},
+            "comp": {"labels": 3},
+            "edges": {"bw": 4},
+            "neighbors": {"ids": 5},
+            "scalars": {"n": 6},
+        }
+        specs = shard.match_partition_rules(shard.PARTITION_RULES, tree)
+        assert specs["points"]["rows"] == P(BATCH_AXIS)
+        assert specs["neighbors"]["ids"] == P(BATCH_AXIS)
+        assert specs["edges"]["bw"] == P(BATCH_AXIS)
+        assert specs["comp"]["labels"] == P(BATCH_AXIS)
+        # forest/normals is the ONE broadcast rule and must shadow forest/
+        assert specs["forest"]["normals"] == P()
+        assert specs["forest"]["thresholds"] == P(BATCH_AXIS)
+        assert specs["scalars"]["n"] == P()
+
+    def test_unmatched_leaf_raises(self):
+        with pytest.raises(ValueError, match="no partition rule"):
+            shard.match_partition_rules(
+                shard.PARTITION_RULES, {"mystery": {"buffer": 0}}
+            )
+
+    def test_rule_table_is_serializable(self):
+        table = shard.partition_rule_table()
+        assert len(table) == len(shard.PARTITION_RULES)
+        json.dumps(table)  # manifest contract: plain JSON
+        for row in table:
+            assert set(row) == {"path", "spec"}
+
+    def test_constrain_pins_specs_and_preserves_values(self):
+        mesh = get_mesh()
+        tree = {
+            "points": {"rows": np.arange(48, dtype=np.float32).reshape(16, 3)},
+            "scalars": {"n": np.float32(16.0)},
+        }
+        out = shard.constrain(
+            jax.tree_util.tree_map(jax.numpy.asarray, tree), mesh
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out["points"]["rows"]), tree["points"]["rows"]
+        )
+        rows_sharding = out["points"]["rows"].sharding
+        assert rows_sharding.spec == P(BATCH_AXIS)
+        assert out["scalars"]["n"].sharding.is_fully_replicated
+
+
+class TestResolveFitSharding:
+    def test_literal_values_pass_through(self):
+        mesh = get_mesh()
+        assert shard.resolve_fit_sharding("replicated", mesh) == "replicated"
+        assert shard.resolve_fit_sharding("sharded", None) == "sharded"
+
+    def test_auto_stays_replicated_off_tpu(self):
+        # CPU mesh (the forced-8-device test mesh) and no mesh at all both
+        # keep the replicated default; only a multi-device TPU mesh flips.
+        assert shard.resolve_fit_sharding("auto", None) == "replicated"
+        assert shard.resolve_fit_sharding("auto", get_mesh()) == "replicated"
+
+    def test_unknown_value_raises(self):
+        with pytest.raises(ValueError, match="fit_sharding"):
+            shard.resolve_fit_sharding("mirrored", None)
+
+    def test_params_validation_rejects_unknown(self):
+        with pytest.raises(ValueError, match="fit_sharding"):
+            HDBSCANParams(fit_sharding="mirrored")
+
+
+class TestShardedFitParity:
+    def test_scanner_matches_host_scanner(self, rng):
+        from hdbscan_tpu.ops.tiled import BoruvkaScanner
+
+        pts, _ = make_blobs(rng, n=520, d=3, centers=3)
+        core = rng.uniform(0.0, 0.2, size=520)
+        comp = rng.integers(0, 11, size=520)
+        host = BoruvkaScanner(pts, core, row_tile=64, col_tile=128)
+        dist = shard.ShardBoruvkaScanner(
+            pts, core, row_tile=64, col_tile=128, mesh=get_mesh()
+        )
+        bw1, bj1 = host.min_outgoing(comp)
+        bw2, bj2 = dist.min_outgoing(comp)
+        # Bitwise, not approximate: both run the same f32 kernel with the
+        # same (w, j)-lexicographic tie-break.
+        np.testing.assert_array_equal(bj2, bj1)
+        np.testing.assert_array_equal(bw2, bw1)
+
+    def test_fit_bitwise_matches_single_device(self, rng):
+        pts, _ = make_blobs(rng, n=640, d=3, centers=3)
+        base = dict(min_points=5, min_cluster_size=15, mst_backend="host")
+        single = exact.fit(
+            pts, HDBSCANParams(fit_sharding="replicated", **base)
+        )
+        sharded = exact.fit(
+            pts,
+            HDBSCANParams(fit_sharding="sharded", **base),
+            mesh=get_mesh(),
+            row_tile=64,
+            col_tile=128,
+        )
+        np.testing.assert_array_equal(sharded.labels, single.labels)
+        np.testing.assert_array_equal(
+            sharded.outlier_scores, single.outlier_scores
+        )
+        np.testing.assert_array_equal(
+            sharded.core_distances, single.core_distances
+        )
+        for got, want in zip(sharded.mst, single.mst):
+            np.testing.assert_array_equal(got, want)
+
+
+class TestReplicationGateOnFit:
+    def test_sharded_fit_passes_gate_and_replicated_leak_trips_it(self, rng):
+        """The ISSUE acceptance pair in one auditor: the end-to-end sharded
+        fit stays under ``slack * n * itemsize`` per device on the
+        8-device mesh, and a deliberately replicated O(n) buffer injected
+        as an extra audited phase flips the SAME gate to failure."""
+        n, d = 2048, 2
+        pts, _ = make_blobs(rng, n=n, d=d, centers=3, spread=0.4)
+        mesh = get_mesh()
+        aud = MemoryAuditor(interval_s=0.01, source="live_arrays")
+        with obs.installed(auditor=aud):
+            result = exact.fit(
+                pts,
+                HDBSCANParams(
+                    min_points=5, min_cluster_size=20, fit_sharding="sharded"
+                ),
+                mesh=mesh,
+                # row_tile/col_tile sized so the padded shard equals n/8 —
+                # the gate budget assumes per-device rows ~ n/devices.
+                row_tile=128,
+                col_tile=256,
+            )
+            assert len(result.labels) == n
+            gate = obs.assert_not_replicated(n, pts.dtype.itemsize)
+            assert gate["threshold_bytes"] == pytest.approx(0.5 * n * 8)
+            assert "core_distances" in gate["phases"]
+            assert "boruvka_mst" in gate["phases"]
+            assert 0 < gate["worst_fraction"] < 1.0
+
+            # Control: replicate the point set whole onto every device
+            # inside an audited phase — the exact bug class the sharded
+            # program exists to rule out — and the gate must trip.
+            with obs.mem_phase("leak"):
+                bad = jax.device_put(pts, NamedSharding(mesh, P()))
+                bad.block_until_ready()
+            with pytest.raises(ReplicatedBufferError, match="leak"):
+                obs.assert_not_replicated(n, pts.dtype.itemsize)
+            del bad
