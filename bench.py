@@ -235,7 +235,9 @@ def _slo(argv: list[str]) -> None:
     nearest-rank p50/p99/p999, rows/s, the histogram-vs-raw p99
     cross-check, and the target-vs-attainment SLO verdict.
 
-    ``bench.py slo [--quick] [--trace-out PATH] [--report PATH]``
+    ``bench.py slo [--quick] [--trace-out PATH] [--report PATH]
+    [--flight-dir DIR]`` — with ``--flight-dir``, a failed SLO verdict
+    dumps a flight-recorder bundle (``scripts/check_flight.py`` validates).
     """
     import urllib.request
 
@@ -251,6 +253,7 @@ def _slo(argv: list[str]) -> None:
     argv_full = ["slo", *argv]
     trace_out = _pop_path_flag(argv, "--trace-out")
     report_out = _pop_path_flag(argv, "--report")
+    flight_dir = _pop_path_flag(argv, "--flight-dir")
     duration, warmup = 8.0, 1.0
     if "--quick" in argv:
         argv.remove("--quick")
@@ -260,6 +263,19 @@ def _slo(argv: list[str]) -> None:
 
     sinks = [JsonlSink(trace_out, static={"process": 0})] if trace_out else []
     tracer = Tracer(sinks=sinks)
+    # Flight recorder (README "Deep observability"): rides the leg's trace
+    # stream and dumps a post-mortem bundle when the SLO verdict fails, so
+    # a red bench row ships its own evidence (event tail, heartbeats,
+    # thread stacks). A green run writes nothing.
+    flight = None
+    if flight_dir is not None:
+        from hdbscan_tpu.obs.flightrec import FlightRecorder
+
+        flight = FlightRecorder(
+            flight_dir, manifest={"bench": "slo", "argv": argv_full},
+            tracer=tracer,
+        )
+        tracer.add_sink(flight)
     # Per-phase device-memory auditor (README "Observability"): installed
     # BEFORE the synthetic fit so the leg's JSON line and report carry the
     # fit's per-phase watermarks, not just start/end snapshots.
@@ -355,6 +371,13 @@ def _slo(argv: list[str]) -> None:
         "error_rate": closed.errors / max(closed.errors + closed.requests, 1),
     }
     verdict = telemetry.slo_verdict(observed, SLO_TARGETS)
+    if flight is not None and not verdict["ok"]:
+        bundle = flight.dump(
+            "slo_breach",
+            extra={"observed": observed, "targets": verdict["targets"]},
+            emit_event=False,  # the trace sinks are already closed
+        )
+        print(f"[bench] slo flight bundle: {bundle}", file=sys.stderr)
     open_pct = opened.percentiles()
 
     f1, f4 = fleet[1], fleet[4]
@@ -883,8 +906,11 @@ def _mesh_leg(argv: list[str]) -> None:
         file=sys.stderr,
     )
 
+    from hdbscan_tpu.obs import TimelineRecorder
+
     auditor = MemoryAuditor(source="auto")
-    obs.install(auditor=auditor)
+    timeline = TimelineRecorder()
+    obs.install(auditor=auditor, timeline=timeline)
     try:
         core8_s, mst8_s, edges8 = time_phases(mesh8)
         gate = obs.assert_not_replicated(n, data.dtype.itemsize)
@@ -893,6 +919,20 @@ def _mesh_leg(argv: list[str]) -> None:
     parity_ok = all(
         np.array_equal(a, b) for a, b in zip(edges1, edges8)
     )
+    # Timeline join: comm/compute attribution, worst per-round skew, and
+    # model-flops MFU over the ring phases the 8-device run traced.
+    from hdbscan_tpu.utils.flops import PEAK_FLOPS
+
+    tl_table = timeline.phase_table()
+    tl_comm = sum(p["comm_s"] for p in tl_table.values())
+    tl_attr = sum(
+        p["compute_s"] + p["comm_s"] + p["host_s"] for p in tl_table.values()
+    )
+    tl_wall = sum(p["wall_s"] for p in tl_table.values())
+    tl_flops = sum(p["flops"] for p in tl_table.values())
+    comm_frac = round(tl_comm / tl_attr, 4) if tl_attr > 0 else 0.0
+    skew = round(max((p["skew"] for p in tl_table.values()), default=1.0), 4)
+    mfu = round(tl_flops / tl_wall / PEAK_FLOPS, 6) if tl_wall > 0 else 0.0
     peaks = {
         phase: wm["max_device_bytes"]
         for phase, wm in auditor.watermark_table().items()
@@ -917,7 +957,8 @@ def _mesh_leg(argv: list[str]) -> None:
         f"mst={mst8_s:.3f}s (eff {phases['boruvka_mst']['efficiency']}) "
         f"parity={parity_ok} gate_ok=True "
         f"worst_fraction={gate['worst_fraction']} "
-        f"peak_device_bytes={max(peaks.values())}",
+        f"peak_device_bytes={max(peaks.values())} "
+        f"comm_frac={comm_frac} skew={skew} mfu={mfu}",
         file=sys.stderr,
     )
     print(
@@ -938,6 +979,10 @@ def _mesh_leg(argv: list[str]) -> None:
                 "mesh_gate_phases": gate["phases"],
                 "mesh_peak_device_bytes": peaks,
                 "mesh_peak_device_bytes_max": max(peaks.values()),
+                "mesh_comm_frac": comm_frac,
+                "mesh_skew": skew,
+                "mesh_mfu": mfu,
+                "mesh_timeline": tl_table,
                 "mesh_linear_target": 0.8,
                 "platform": platform,
                 "cpu_smoke": platform != "tpu",

@@ -18,7 +18,8 @@ Usage mirrors the reference's documented contract (``main/Main.java:534-614``)::
         [mst_backend={auto,host,device}] \
         [consensus=N] [compat_cf={true,false}] \
         [clusterName={local,auto,<host:port>,<pid>,<np>}] \
-        [heartbeat=F] [watchdog=F] [--assert-not-replicated] \
+        [heartbeat=F] [watchdog=F] [skew_threshold=F] [straggler_rounds=N] \
+        [trace_rotate_bytes=N] [--assert-not-replicated] [--flight-dir DIR] \
         [--trace-out PATH] [--report PATH] [--compile-cache {auto,off,DIR}]
 
 Telemetry (README "Observability"): ``--trace-out PATH`` appends every
@@ -40,6 +41,22 @@ to the trace and stderr when no phase beats within F seconds (0 = off).
 ``--assert-not-replicated`` checks the audited watermarks after the fit and
 exits nonzero if any single device's memory grew by ~n*itemsize during a
 sharded phase — i.e. an O(n) buffer was replicated instead of sharded.
+
+Mesh timelines + flight recorder (README "Deep observability"): with either
+telemetry flag, every sharded/ring round also decomposes into per-device
+``device_timeline`` events (telescoping compute/comm/host segments,
+``attribution: model``) and the report gains ``timeline`` + ``roofline``
+sections (``hdbscan-tpu-report/3``). ``skew_threshold=F`` (default 2.0) and
+``straggler_rounds=N`` (default 3) tune the straggler detector: a device at
+>= F x the round-median wall for N consecutive rounds emits
+``straggler_flag`` events. ``trace_rotate_bytes=N`` (default 256 MiB, 0 =
+off) rotates ``--trace-out`` files to ``<path>.1`` at the bound.
+``--flight-dir DIR`` arms the flight recorder: a bounded in-memory ring of
+recent trace events that writes a self-contained post-mortem bundle
+(``flight-<pid>-<seq>-<reason>.json`` — event tail, heartbeats, thread
+stacks, watermarks, manifest; validate with ``scripts/check_flight.py``)
+on watchdog stall, replication-gate trip, unhandled fit exception, or
+SIGTERM. A healthy run writes nothing.
 
 ``knn_index`` picks the neighbor-graph TIER (README "Approximate
 neighbors"): ``exact`` (default) keeps the O(n²) scans bitwise-unchanged,
@@ -254,6 +271,7 @@ def _main_fit(argv: list[str]) -> int:
         report_out = _pop_path_flag(argv, "--report")
         compile_cache_flag = _pop_path_flag(argv, "--compile-cache")
         model_out = _pop_path_flag(argv, "--model-out")
+        flight_dir = _pop_path_flag(argv, "--flight-dir")
         assert_not_replicated = _pop_bool_flag(argv, "--assert-not-replicated")
         params = HDBSCANParams.from_args(argv)
         if compile_cache_flag is not None:
@@ -340,7 +358,11 @@ def _main_fit(argv: list[str]) -> int:
             trace_path = telemetry.trace_path_for_process(
                 trace_out, jax.process_index(), n_proc
             )
-            sinks.append(JsonlSink(trace_path, static={"process": jax.process_index()}))
+            sinks.append(JsonlSink(
+                trace_path,
+                static={"process": jax.process_index()},
+                rotate_bytes=params.trace_rotate_bytes,
+            ))
     tracer = Tracer(
         stream=sys.stderr if os.environ.get("HDBSCAN_TPU_TRACE") else None,
         sinks=sinks,
@@ -354,10 +376,17 @@ def _main_fit(argv: list[str]) -> int:
     from hdbscan_tpu import obs
 
     installed_obs = False
+    tl_rec = None
     if (telemetry_on or assert_not_replicated) and obs.auditor() is None:
         from hdbscan_tpu.obs.audit import MemoryAuditor
         from hdbscan_tpu.obs.heartbeat import Heartbeats
+        from hdbscan_tpu.obs.timeline import TimelineRecorder
 
+        tl_rec = TimelineRecorder(
+            skew_threshold=params.obs_skew_threshold,
+            straggler_rounds=params.obs_straggler_rounds,
+            trace=tracer,
+        )
         obs.install(
             auditor=MemoryAuditor(tracer=tracer),
             heartbeats=Heartbeats(
@@ -365,8 +394,44 @@ def _main_fit(argv: list[str]) -> int:
                 heartbeat_s=params.heartbeat_s,
                 watchdog_s=params.watchdog_s,
             ),
+            timeline=tl_rec,
         )
         installed_obs = True
+
+    # Flight recorder (README "Deep observability"): always-on bounded ring
+    # over the trace stream; dumps a post-mortem bundle to --flight-dir on
+    # watchdog stall (sniffed from the stream), replication-gate trip,
+    # unhandled fit exception, or SIGTERM. A healthy run writes no files.
+    flight = None
+    if flight_dir is not None:
+        from hdbscan_tpu.obs.flightrec import FlightRecorder
+        from hdbscan_tpu.utils import telemetry as _tm
+
+        flight = FlightRecorder(
+            flight_dir,
+            manifest=_tm.run_manifest(params, argv=argv_full),
+            tracer=tracer,
+        )
+        tracer.add_sink(flight)
+        obs.install(flight=flight)
+        installed_obs = True
+        import signal
+        import threading as _threading
+
+        if _threading.current_thread() is _threading.main_thread():
+            prev_term = signal.getsignal(signal.SIGTERM)
+
+            def _on_sigterm(signum, frame):
+                try:
+                    flight.dump("sigterm")
+                finally:
+                    signal.signal(
+                        signal.SIGTERM,
+                        prev_term if callable(prev_term) else signal.SIG_DFL,
+                    )
+                    signal.raise_signal(signal.SIGTERM)
+
+            signal.signal(signal.SIGTERM, _on_sigterm)
 
     mem_start = None
     if report_out is not None:
@@ -422,6 +487,11 @@ def _main_fit(argv: list[str]) -> int:
             try:
                 gate = obs.assert_not_replicated(n, data.dtype.itemsize)
             except ReplicatedBufferError as e:
+                if flight is not None:
+                    try:
+                        flight.dump("replication_gate", extra={"error": str(e)})
+                    except Exception:
+                        pass
                 print(f"error: replicated device buffer: {e}", file=sys.stderr)
                 return 3
             except RuntimeError as e:
@@ -478,6 +548,15 @@ def _main_fit(argv: list[str]) -> int:
                 print("phases:", file=sys.stderr)
                 for line in summary.splitlines():
                     print(f"  {line}", file=sys.stderr)
+    except BaseException as e:
+        # The black box's whole point: an unhandled fit crash leaves a
+        # bundle behind even though the process is about to die.
+        if flight is not None and not isinstance(e, SystemExit):
+            try:
+                flight.dump("exception", extra={"error": repr(e)})
+            except Exception:
+                pass
+        raise
     finally:
         # Uninstall the fit's auditor/heartbeats (stops the watchdog thread)
         # before the tracer flushes — nothing may emit after close.
@@ -528,6 +607,9 @@ def _main_fit(argv: list[str]) -> int:
                     "end": telemetry.sample_device_memory(),
                 },
                 per_host=per_host,
+                timeline=(
+                    tl_rec.phase_table() if tl_rec is not None else None
+                ),
             ),
         )
     return 0

@@ -402,6 +402,20 @@ class HDBSCANParams:
     #: request, forever — cannot grow without limit. Dropped events are
     #: counted (``Tracer.events_dropped``) and noted in the summary.
     trace_max_events: int = 100_000
+    #: Straggler trip ratio for the per-device timeline recorder
+    #: (``hdbscan_tpu/obs/timeline.py``): a device whose per-round wall is
+    #: >= this multiple of the round's median wall counts as slow. Must be
+    #: >= 1.
+    obs_skew_threshold: float = 2.0
+    #: Consecutive slow rounds before a ``straggler_flag`` event fires (and
+    #: ``hdbscan_tpu_straggler_flags_total{device}`` increments). Must be
+    #: >= 1.
+    obs_straggler_rounds: int = 3
+    #: JSONL trace-file rotation bound in bytes (``JsonlSink``): when the
+    #: next line would push ``trace.jsonl`` past this size it moves to
+    #: ``trace.jsonl.1`` and a fresh file opens (seq continues; at most two
+    #: files ever exist). 0 disables rotation. Default 256 MiB.
+    trace_rotate_bytes: int = 268_435_456
     # Output file names derived from the input path (main/Main.java:516-526):
 
     def __post_init__(self):
@@ -589,6 +603,21 @@ class HDBSCANParams:
                 "watchdog_s must be >= 0 (0 = watchdog off), "
                 f"got {self.watchdog_s!r}"
             )
+        if not self.obs_skew_threshold >= 1.0:
+            raise ValueError(
+                "obs_skew_threshold must be >= 1.0, "
+                f"got {self.obs_skew_threshold!r}"
+            )
+        if self.obs_straggler_rounds < 1:
+            raise ValueError(
+                "obs_straggler_rounds must be >= 1, "
+                f"got {self.obs_straggler_rounds!r}"
+            )
+        if self.trace_rotate_bytes < 0:
+            raise ValueError(
+                "trace_rotate_bytes must be >= 0 (0 = rotation off), "
+                f"got {self.trace_rotate_bytes!r}"
+            )
         if self.trace_max_events < 0:
             raise ValueError(
                 "trace_max_events must be >= 0 (0 = unbounded), "
@@ -708,6 +737,9 @@ FLAG_FIELDS = {
     "heartbeat": ("heartbeat_s", float),
     "watchdog": ("watchdog_s", float),
     "trace_max_events": ("trace_max_events", int),
+    "skew_threshold": ("obs_skew_threshold", float),
+    "straggler_rounds": ("obs_straggler_rounds", int),
+    "trace_rotate_bytes": ("trace_rotate_bytes", int),
     "max_samples": ("max_samples", int),
     "compat_cf": ("compat_cf_int_math", _bool),
 }

@@ -451,6 +451,20 @@ def build_merge_forest_device(
         t0 = time.monotonic()
         fetched = jax.device_get(out)
         sync_wall = time.monotonic() - t0
+    tl = obs.timeline()
+    if tl is not None:
+        # Single-device phase: the event stream lives on one chip and the
+        # only host segment is the one fetch. No ring traffic -> the whole
+        # exec wall attributes to compute.
+        try:
+            leaf = jax.tree_util.tree_leaves(out)[0]
+            dev_id = min(d.id for d in leaf.devices())
+        except Exception:
+            dev_id = 0
+        tl.record_round(
+            "tree_build_device", 0, [(dev_id, build_wall)],
+            fetch_s=sync_wall, trace=trace,
+        )
     if trace is not None:
         trace(
             "host_sync",

@@ -99,6 +99,11 @@ class _Replica:
         self.in_flight = 0
         self.restarts = 0
         self.checks = 0
+        # Deep-obs signals lifted from the replica's /healthz body at each
+        # probe: watchdog stall count and straggler-flag totals, so one
+        # router /healthz shows which replica is hung or on a slow device.
+        self.watchdog_stalls = 0
+        self.straggler_flags = 0
 
     def alive(self) -> bool:
         return self.proc is not None and self.proc.poll() is None
@@ -512,10 +517,26 @@ class FleetRouter:
         ok = False
         if r.port is not None:
             try:
-                status, _, _ = await self._replica_request(
+                status, _, body = await self._replica_request(
                     r, "GET", "/healthz", {}, b"", probe_timeout
                 )
                 ok = status == 200
+                if ok:
+                    # Lift the replica's deep-obs signals while the body is
+                    # in hand: a hung phase (watchdog) or slow device
+                    # (straggler) surfaces in the router's own /healthz
+                    # without a second probe. Best-effort — the probe's
+                    # verdict never depends on the body parsing.
+                    try:
+                        h = json.loads(body.decode("utf-8"))
+                        wd = h.get("watchdog")
+                        if isinstance(wd, dict):
+                            r.watchdog_stalls = int(wd.get("stalls", 0))
+                        sg = h.get("straggler")
+                        if isinstance(sg, dict):
+                            r.straggler_flags = int(sg.get("flags_total", 0))
+                    except (ValueError, TypeError, AttributeError):
+                        pass
             except _ReplicaError:
                 ok = False
         self._mark(r, ok)
@@ -551,6 +572,8 @@ class FleetRouter:
                     "pid": r.proc.pid if r.proc else None,
                     "failures": r.failures, "in_flight": r.in_flight,
                     "restarts": r.restarts, "checks": r.checks,
+                    "watchdog_stalls": r.watchdog_stalls,
+                    "straggler_flags": r.straggler_flags,
                 }
                 for r in self.replicas
             },

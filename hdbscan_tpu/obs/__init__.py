@@ -22,6 +22,15 @@ Three layers, all opt-in and process-global (mirroring the
   router's ``router_span`` events with replica ``request_span`` /
   ``request_shed`` events on the propagated ``X-Request-Id``, so one
   request's causal chain reconstructs across processes.
+- :class:`~hdbscan_tpu.obs.timeline.TimelineRecorder` — per-device phase
+  timelines: every sharded/ring round decomposes into telescoping
+  ``compute_s``/``comm_s``/``host_s`` segments (``device_timeline``
+  events), per-round skew stats feed the straggler detector
+  (``straggler_flag`` events + ``hdbscan_tpu_straggler_flags_total``).
+- :class:`~hdbscan_tpu.obs.flightrec.FlightRecorder` — the crash/stall
+  black box: a bounded ring of recent trace events that dumps a
+  self-contained post-mortem bundle on watchdog stall, replication-gate
+  trip, SLO breach, unhandled exception, or SIGTERM.
 
 The uninstalled fast path is one module-attribute load + ``is None`` test
 per instrumented site (the same contract ``fault/inject.py`` keeps): fit
@@ -39,23 +48,30 @@ from hdbscan_tpu.obs.audit import (
     donation_guard,
 )
 from hdbscan_tpu.obs.correlate import join_spans, merge_fleet_traces
+from hdbscan_tpu.obs.flightrec import FlightRecorder
 from hdbscan_tpu.obs.heartbeat import Heartbeats
+from hdbscan_tpu.obs.timeline import TimelineRecorder
 
 __all__ = [
     "MemoryAuditor",
     "ReplicatedBufferError",
     "donation_guard",
     "Heartbeats",
+    "TimelineRecorder",
+    "FlightRecorder",
     "join_spans",
     "merge_fleet_traces",
     "install",
     "clear",
     "auditor",
     "heartbeats",
+    "timeline",
+    "flight",
     "mem_phase",
     "task",
     "beat",
     "watchdog_state",
+    "straggler_state",
     "assert_not_replicated",
 ]
 
@@ -75,28 +91,37 @@ _NULL_TASK = _NullTask()
 # hot-path cost of the uninstalled layer is one attribute load + is-None.
 _AUDITOR: MemoryAuditor | None = None
 _HEARTBEATS: Heartbeats | None = None
+_TIMELINE: TimelineRecorder | None = None
+_FLIGHT: FlightRecorder | None = None
 _INSTALL_LOCK = threading.Lock()
 
 
-def install(auditor=None, heartbeats=None) -> None:
-    """Install the process-wide auditor and/or heartbeat hub. Passing None
-    for either leaves that layer as it was (install them independently)."""
-    global _AUDITOR, _HEARTBEATS
+def install(auditor=None, heartbeats=None, timeline=None, flight=None) -> None:
+    """Install the process-wide auditor / heartbeat hub / timeline recorder
+    / flight recorder. Passing None for any layer leaves it as it was
+    (install them independently)."""
+    global _AUDITOR, _HEARTBEATS, _TIMELINE, _FLIGHT
     with _INSTALL_LOCK:
         if auditor is not None:
             _AUDITOR = auditor
         if heartbeats is not None:
             _HEARTBEATS = heartbeats
+        if timeline is not None:
+            _TIMELINE = timeline
+        if flight is not None:
+            _FLIGHT = flight
 
 
 def clear() -> None:
-    """Remove both layers (instrumented sites go back to no-ops)."""
-    global _AUDITOR, _HEARTBEATS
+    """Remove every layer (instrumented sites go back to no-ops)."""
+    global _AUDITOR, _HEARTBEATS, _TIMELINE, _FLIGHT
     with _INSTALL_LOCK:
         if _HEARTBEATS is not None:
             _HEARTBEATS.close()
         _AUDITOR = None
         _HEARTBEATS = None
+        _TIMELINE = None
+        _FLIGHT = None
 
 
 def auditor() -> MemoryAuditor | None:
@@ -105,6 +130,14 @@ def auditor() -> MemoryAuditor | None:
 
 def heartbeats() -> Heartbeats | None:
     return _HEARTBEATS
+
+
+def timeline() -> TimelineRecorder | None:
+    return _TIMELINE
+
+
+def flight() -> FlightRecorder | None:
+    return _FLIGHT
 
 
 def mem_phase(name: str):
@@ -144,6 +177,15 @@ def watchdog_state() -> dict | None:
     return hb.state()
 
 
+def straggler_state() -> dict | None:
+    """The timeline recorder's straggler-detector state for ``/healthz``;
+    None when no timeline recorder is installed."""
+    tl = _TIMELINE
+    if tl is None:
+        return None
+    return tl.state()
+
+
 def assert_not_replicated(n, itemsize, slack=0.5, phases=None) -> dict:
     """Delegate to the installed auditor's replication gate. Raises
     :class:`RuntimeError` when no auditor is installed — a gate that was
@@ -158,19 +200,23 @@ def assert_not_replicated(n, itemsize, slack=0.5, phases=None) -> dict:
 
 
 @contextmanager
-def installed(auditor=None, heartbeats=None):
+def installed(auditor=None, heartbeats=None, timeline=None, flight=None):
     """Scoped install for tests: install, yield, restore previous layers."""
-    global _AUDITOR, _HEARTBEATS
+    global _AUDITOR, _HEARTBEATS, _TIMELINE, _FLIGHT
     with _INSTALL_LOCK:
-        prev = (_AUDITOR, _HEARTBEATS)
+        prev = (_AUDITOR, _HEARTBEATS, _TIMELINE, _FLIGHT)
         if auditor is not None:
             _AUDITOR = auditor
         if heartbeats is not None:
             _HEARTBEATS = heartbeats
+        if timeline is not None:
+            _TIMELINE = timeline
+        if flight is not None:
+            _FLIGHT = flight
     try:
         yield
     finally:
         with _INSTALL_LOCK:
             if heartbeats is not None and heartbeats is not prev[1]:
                 heartbeats.close()
-            _AUDITOR, _HEARTBEATS = prev
+            _AUDITOR, _HEARTBEATS, _TIMELINE, _FLIGHT = prev

@@ -229,7 +229,14 @@ class MemoryAuditor:
                     return
 
         t0 = time.monotonic()
-        self._sample(name, acc)
+        # Sync entry/exit samples are best-effort: a phase whose sampling
+        # failed (or raced a teardown) must still land in the watermark
+        # table as a ``sampled: false`` row — an omitted phase key-misses
+        # every report/bench_compare consumer downstream.
+        try:
+            self._sample(name, acc)
+        except Exception:
+            pass
         pump = threading.Thread(
             target=_pump, name=f"mem-audit-{name}", daemon=True
         )
@@ -239,7 +246,10 @@ class MemoryAuditor:
         finally:
             stop.set()
             pump.join(timeout=5.0)
-            self._sample(name, acc)
+            try:
+                self._sample(name, acc)
+            except Exception:
+                pass
             wall_s = time.monotonic() - t0
             self._merge_watermark(name, acc, wall_s)
             if self.tracer is not None:
@@ -248,6 +258,7 @@ class MemoryAuditor:
                     phase=name,
                     source=acc["source"],
                     samples=acc["samples"],
+                    sampled=acc["samples"] > 0,
                     devices=len(acc["per_device"]),
                     max_device_bytes=acc["max_device_bytes"],
                     total_bytes=acc["total_bytes"],
@@ -258,9 +269,13 @@ class MemoryAuditor:
         with self._lock:
             wm = self._watermarks.get(name)
             if wm is None:
+                # Zero-sample phases (sampling failed, or a repeat faster
+                # than any sampler tick) still get a row — ``sampled``
+                # distinguishes "audited and small" from "never measured".
                 self._watermarks[name] = {
                     "source": acc["source"],
                     "samples": acc["samples"],
+                    "sampled": acc["samples"] > 0,
                     "max_device_bytes": acc["max_device_bytes"],
                     "total_bytes": acc["total_bytes"],
                     "per_device": dict(acc["per_device"]),
@@ -268,6 +283,7 @@ class MemoryAuditor:
                 }
                 return
             wm["samples"] += acc["samples"]
+            wm["sampled"] = bool(wm.get("sampled")) or acc["samples"] > 0
             wm["max_device_bytes"] = max(
                 wm["max_device_bytes"], acc["max_device_bytes"]
             )

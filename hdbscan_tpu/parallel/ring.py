@@ -130,10 +130,20 @@ def _per_device_walls(out, t0: float, beat=None) -> list[tuple[int, float]]:
     per-chip timelines — good enough to surface a straggler device or a
     non-overlapped ppermute in the trace (README "Scaling out").
     ``beat(done)`` (an ``obs`` heartbeat) fires as each shard lands, so a
-    hung collective is distinguishable from a slow one."""
+    hung collective is distinguishable from a slow one.
+
+    Fault site: ``phase_stall`` fires at most once per round here and
+    sleeps ``delay_s`` before the LAST shard's timestamp — a deterministic
+    single-device straggler for exercising the skew detector
+    (``obs/timeline.py``) without touching real device timing."""
+    from hdbscan_tpu.fault import inject
+
     walls = []
     shards = sorted(out.addressable_shards, key=lambda s: s.device.id)
+    spec = inject.maybe_fire("phase_stall")
     for i, sh in enumerate(shards):
+        if spec is not None and i == len(shards) - 1 and spec.delay_s > 0:
+            time.sleep(spec.delay_s)
         jax.block_until_ready(sh.data)
         walls.append((int(sh.device.id), time.monotonic() - t0))
         if beat is not None:
@@ -142,12 +152,38 @@ def _per_device_walls(out, t0: float, beat=None) -> list[tuple[int, float]]:
 
 
 def _emit_ring_trace(
-    trace, stage: str, wall: float, walls, n_dev: int, rnd: int, **fields
+    trace, stage: str, wall: float, walls, n_dev: int, rnd: int, *,
+    upload_s: float = 0.0, fetch_s: float = 0.0, comm_bytes: int = 0,
+    flops: float = 0.0, **fields
 ) -> None:
     """One summary event (devices + ppermute_steps — the validator contract:
-    steps == devices - 1 per round) plus one per-device wall event."""
+    steps == devices - 1 per round) plus one per-device wall event.
+
+    Also the single seam feeding the installed
+    :class:`~hdbscan_tpu.obs.timeline.TimelineRecorder`: the measured
+    per-device walls plus the host segments (``upload_s``/``fetch_s``) and
+    the round's ring traffic (``comm_bytes`` one device moved) / total
+    ``flops`` become per-device ``device_timeline`` events, and the round's
+    skew stats ride the summary event. Recording happens even when
+    ``trace`` is None — the recorder still feeds the report/healthz."""
+    tl = obs.timeline()
+    stats = None
+    if tl is not None:
+        stats = tl.record_round(
+            stage, rnd, walls, upload_s=upload_s, fetch_s=fetch_s,
+            comm_bytes=comm_bytes, flops=flops, trace=trace,
+        )
     if trace is None:
         return
+    if stats is not None:
+        fields = dict(
+            fields,
+            skew=stats["skew"],
+            max_device_wall_s=stats["max_wall_s"],
+            median_device_wall_s=stats["median_wall_s"],
+        )
+    if comm_bytes:
+        fields.setdefault("comm_bytes", int(comm_bytes))
     trace(
         stage,
         wall_s=round(wall, 6),
@@ -408,8 +444,14 @@ def ring_knn_core_distances(
         lanes = np.zeros((n_pad, LANES), np.float32)
         lanes[:, :dm] = data_p
         data_p = lanes
+    t_up = time.monotonic()
     rows = jax.device_put(data_p, row_sharding(mesh))
     n_arr = jax.device_put(np.asarray(n, np.int32), replicated(mesh))
+    upload_s = time.monotonic() - t_up
+    # Ring traffic per device per sweep: the circulating panel (one row
+    # shard, post-lanes width) crosses each of the n_dev-1 permute steps.
+    comm_bytes = (n_dev - 1) * shard * data_p.shape[1] * data_p.dtype.itemsize
+    round_flops = 2.0 * n_pad * n_pad * dm
     kth_col = min(max(min_pts - 1, 1), n) - 1
     fetch_knn = fetch_knn or return_indices
     # Core-only callers get the kth-column program: the device output is
@@ -437,24 +479,32 @@ def ring_knn_core_distances(
     from hdbscan_tpu.parallel.mesh import fetch
 
     if not fetch_knn:
+        t_f = time.monotonic()
         kth = np.asarray(fetch(best_d), np.float64)[:n]
+        fetch_s = time.monotonic() - t_f
         # Release device state eagerly (not at gc): lingering pieces of the
         # scan otherwise stay resident into the Borůvka phase and charge
         # against the --assert-not-replicated budget there.
         best_d.delete()
         rows.delete()
         _emit_ring_trace(
-            trace, "ring_knn_scan", wall, walls, n_dev, 0, rows=n, shard=shard
+            trace, "ring_knn_scan", wall, walls, n_dev, 0, rows=n, shard=shard,
+            upload_s=upload_s, fetch_s=fetch_s, comm_bytes=comm_bytes,
+            flops=round_flops,
         )
         core = np.zeros(n, np.float64) if min_pts <= 1 else kth
         return core, None
+    t_f = time.monotonic()
     knn = np.asarray(fetch(best_d), np.float64)[:n]
     idx = np.asarray(fetch(best_i), np.int64)[:n] if return_indices else None
+    fetch_s = time.monotonic() - t_f
     best_d.delete()
     best_i.delete()
     rows.delete()
     _emit_ring_trace(
-        trace, "ring_knn_scan", wall, walls, n_dev, 0, rows=n, shard=shard
+        trace, "ring_knn_scan", wall, walls, n_dev, 0, rows=n, shard=shard,
+        upload_s=upload_s, fetch_s=fetch_s, comm_bytes=comm_bytes,
+        flops=round_flops,
     )
     if min_pts <= 1:
         core = np.zeros(n, np.float64)
@@ -508,6 +558,7 @@ def ring_knn_core_distances_rows(
     m_pad = q_shard * n_dev
     data_np = np.asarray(data)
     dm = data_np.shape[1]
+    t_up = time.monotonic()
     cols = jax.device_put(
         _pad_rows(np.asarray(data_np, dtype), n_pad), row_sharding(mesh)
     )
@@ -515,6 +566,10 @@ def ring_knn_core_distances_rows(
         _pad_rows(np.asarray(data_np[row_ids], dtype), m_pad), row_sharding(mesh)
     )
     n_arr = jax.device_put(np.asarray(n, np.int32), replicated(mesh))
+    upload_s = time.monotonic() - t_up
+    # The COLUMN panels (full-set row shards) circulate; queries stay put.
+    comm_bytes = (n_dev - 1) * shard * dm * np.dtype(dtype).itemsize
+    round_flops = 2.0 * m_pad * n_pad * dm
     kth_col = min(max(min_pts - 1, 1), n) - 1
     # Only the kth column ever leaves the device here (boundary rescan):
     # slice it inside the program so the output is O(m/D) per device.
@@ -533,13 +588,16 @@ def ring_knn_core_distances_rows(
 
     from hdbscan_tpu.parallel.mesh import fetch
 
+    t_f = time.monotonic()
     kth = np.asarray(fetch(best_d), np.float64)[:m]
+    fetch_s = time.monotonic() - t_f
     best_d.delete()
     q.delete()
     cols.delete()
     _emit_ring_trace(
         trace, "ring_rows_scan", wall, walls, n_dev, 0, rows=m, cols=n,
-        shard=shard,
+        shard=shard, upload_s=upload_s, fetch_s=fetch_s,
+        comm_bytes=comm_bytes, flops=round_flops,
     )
     if min_pts <= 1:
         return np.zeros(m, np.float64)
@@ -766,10 +824,12 @@ class RingBoruvkaScanner:
         uniq, dense = np.unique(comp, return_inverse=True)
         n_comp = len(uniq)
         n_comp_pad = _next_pow2(max(8, n_comp))
+        t_up = time.monotonic()
         comp_rep = jax.device_put(
             _pad_rows(dense.astype(np.int32), self.n_pad),
             replicated(self.mesh),
         )
+        upload_s = time.monotonic() - t_up
         fn = _ring_boruvka_fn(
             self.mesh, self.metric, self.row_tile, self.col_tile, n_comp_pad
         )
@@ -785,13 +845,22 @@ class RingBoruvkaScanner:
 
         from hdbscan_tpu.parallel.mesh import fetch
 
+        t_f = time.monotonic()
         w, lo, hi, cand = fetch((w_all, lo_all, hi_all, n_cand))
+        fetch_s = time.monotonic() - t_f
         w = np.asarray(w, np.float64)[:n_comp]
         lo = np.asarray(lo, np.int64)[:n_comp]
         hi = np.asarray(hi, np.int64)[:n_comp]
+        # The augmented (d+1-wide) row-shard panel circulates each round.
+        comm_bytes = (
+            (self.n_dev - 1) * self.shard * (self.d + 1)
+            * self._rows.dtype.itemsize
+        )
         _emit_ring_trace(
             self.trace, "ring_boruvka_scan", wall, walls, self.n_dev,
             self._round, n_comp=n_comp, candidates=int(cand),
+            upload_s=upload_s, fetch_s=fetch_s, comm_bytes=comm_bytes,
+            flops=2.0 * self.n_pad * self.n_pad * self.d,
         )
         self._round += 1
         bw = np.full(self.n, np.inf, np.float64)

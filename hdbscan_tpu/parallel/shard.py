@@ -436,10 +436,12 @@ def shard_forest_core_distances(
     normals = rng.standard_normal((trees, max(num_nodes, 1), d))
     normals /= np.maximum(np.linalg.norm(normals, axis=-1, keepdims=True), 1e-12)
 
+    t_up = time.monotonic()
     rows = jax.device_put(
         _pad_rows(np.asarray(data, dtype), n_pad), row_sharding(mesh)
     )
     normals_dev = jax.device_put(normals.astype(dtype), replicated(mesh))
+    upload_s = time.monotonic() - t_up
 
     t0 = time.monotonic()
     with obs.mem_phase("shard_knn_build"), obs.task(
@@ -481,9 +483,19 @@ def shard_forest_core_distances(
             best_d, best_i = sweep(rows, members, thrs, normals_dev)
             walls = _per_device_walls(best_d, t0, beat=hb.beat)
         wall = time.monotonic() - t0
+    # One visiting panel per permute step: the shard's points plus its
+    # trees' leaf members and heap thresholds.
+    itemsize = np.dtype(dtype).itemsize
+    panel_bytes = (
+        shard * d * itemsize
+        + trees * len(leaves) * lmax * 4
+        + trees * num_nodes * itemsize
+    )
     _emit_ring_trace(
         trace, "shard_panel_sweep", wall, walls, n_dev, 0,
         rows=n, trees=trees, shard=shard,
+        upload_s=upload_s, comm_bytes=(n_dev - 1) * panel_bytes,
+        flops=2.0 * n_pad * trees * n_dev * lmax * d,
     )
 
     kth_col = min(max(min_pts - 1, 1), n) - 1
@@ -834,27 +846,38 @@ class ShardBoruvkaScanner:
             # round's outputs being ready (obs.donation_guard).
             with obs.donation_guard():
                 # Component labels are vertex ids (< n): int32 panel.
+                t_up = time.monotonic()
                 comp_dev = _owned_row_panel(
                     _pad_rows(comp.astype(np.int32), self.n_pad), self.mesh
                 )
                 t0 = time.monotonic()
+                upload_s = t0 - t_up
                 bw_dev, bj_dev = fn(self._rows, comp_dev, self._n_arr)
                 walls = _per_device_walls(bw_dev, t0, beat=hb.beat)
             wall = time.monotonic() - t0
 
+        t_f = time.monotonic()
         bw = np.asarray(fetch(bw_dev), np.float64)[: self.n]
         bj = np.asarray(fetch(bj_dev), np.int64)[: self.n]
+        fetch_s = time.monotonic() - t_f
         # Free the round's device outputs NOW: the runtime's deferred
         # deletion otherwise keeps every round's (shard,) pieces resident
         # through the next round's scan, and the accumulated O(n·rounds/D)
         # bytes read as replication to the fit-path memory gate.
         bw_dev.delete()
         bj_dev.delete()
+        # Two circulating panels per step: the augmented row shard and the
+        # matching int32 component-label shard.
+        comm_bytes = (self.n_dev - 1) * self.shard * (
+            (self.d + 1) * self._rows.dtype.itemsize + 4
+        )
         _emit_ring_trace(
             self.trace, "shard_boruvka_scan", wall, walls, self.n_dev,
             self._round,
             n_comp=int(len(np.unique(comp))),
             candidates=int(np.sum(bj >= 0)),
+            upload_s=upload_s, fetch_s=fetch_s, comm_bytes=comm_bytes,
+            flops=2.0 * self.n_pad * self.n_pad * self.d,
         )
         self._round += 1
         return bw, bj

@@ -430,6 +430,19 @@ class ClusterServer:
             "Per-device peak resident bytes across audited fit phases.",
             labelnames=("device",),
         )
+        self._m_straggler = self.metrics.counter(
+            "hdbscan_tpu_straggler_flags_total",
+            "Straggler flags fired (device >= skew_threshold x round-median "
+            "wall for straggler_rounds consecutive rounds), by device.",
+            labelnames=("device",),
+        )
+        # Timeline/straggler layer: an installed TimelineRecorder (CLI- or
+        # test-built) feeds this server's straggler counter so /metrics sees
+        # slow devices; none is created here — refit/ingest paths install
+        # their own when telemetry asks for it.
+        tl = obs.timeline()
+        if tl is not None and tl.straggler_counter is None:
+            tl.straggler_counter = self._m_straggler
         # Progress/watchdog layer (``hdbscan_tpu/obs``): arm the hub when
         # config asks for a watchdog and none is installed yet (a CLI-built
         # hub keeps priority); either way the installed hub feeds this
@@ -1307,6 +1320,9 @@ class ClusterServer:
         wd = obs.watchdog_state()
         if wd is not None:
             out["watchdog"] = wd
+        sg = obs.straggler_state()
+        if sg is not None:
+            out["straggler"] = sg
         if self.ingest_enabled:
             stats = self.buffer.stats()
             out["stream"] = {

@@ -27,8 +27,12 @@ from hdbscan_tpu.utils.tracing import TRACE_SCHEMA, Tracer
 #: Version tag carried by the run report. Bump the integer suffix on any
 #: backwards-incompatible report-shape change. /2: ``memory`` gained the
 #: per-phase ``watermarks`` table (``obs/audit.MemoryAuditor`` peaks) next
-#: to the start/end samples.
-REPORT_SCHEMA = "hdbscan-tpu-report/2"
+#: to the start/end samples. /3: the mesh-observability sections —
+#: ``timeline`` (per-phase comm/compute/host decomposition + skew from the
+#: ``device_timeline`` events) and ``roofline`` (achieved GFLOP/s / GB/s,
+#: arithmetic intensity, bound classification, honest tags) — and
+#: watermark rows carry ``sampled``.
+REPORT_SCHEMA = "hdbscan-tpu-report/3"
 
 #: Env vars echoed into the manifest when set: anything that changes what the
 #: run computes or how its figures are derived, without appearing in argv.
@@ -294,12 +298,19 @@ def build_report(
     manifest: dict | None = None,
     memory: dict | None = None,
     per_host: dict | None = None,
+    timeline: dict | None = None,
+    roofline_tags=None,
 ) -> dict:
     """Assemble the run report dict from a tracer's collected events.
 
     ``memory``: e.g. ``{"start": sample, "end": sample}`` from
     :func:`sample_device_memory`. ``per_host``: the
-    :func:`merge_host_traces` result for multi-host runs.
+    :func:`merge_host_traces` result for multi-host runs. ``timeline``:
+    a :meth:`~hdbscan_tpu.obs.timeline.TimelineRecorder.phase_table`
+    (the exact figures when a recorder ran; otherwise the section
+    reconstructs from the trace's ``device_timeline`` events).
+    ``roofline_tags``: honesty tags for the roofline section; None picks
+    :func:`~hdbscan_tpu.obs.roofline.default_tags`.
     """
     phases = phase_aggregates(tracer.events)
     report = {
@@ -327,6 +338,16 @@ def build_report(
         if watermarks is not None:
             mem["watermarks"] = watermarks
         report["memory"] = json_sanitize(mem)
+    tl_table = timeline
+    if tl_table is None:
+        tl_table = timeline_section(tracer)
+    if tl_table:
+        report["timeline"] = json_sanitize(tl_table)
+    from hdbscan_tpu.obs.roofline import roofline_section
+
+    roofline = roofline_section(phases, tl_table, tags=roofline_tags)
+    if roofline is not None:
+        report["roofline"] = json_sanitize(roofline)
     if per_host is not None:
         report["per_host"] = per_host
     return report
@@ -518,6 +539,92 @@ def stream_section(tracer: Tracer) -> dict | None:
     return section
 
 
+def timeline_section(tracer: Tracer) -> dict | None:
+    """The run report's ``timeline`` section reconstructed from the trace's
+    ``device_timeline`` events — the fallback when no live
+    :class:`~hdbscan_tpu.obs.timeline.TimelineRecorder` table is at hand
+    (e.g. rebuilding a report from a trace file). Per phase: per-round
+    max-device walls sum into ``wall_s`` (the critical path), segment
+    means sum per round, skew is the worst round's max/median, and
+    ``straggler_flags`` counts the phase's ``straggler_flag`` events.
+    None when the run recorded no timelines (the section is omitted)."""
+    rows = [e for e in tracer.events if e.name == "device_timeline"]
+    if not rows:
+        return None
+    flags = [e for e in tracer.events if e.name == "straggler_flag"]
+    # Group rows into rounds in emission order: a new (phase, round) pair
+    # or a repeated device id closes the open group for that phase.
+    groups: list[dict] = []
+    open_group: dict[str, dict] = {}
+    for e in rows:
+        f = e.fields
+        phase = str(f.get("phase", "?"))
+        rnd = int(f.get("round", 0))
+        dev = int(f.get("device", 0))
+        g = open_group.get(phase)
+        if g is None or g["round"] != rnd or dev in g["devices"]:
+            g = {"phase": phase, "round": rnd, "devices": {}, "rows": []}
+            open_group[phase] = g
+            groups.append(g)
+        g["devices"][dev] = True
+        g["rows"].append(
+            (
+                float(e.wall_s),
+                float(f.get("compute_s", 0.0)),
+                float(f.get("comm_s", 0.0)),
+                float(f.get("host_s", 0.0)),
+                int(f.get("comm_bytes", 0)),
+            )
+        )
+    table: dict[str, dict] = {}
+    for g in groups:
+        n_dev = len(g["rows"])
+        walls = sorted(r[0] for r in g["rows"])
+        median = (
+            walls[n_dev // 2]
+            if n_dev % 2
+            else 0.5 * (walls[n_dev // 2 - 1] + walls[n_dev // 2])
+        )
+        skew = (walls[-1] / median) if median > 0 else 1.0
+        ph = table.setdefault(
+            g["phase"],
+            {
+                "rounds": 0,
+                "devices": 0,
+                "wall_s": 0.0,
+                "compute_s": 0.0,
+                "comm_s": 0.0,
+                "host_s": 0.0,
+                "comm_bytes": 0,
+                "max_skew": 1.0,
+            },
+        )
+        ph["rounds"] += 1
+        ph["devices"] = max(ph["devices"], n_dev)
+        ph["wall_s"] += walls[-1]
+        ph["compute_s"] += sum(r[1] for r in g["rows"]) / n_dev
+        ph["comm_s"] += sum(r[2] for r in g["rows"]) / n_dev
+        ph["host_s"] += sum(r[3] for r in g["rows"]) / n_dev
+        ph["comm_bytes"] += sum(r[4] for r in g["rows"])
+        ph["max_skew"] = max(ph["max_skew"], skew)
+    out: dict[str, dict] = {}
+    for name, ph in table.items():
+        total = ph["compute_s"] + ph["comm_s"] + ph["host_s"]
+        skew = ph.pop("max_skew")
+        out[name] = {
+            **{
+                k: (round(v, 9) if isinstance(v, float) else v)
+                for k, v in ph.items()
+            },
+            "comm_frac": round(ph["comm_s"] / total, 6) if total > 0 else 0.0,
+            "skew": round(skew, 6),
+            "straggler_flags": sum(
+                1 for e in flags if str(e.fields.get("phase")) == name
+            ),
+        }
+    return out
+
+
 def memory_watermark_section(tracer: Tracer) -> dict | None:
     """The run report's ``memory.watermarks`` table: per-phase device-memory
     peaks over every ``mem_phase_peak`` event the
@@ -538,6 +645,7 @@ def memory_watermark_section(tracer: Tracer) -> dict | None:
             {
                 "source": f.get("source"),
                 "samples": 0,
+                "sampled": False,
                 "devices": 0,
                 "max_device_bytes": 0,
                 "total_bytes": 0,
@@ -545,6 +653,11 @@ def memory_watermark_section(tracer: Tracer) -> dict | None:
             },
         )
         row["samples"] += int(f.get("samples", 0))
+        # Older traces lack the field: infer from samples so rebuilt
+        # reports agree with the auditor's in-memory table.
+        row["sampled"] = row["sampled"] or bool(
+            f.get("sampled", int(f.get("samples", 0)) > 0)
+        )
         row["devices"] = max(row["devices"], int(f.get("devices", 0)))
         row["max_device_bytes"] = max(
             row["max_device_bytes"], int(f.get("max_device_bytes", 0))

@@ -20,6 +20,7 @@ from the fleet router (joinable with replica ``request_span`` events on
 from __future__ import annotations
 
 import json
+import os
 import sys
 import threading
 import time
@@ -65,12 +66,29 @@ class JsonlSink:
     given at construction (e.g. ``process`` for multi-host runs). Values are
     sanitized to plain JSON types (numpy scalars appear in trace fields).
     Lines flush as they happen so a killed run keeps its partial trace.
+
+    ``rotate_bytes`` (config knob ``trace_rotate_bytes``, 0 = off) bounds
+    the file for long-running servers/maintainers: when the next line
+    would push the file past the bound, the current file moves to
+    ``<path>.1`` (replacing any previous rotation — at most two files
+    ever exist) and a fresh ``<path>`` opens. ``seq`` continues across
+    the boundary, so ``scripts/check_trace.py`` can validate a rotated
+    set's continuity.
     """
 
-    def __init__(self, path: str, static: dict | None = None):
+    def __init__(self, path: str, static: dict | None = None,
+                 rotate_bytes: int = 0):
+        rotate_bytes = int(rotate_bytes)
+        if rotate_bytes < 0:
+            raise ValueError(
+                f"rotate_bytes must be >= 0 (0 = off), got {rotate_bytes!r}"
+            )
         self.path = path
+        self.rotate_bytes = rotate_bytes
+        self.rotations = 0
         self._static = dict(static or {})
         self._seq = 0
+        self._bytes = 0
         self._f = open(path, "w", encoding="utf-8")
 
     def emit(self, ev: TraceEvent) -> None:
@@ -85,8 +103,26 @@ class JsonlSink:
             **json_sanitize(ev.fields),
         }
         self._seq += 1
-        self._f.write(json.dumps(rec) + "\n")
+        line = json.dumps(rec) + "\n"  # ensure_ascii: len == byte length
+        if (
+            self.rotate_bytes
+            and self._bytes
+            and self._bytes + len(line) > self.rotate_bytes
+        ):
+            self._rotate()
+        self._f.write(line)
         self._f.flush()
+        self._bytes += len(line)
+
+    def _rotate(self) -> None:
+        """Move the full file to ``<path>.1`` and start a fresh one. The
+        sink's ``seq`` keeps counting — rotation is invisible to readers
+        that follow the continuity rule."""
+        self._f.close()
+        os.replace(self.path, self.path + ".1")
+        self._f = open(self.path, "w", encoding="utf-8")
+        self._bytes = 0
+        self.rotations += 1
 
     def close(self) -> None:
         if not self._f.closed:

@@ -51,6 +51,15 @@ HEADLINE = {
     # bigger is better — and the per-device peak the replication gate saw.
     "mesh_scan_scaling_efficiency_8dev": "higher",
     "mesh_peak_device_bytes_max": "lower",
+    # Mesh-timeline companions (obs/timeline.py, report/3): the fraction
+    # of attributed wall the cost model assigns to comm, the worst
+    # per-round device skew, and model-flop utilization. On cpu_smoke
+    # rounds these are honest-but-noisy (model attribution, thread
+    # scheduling); the smoke threshold absorbs that, and the real-TPU
+    # lane (ROADMAP item 5) is where the strict gate bites.
+    "mesh_comm_frac": "lower",
+    "mesh_skew": "lower",
+    "mesh_mfu": "higher",
 }
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
@@ -120,9 +129,11 @@ def load_round(path: str) -> dict:
             if isinstance(ari, (int, float)):
                 metrics["stream_maintain_ari_vs_scratch"] = float(ari)
         if name == "mesh_scan_scaling_efficiency_8dev":
-            peak = rec.get("mesh_peak_device_bytes_max")
-            if isinstance(peak, (int, float)):
-                metrics["mesh_peak_device_bytes_max"] = float(peak)
+            for comp in ("mesh_peak_device_bytes_max", "mesh_comm_frac",
+                         "mesh_skew", "mesh_mfu"):
+                v = rec.get(comp)
+                if isinstance(v, (int, float)):
+                    metrics[comp] = float(v)
     m = _ROUND_RE.search(os.path.basename(path))
     return {
         "path": path,

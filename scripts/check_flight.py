@@ -1,0 +1,225 @@
+#!/usr/bin/env python3
+"""Validate flight-recorder bundles (README "Deep observability").
+
+Usage::
+
+    python scripts/check_flight.py BUNDLE.json [BUNDLE.json ...]
+    python scripts/check_flight.py FLIGHT_DIR
+
+Given a directory, validates every ``flight-*.json`` inside it (and fails
+if there are none — pointing the checker at an empty flight dir is
+usually a post-mortem gone wrong, not a clean bill of health; pass
+``--allow-empty`` for the healthy-run assertion that a dir holds zero
+bundles).
+
+A bundle (``obs/flightrec.FLIGHT_SCHEMA``) must carry a matching
+``schema`` tag, a ``reason`` from the known trigger vocabulary
+(watchdog_stall / replication_gate / slo_breach / exception / sigterm /
+manual), a positive ``pid``, a finite positive ``created_unix``, a
+NON-EMPTY ``events`` tail whose every record has a non-empty string
+``stage`` and a finite non-negative ``wall_s``, an ``events_seen``
+counter >= the tail length (the ring can only drop, never invent),
+a ``heartbeats`` tail containing only ``heartbeat`` events, and a
+non-empty ``stacks`` dump that names at least one thread — a black box
+without the stalling thread's stack is no black box. Optional sections
+(``watchdog``/``straggler``/``watermarks``/``device_peaks``/``manifest``/
+``extra``) must be well-typed when present.
+
+Exit code 0 = every bundle valid; 1 = any violation (all printed). Pure
+stdlib on purpose: the validator must run where the crash artifacts land,
+without the package or jax installed.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+import sys
+
+#: Kept in sync with ``hdbscan_tpu.obs.flightrec`` — stdlib-only duplicate
+#: so the validator runs without the package importable.
+FLIGHT_SCHEMA_PREFIX = "hdbscan-tpu-flight/"
+DUMP_REASONS = (
+    "watchdog_stall",
+    "replication_gate",
+    "slo_breach",
+    "exception",
+    "sigterm",
+    "manual",
+)
+
+
+def _finite_num(val) -> bool:
+    return (
+        isinstance(val, (int, float))
+        and not isinstance(val, bool)
+        and math.isfinite(float(val))
+    )
+
+
+def _check_tail(name: str, tail, where: str, require_nonempty: bool) -> list:
+    """Event-record checks shared by the ``events`` and ``heartbeats``
+    tails: each record is a dict with a non-empty string ``stage`` and a
+    finite non-negative ``wall_s``."""
+    errors: list = []
+    if not isinstance(tail, list) or (require_nonempty and not tail):
+        errors.append(f"{where}: {name}={type(tail).__name__} not a "
+                      f"{'non-empty ' if require_nonempty else ''}list")
+        return errors
+    for i, rec in enumerate(tail):
+        if not isinstance(rec, dict):
+            errors.append(f"{where}: {name}[{i}] is not an object")
+            continue
+        stage = rec.get("stage")
+        if not isinstance(stage, str) or not stage:
+            errors.append(
+                f"{where}: {name}[{i}] lacks a non-empty string 'stage'"
+            )
+        wall = rec.get("wall_s")
+        if not _finite_num(wall) or float(wall) < 0:
+            errors.append(
+                f"{where}: {name}[{i}] wall_s={wall!r} not a finite "
+                f"non-negative number"
+            )
+    return errors
+
+
+def validate_bundle(path: str) -> tuple[dict | None, list]:
+    """Parse + validate one bundle file. Returns ``(bundle, errors)``."""
+    errors: list = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            bundle = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return None, [f"{path}: unreadable bundle ({e})"]
+    if not isinstance(bundle, dict):
+        return None, [f"{path}: bundle is not a JSON object"]
+    schema = bundle.get("schema")
+    if not isinstance(schema, str) or not schema.startswith(
+        FLIGHT_SCHEMA_PREFIX
+    ):
+        errors.append(
+            f"{path}: schema={schema!r} (want {FLIGHT_SCHEMA_PREFIX}<n>)"
+        )
+    reason = bundle.get("reason")
+    if reason not in DUMP_REASONS:
+        errors.append(f"{path}: reason={reason!r} not in {DUMP_REASONS}")
+    pid = bundle.get("pid")
+    if not isinstance(pid, int) or isinstance(pid, bool) or pid <= 0:
+        errors.append(f"{path}: pid={pid!r} not a positive int")
+    created = bundle.get("created_unix")
+    if not _finite_num(created) or float(created) <= 0:
+        errors.append(
+            f"{path}: created_unix={created!r} not a positive timestamp"
+        )
+    events = bundle.get("events")
+    errors += _check_tail("events", events, path, require_nonempty=True)
+    seen = bundle.get("events_seen")
+    if not isinstance(seen, int) or isinstance(seen, bool) or seen < 0:
+        errors.append(f"{path}: events_seen={seen!r} not a non-negative int")
+    elif isinstance(events, list) and seen < len(events):
+        errors.append(
+            f"{path}: events_seen={seen} < tail length {len(events)} — the "
+            f"ring can drop old events but never invent them"
+        )
+    heartbeats = bundle.get("heartbeats")
+    errors += _check_tail("heartbeats", heartbeats, path,
+                          require_nonempty=False)
+    if isinstance(heartbeats, list):
+        for i, rec in enumerate(heartbeats):
+            if isinstance(rec, dict) and rec.get("stage") != "heartbeat":
+                errors.append(
+                    f"{path}: heartbeats[{i}] stage={rec.get('stage')!r} — "
+                    f"the heartbeat tail holds only heartbeat events"
+                )
+    stacks = bundle.get("stacks")
+    if not isinstance(stacks, str) or not stacks.strip():
+        errors.append(f"{path}: lacks a non-empty string 'stacks' dump")
+    elif "Thread" not in stacks and "thread" not in stacks:
+        errors.append(
+            f"{path}: stacks dump names no thread — a black box without "
+            f"the stalling thread's stack is no black box"
+        )
+    for key in ("watchdog", "straggler", "manifest", "extra",
+                "device_peaks"):
+        if key in bundle and not isinstance(bundle[key], dict):
+            errors.append(
+                f"{path}: {key}={type(bundle[key]).__name__} not an object"
+            )
+    # The auditor's watermark table: phase name -> watermark row.
+    wm = bundle.get("watermarks")
+    if wm is not None:
+        if not isinstance(wm, dict):
+            errors.append(
+                f"{path}: watermarks={type(wm).__name__} not an object"
+            )
+        else:
+            for phase, row in wm.items():
+                if not isinstance(row, dict):
+                    errors.append(
+                        f"{path}: watermarks[{phase!r}] not an object"
+                    )
+    return bundle, errors
+
+
+def _summarize(path: str, bundle: dict) -> str:
+    events = bundle.get("events") or []
+    stages: dict = {}
+    for rec in events:
+        if isinstance(rec, dict) and isinstance(rec.get("stage"), str):
+            stages[rec["stage"]] = stages.get(rec["stage"], 0) + 1
+    top = ", ".join(
+        f"{s}×{c}"
+        for s, c in sorted(stages.items(), key=lambda kv: -kv[1])[:5]
+    )
+    return (
+        f"  {os.path.basename(path)}: reason={bundle.get('reason')} "
+        f"pid={bundle.get('pid')} events={len(events)} "
+        f"(seen {bundle.get('events_seen')}) "
+        f"heartbeats={len(bundle.get('heartbeats') or [])}"
+        + (f" | tail: {top}" if top else "")
+    )
+
+
+def main(argv: list[str]) -> int:
+    allow_empty = "--allow-empty" in argv
+    argv = [a for a in argv if a != "--allow-empty"]
+    if not argv:
+        print(__doc__.strip().splitlines()[0])
+        print("usage: check_flight.py [--allow-empty] "
+              "BUNDLE.json|FLIGHT_DIR ...")
+        return 1
+    paths: list = []
+    for arg in argv:
+        if os.path.isdir(arg):
+            found = sorted(glob.glob(os.path.join(arg, "flight-*.json")))
+            if not found and not allow_empty:
+                print(f"FAIL: {arg}: no flight-*.json bundles in directory")
+                return 1
+            if not found:
+                print(f"OK: {arg}: zero flight bundles (healthy run)")
+            paths += found
+        else:
+            paths.append(arg)
+    all_errors: list = []
+    summaries: list = []
+    for path in paths:
+        bundle, errors = validate_bundle(path)
+        all_errors += errors
+        if bundle is not None and not errors:
+            summaries.append(_summarize(path, bundle))
+    if all_errors:
+        for err in all_errors:
+            print(f"FAIL: {err}")
+        return 1
+    if paths:
+        print(f"OK: {len(paths)} flight bundle(s) valid")
+        for line in summaries:
+            print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

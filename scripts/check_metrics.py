@@ -30,8 +30,10 @@ in-flight/resident gauges never go negative, and
 ``hdbscan_tpu_tenant_predict_seconds`` is a histogram labelled by tenant.
 The deep-observability families (README "Observability"):
 ``hdbscan_tpu_watchdog_stalls_total`` must be an integral non-negative
-counter and ``hdbscan_tpu_device_peak_bytes`` a gauge carrying a
-``device`` label with non-negative byte values.
+counter, ``hdbscan_tpu_straggler_flags_total`` an integral non-negative
+counter labelled by exactly ``device``, and
+``hdbscan_tpu_device_peak_bytes`` a gauge carrying a ``device`` label with
+non-negative byte values.
 
 With two files (two scrapes of the same server, second taken later): also
 checks counter monotonicity — every counter-type sample and every
@@ -379,7 +381,8 @@ def _check_fleet_metrics(parsed, where: str) -> list:
 
 def _check_obs_metrics(parsed, where: str) -> list:
     """Deep-observability family contracts (hdbscan_tpu/obs, serve/server.py):
-    the watchdog stall counter is an integral non-negative counter, and the
+    the watchdog stall counter is an integral non-negative counter, the
+    straggler flag counter carries exactly a ``device`` label, and the
     per-device peak-bytes gauge carries a ``device`` label with non-negative
     values."""
     errors: list = []
@@ -394,6 +397,25 @@ def _check_obs_metrics(parsed, where: str) -> list:
             errors.append(
                 f"{where}: {fam}{dict(label_items)} value {value} not a "
                 f"non-negative integer"
+            )
+    fam = "hdbscan_tpu_straggler_flags_total"
+    if fam in types and types[fam] != "counter":
+        errors.append(f"{where}: {fam} declared {types[fam]!r}, want counter")
+    for (name, label_items), value in samples.items():
+        if name != fam:
+            continue
+        labels = dict(label_items)
+        # Exactly one label: the device id. A second label dimension would
+        # fan the family out per phase/round and break dashboard joins
+        # against hdbscan_tpu_device_peak_bytes.
+        if sorted(labels) != ["device"]:
+            errors.append(
+                f"{where}: {fam} labels {sorted(labels)} != ['device']"
+            )
+        if value < 0 or value != int(value):
+            errors.append(
+                f"{where}: {fam}{labels} value {value} not a non-negative "
+                f"integer"
             )
     fam = "hdbscan_tpu_device_peak_bytes"
     if fam in types and types[fam] != "gauge":

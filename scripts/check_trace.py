@@ -102,10 +102,12 @@ contract across evictions); ``tenant_evict`` a non-empty string
 ``tenant``, positive ``generation`` and non-negative
 ``resident``/``requests``.
 Deep-observability events (``hdbscan_tpu/obs``, README "Observability")
-add five schemas: ``mem_sample`` must carry a non-empty string ``phase``,
+add eight schemas: ``mem_sample`` must carry a non-empty string ``phase``,
 a ``source`` in ``{memory_stats, live_arrays}`` and non-negative integer
 ``max_device_bytes``/``total_bytes``; ``mem_phase_peak`` additionally
-positive ``samples``/``devices`` and a ``max_device_bytes`` that is >= the
+positive ``samples``/``devices`` (non-negative when the row carries
+``sampled: false`` — a phase whose sampling failed or raced teardown
+still gets an honest zero row) and a ``max_device_bytes`` that is >= the
 running max of every ``mem_sample`` seen for that (process, phase) since
 the previous peak — a phase's published peak can never under-report its
 own samples; ``heartbeat`` a non-empty string ``phase``, a positive
@@ -118,7 +120,18 @@ backwards — plus an optional finite non-negative ``eta_s``;
 non-empty string ``request_id``/``replica``, ``route`` in
 ``{/predict, /ingest}``, ``policy`` in ``{consistent_hash, least_loaded}``,
 an HTTP ``status`` int, positive ``attempts``, a finite non-negative
-``queue_s`` and a boolean ``replied``.
+``queue_s`` and a boolean ``replied``; ``device_timeline`` (the mesh
+timeline, README "Deep observability") a non-empty string ``phase``,
+non-negative integer ``device``/``round``/``comm_bytes``,
+``attribution == "model"`` and three finite non-negative segments
+(``compute_s``/``comm_s``/``host_s``) that TELESCOPE — their sum equals
+``wall_s`` within 1e-6 — plus round CONTIGUITY per (process, device,
+phase): rounds may repeat, advance by one, or reset to a lower value,
+never skip ahead; ``straggler_flag`` a non-negative ``device``/``round``,
+positive ``streak``, ``threshold >= 1``, ``ratio >= threshold`` and
+``wall_s >= median_s`` (a flag must describe a genuinely slow device);
+``flight_dump`` a ``reason`` from the known dump-reason set, a non-empty
+bundle ``path`` and a non-negative ``events`` count.
 Sharded-fit events (``parallel/shard.py``, README "One sharded program")
 add five schemas: ``shard_knn_build`` must carry positive integer
 ``devices``/``trees``/``depth``/``leaf_size``/``n``/``d`` with
@@ -151,6 +164,13 @@ section — that its nearest-rank p50/p95/p99/p999 recompute exactly from
 the trace's ``predict_batch`` walls (same 1e-6 tolerance) — the round-trip
 guarantees the tier-1 e2e tests pin.
 
+Rotated trace sets (``JsonlSink`` ``rotate_bytes``, README "Deep
+observability"): when ``TRACE.jsonl.1`` sits next to ``TRACE.jsonl`` the
+pair is validated as ONE logical trace — the rotated file first, then the
+live file — with every cross-event invariant spanning the boundary, and
+the live file's first ``seq`` per process must be exactly contiguous with
+the rotated file's last (a gap means the rotation lost lines).
+
 Exit code 0 = valid; 1 = any violation (all violations printed). Pure
 stdlib on purpose: the validator must run where the run artifacts land,
 without the package or jax installed.
@@ -160,6 +180,7 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import sys
 
 #: Kept in sync with ``hdbscan_tpu.utils.tracing.TRACE_SCHEMA`` /
@@ -207,267 +228,317 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
     mem_running_max: dict = {}  # per-(process, phase) mem_sample running max
     hb_progress: dict = {}  # per-(process, phase, task) heartbeat progress
     last_shard_round: dict = {}  # per-process (round, n_comp) Borůvka state
-    with open(path, encoding="utf-8") as f:
-        for lineno, line in enumerate(f, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                ev = json.loads(line)
-            except json.JSONDecodeError as e:
-                errors.append(f"{path}:{lineno}: not valid JSON ({e})")
-                continue
-            if not isinstance(ev, dict):
-                errors.append(f"{path}:{lineno}: line is not a JSON object")
-                continue
-            events.append(ev)
-            schema = ev.get("schema")
-            if not isinstance(schema, str) or not schema.startswith(
-                TRACE_SCHEMA_PREFIX
-            ):
-                errors.append(
-                    f"{path}:{lineno}: schema={schema!r} (want "
-                    f"{TRACE_SCHEMA_PREFIX}<n>)"
-                )
-            if not isinstance(ev.get("stage"), str) or not ev.get("stage"):
-                errors.append(f"{path}:{lineno}: missing/non-string 'stage'")
-            stage = ev.get("stage")
-            if isinstance(stage, str) and stage.startswith("tree_"):
-                # Finalize-stage invariants (models/_finalize.py).
-                if stage not in TREE_STAGES_ALL:
-                    errors.append(
-                        f"{path}:{lineno}: unknown finalize stage {stage!r} "
-                        f"(want one of {sorted(TREE_STAGES_ALL)})"
-                    )
-                backend = ev.get("backend")
-                if not isinstance(backend, str) or not backend:
-                    errors.append(
-                        f"{path}:{lineno}: {stage} lacks a string 'backend' tag"
-                    )
-            wall = ev.get("wall_s")
-            if not isinstance(wall, (int, float)) or isinstance(wall, bool) or (
-                isinstance(wall, float) and not math.isfinite(wall)
-            ):
-                errors.append(f"{path}:{lineno}: wall_s={wall!r} not finite number")
-            seq = ev.get("seq")
-            proc = ev.get("process")
-            if isinstance(seq, int):
-                prev = last_seq.get(proc)
-                if prev is not None and seq <= prev:
-                    errors.append(
-                        f"{path}:{lineno}: seq {seq} not increasing (prev {prev})"
-                    )
-                last_seq[proc] = seq
-            # Ring-scan invariants (parallel/ring.py). Summary events carry
-            # devices + ppermute_steps: one full panel rotation is exactly
-            # devices - 1 permutes (the final panel is scanned in place).
-            devices = ev.get("devices")
-            steps = ev.get("ppermute_steps")
-            if isinstance(devices, int) and steps is not None:
-                if not isinstance(steps, int) or steps != devices - 1:
-                    errors.append(
-                        f"{path}:{lineno}: ppermute_steps={steps!r} != "
-                        f"devices - 1 ({devices} devices)"
-                    )
-            # Serving invariants (serve/predict.py): batches dispatch into
-            # power-of-two buckets (the zero-recompile bucket set), never
-            # carry more real rows than the bucket holds, and the dispatch
-            # order is totally ordered per process.
-            if stage == "predict_batch":
-                bucket = ev.get("bucket")
-                rows = ev.get("rows")
-                if not isinstance(bucket, int) or bucket < 1 or (
-                    bucket & (bucket - 1)
+    last_tl_round: dict = {}  # per-(process, device, phase) timeline round
+    # Rotated sets (``JsonlSink`` ``rotate_bytes``): when ``<path>.1``
+    # exists, the pair is ONE logical trace — read the rotated file first,
+    # then the live file, sharing every cross-event tracker so seq order,
+    # watermark state and round contiguity all span the boundary. The
+    # sink's per-line seq keeps counting across a rotation, so the live
+    # file's first seq per process must be exactly the rotated file's last
+    # seq + 1 (a gap means lines were lost, not rotated).
+    live_path = path
+    sources = (
+        [path + ".1", path] if os.path.exists(path + ".1") else [path]
+    )
+    rotation_carry: dict | None = None
+    seen_after_rotation: set = set()
+    for path in sources:
+        rotating_boundary = rotation_carry is not None
+        with open(path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError as e:
+                    errors.append(f"{path}:{lineno}: not valid JSON ({e})")
+                    continue
+                if not isinstance(ev, dict):
+                    errors.append(f"{path}:{lineno}: line is not a JSON object")
+                    continue
+                events.append(ev)
+                schema = ev.get("schema")
+                if not isinstance(schema, str) or not schema.startswith(
+                    TRACE_SCHEMA_PREFIX
                 ):
                     errors.append(
-                        f"{path}:{lineno}: predict_batch bucket={bucket!r} "
-                        f"is not a power of two"
+                        f"{path}:{lineno}: schema={schema!r} (want "
+                        f"{TRACE_SCHEMA_PREFIX}<n>)"
                     )
-                elif not isinstance(rows, int) or not (1 <= rows <= bucket):
-                    errors.append(
-                        f"{path}:{lineno}: predict_batch rows={rows!r} not in "
-                        f"[1, bucket={bucket}]"
-                    )
-                bseq = ev.get("batch_seq")
-                if not isinstance(bseq, int):
-                    errors.append(
-                        f"{path}:{lineno}: predict_batch lacks integer "
-                        f"'batch_seq'"
-                    )
-                else:
-                    # Keyed per (process, predictor): a blue/green swap
-                    # builds a fresh Predictor whose batch_seq restarts at
-                    # 0, but each predictor's own dispatch order is total.
-                    bkey = (proc, ev.get("pred"))
-                    prev = last_batch_seq.get(bkey)
-                    if prev is not None and bseq <= prev:
+                if not isinstance(ev.get("stage"), str) or not ev.get("stage"):
+                    errors.append(f"{path}:{lineno}: missing/non-string 'stage'")
+                stage = ev.get("stage")
+                if isinstance(stage, str) and stage.startswith("tree_"):
+                    # Finalize-stage invariants (models/_finalize.py).
+                    if stage not in TREE_STAGES_ALL:
                         errors.append(
-                            f"{path}:{lineno}: batch_seq {bseq} not "
-                            f"increasing (prev {prev})"
+                            f"{path}:{lineno}: unknown finalize stage {stage!r} "
+                            f"(want one of {sorted(TREE_STAGES_ALL)})"
                         )
-                    last_batch_seq[bkey] = bseq
-            # Approximate-neighbor invariants (ops/rpforest.py): the three
-            # knn_index_* events each pin their geometry fields.
-            if stage in ("knn_index_build", "knn_index_query", "knn_index_rescan"):
-                errors += _check_knn_index(path, lineno, stage, ev)
-            # Device-MST invariants (core/mst_device.py): per-event schemas
-            # here; the one-sync-per-forest-build count check runs after the
-            # file is fully read (see below).
-            if stage in ("mst_round", "host_sync", "tree_build_device"):
-                errors += _check_mst_device(path, lineno, stage, ev)
-                if stage != "mst_round":
-                    counts = sync_counts.setdefault(proc, [0, 0])
-                    counts[0 if stage == "host_sync" else 1] += 1
-            # Streaming invariants (hdbscan_tpu/stream + serve/server.py):
-            # ingest row accounting, drift-check schema, and the blue/green
-            # contract — swap generations strictly increase per server.
-            if stage in ("stream_ingest", "drift_check", "model_refit",
-                         "model_swap"):
-                errors += _check_stream(path, lineno, stage, ev)
-                if stage == "model_swap":
-                    gen = ev.get("generation")
-                    if _pos_int(gen):
-                        key = (proc, ev.get("server"))
-                        prev = last_swap_gen.get(key)
-                        if prev is not None and gen <= prev:
+                    backend = ev.get("backend")
+                    if not isinstance(backend, str) or not backend:
+                        errors.append(
+                            f"{path}:{lineno}: {stage} lacks a string 'backend' tag"
+                        )
+                wall = ev.get("wall_s")
+                if not isinstance(wall, (int, float)) or isinstance(wall, bool) or (
+                    isinstance(wall, float) and not math.isfinite(wall)
+                ):
+                    errors.append(f"{path}:{lineno}: wall_s={wall!r} not finite number")
+                seq = ev.get("seq")
+                proc = ev.get("process")
+                if isinstance(seq, int):
+                    if rotating_boundary and proc not in seen_after_rotation:
+                        seen_after_rotation.add(proc)
+                        carried = rotation_carry.get(proc)
+                        if carried is not None and seq != carried + 1:
                             errors.append(
-                                f"{path}:{lineno}: model_swap generation "
-                                f"{gen} not increasing (prev {prev}) for "
-                                f"server {ev.get('server')!r}"
+                                f"{path}:{lineno}: rotated set discontinuous: "
+                                f"seq {seq} does not continue {live_path}.1 "
+                                f"(last seq {carried})"
                             )
-                        last_swap_gen[key] = gen
-            # Incremental-maintenance invariants (hdbscan_tpu/incremental):
-            # splice edge-count reconciliation, dirty-subtree bounds, and
-            # the fallback-event schema.
-            if stage in ("mst_splice", "subtree_finalize",
-                         "maintain_fallback"):
-                errors += _check_maintain(path, lineno, stage, ev)
-            # Request-span invariants (serve/server.py): per-event schema
-            # here; per-process request-id uniqueness needs cross-event
-            # state so it lives in this loop.
-            if stage == "request_span":
-                errors += _check_request_span(path, lineno, ev)
-                rid = ev.get("request_id")
-                if isinstance(rid, str) and rid:
-                    seen = seen_request_ids.setdefault(proc, set())
-                    if rid in seen:
+                    prev = last_seq.get(proc)
+                    if prev is not None and seq <= prev:
                         errors.append(
-                            f"{path}:{lineno}: request_span request_id "
-                            f"{rid!r} repeated within process {proc!r}"
+                            f"{path}:{lineno}: seq {seq} not increasing (prev {prev})"
                         )
-                    seen.add(rid)
-            # Fault-tolerance invariants (hdbscan_tpu/fault + stream/wal.py):
-            # per-event schemas in the helper; the shed/span request-id
-            # exclusivity and the per-(process, wal) seq contiguity need
-            # cross-event state so they live in this loop.
-            if stage in ("fault_injected", "request_shed", "circuit_state",
-                         "retry_backoff", "wal_append", "wal_recover"):
-                errors += _check_fault(path, lineno, stage, ev)
-                if stage == "request_shed":
+                    last_seq[proc] = seq
+                # Ring-scan invariants (parallel/ring.py). Summary events carry
+                # devices + ppermute_steps: one full panel rotation is exactly
+                # devices - 1 permutes (the final panel is scanned in place).
+                devices = ev.get("devices")
+                steps = ev.get("ppermute_steps")
+                if isinstance(devices, int) and steps is not None:
+                    if not isinstance(steps, int) or steps != devices - 1:
+                        errors.append(
+                            f"{path}:{lineno}: ppermute_steps={steps!r} != "
+                            f"devices - 1 ({devices} devices)"
+                        )
+                # Serving invariants (serve/predict.py): batches dispatch into
+                # power-of-two buckets (the zero-recompile bucket set), never
+                # carry more real rows than the bucket holds, and the dispatch
+                # order is totally ordered per process.
+                if stage == "predict_batch":
+                    bucket = ev.get("bucket")
+                    rows = ev.get("rows")
+                    if not isinstance(bucket, int) or bucket < 1 or (
+                        bucket & (bucket - 1)
+                    ):
+                        errors.append(
+                            f"{path}:{lineno}: predict_batch bucket={bucket!r} "
+                            f"is not a power of two"
+                        )
+                    elif not isinstance(rows, int) or not (1 <= rows <= bucket):
+                        errors.append(
+                            f"{path}:{lineno}: predict_batch rows={rows!r} not in "
+                            f"[1, bucket={bucket}]"
+                        )
+                    bseq = ev.get("batch_seq")
+                    if not isinstance(bseq, int):
+                        errors.append(
+                            f"{path}:{lineno}: predict_batch lacks integer "
+                            f"'batch_seq'"
+                        )
+                    else:
+                        # Keyed per (process, predictor): a blue/green swap
+                        # builds a fresh Predictor whose batch_seq restarts at
+                        # 0, but each predictor's own dispatch order is total.
+                        bkey = (proc, ev.get("pred"))
+                        prev = last_batch_seq.get(bkey)
+                        if prev is not None and bseq <= prev:
+                            errors.append(
+                                f"{path}:{lineno}: batch_seq {bseq} not "
+                                f"increasing (prev {prev})"
+                            )
+                        last_batch_seq[bkey] = bseq
+                # Approximate-neighbor invariants (ops/rpforest.py): the three
+                # knn_index_* events each pin their geometry fields.
+                if stage in ("knn_index_build", "knn_index_query", "knn_index_rescan"):
+                    errors += _check_knn_index(path, lineno, stage, ev)
+                # Device-MST invariants (core/mst_device.py): per-event schemas
+                # here; the one-sync-per-forest-build count check runs after the
+                # file is fully read (see below).
+                if stage in ("mst_round", "host_sync", "tree_build_device"):
+                    errors += _check_mst_device(path, lineno, stage, ev)
+                    if stage != "mst_round":
+                        counts = sync_counts.setdefault(proc, [0, 0])
+                        counts[0 if stage == "host_sync" else 1] += 1
+                # Streaming invariants (hdbscan_tpu/stream + serve/server.py):
+                # ingest row accounting, drift-check schema, and the blue/green
+                # contract — swap generations strictly increase per server.
+                if stage in ("stream_ingest", "drift_check", "model_refit",
+                             "model_swap"):
+                    errors += _check_stream(path, lineno, stage, ev)
+                    if stage == "model_swap":
+                        gen = ev.get("generation")
+                        if _pos_int(gen):
+                            key = (proc, ev.get("server"))
+                            prev = last_swap_gen.get(key)
+                            if prev is not None and gen <= prev:
+                                errors.append(
+                                    f"{path}:{lineno}: model_swap generation "
+                                    f"{gen} not increasing (prev {prev}) for "
+                                    f"server {ev.get('server')!r}"
+                                )
+                            last_swap_gen[key] = gen
+                # Incremental-maintenance invariants (hdbscan_tpu/incremental):
+                # splice edge-count reconciliation, dirty-subtree bounds, and
+                # the fallback-event schema.
+                if stage in ("mst_splice", "subtree_finalize",
+                             "maintain_fallback"):
+                    errors += _check_maintain(path, lineno, stage, ev)
+                # Request-span invariants (serve/server.py): per-event schema
+                # here; per-process request-id uniqueness needs cross-event
+                # state so it lives in this loop.
+                if stage == "request_span":
+                    errors += _check_request_span(path, lineno, ev)
                     rid = ev.get("request_id")
                     if isinstance(rid, str) and rid:
                         seen = seen_request_ids.setdefault(proc, set())
                         if rid in seen:
                             errors.append(
-                                f"{path}:{lineno}: request_shed request_id "
-                                f"{rid!r} repeated within process {proc!r} — "
-                                f"a request terminates as exactly one of "
-                                f"span/shed"
+                                f"{path}:{lineno}: request_span request_id "
+                                f"{rid!r} repeated within process {proc!r}"
                             )
                         seen.add(rid)
-                elif stage == "wal_append":
-                    wseq = ev.get("wal_seq")
-                    if _nonneg_int(wseq):
-                        key = (proc, ev.get("wal"))
-                        prev = last_wal_seq.get(key)
-                        reset = wseq == 0 and ev.get("kind") == "begin"
-                        if prev is not None and wseq != prev + 1 and not reset:
-                            errors.append(
-                                f"{path}:{lineno}: wal_append seq {wseq} not "
-                                f"contiguous (prev {prev}) for wal "
-                                f"{ev.get('wal')!r}"
-                            )
-                        last_wal_seq[key] = wseq
-            # Fleet invariants (hdbscan_tpu/fleet): router routing/health
-            # events and tenant-registry lifecycle events.
-            if stage in ("fleet_route", "replica_health", "tenant_load",
-                         "tenant_evict"):
-                errors += _check_fleet(path, lineno, stage, ev)
-            # Sharded-fit invariants (parallel/shard.py): per-event schemas
-            # in the helper; the round-contiguity and component-contraction
-            # checks need cross-event state so they live in this loop.
-            if stage in ("shard_knn_build", "shard_panel_sweep",
-                         "shard_knn_exchange", "shard_boruvka_scan",
-                         "replication_gate"):
-                errors += _check_shard(path, lineno, stage, ev)
-                if stage == "shard_boruvka_scan":
-                    rnd, nc = ev.get("round"), ev.get("n_comp")
-                    if _nonneg_int(rnd) and _pos_int(nc):
-                        prev = last_shard_round.get(proc)
-                        if rnd == 0:
-                            pass  # a fresh scanner restarts the sequence
-                        elif prev is None or rnd != prev[0] + 1:
-                            errors.append(
-                                f"{path}:{lineno}: shard_boruvka_scan round "
-                                f"{rnd} not contiguous (prev "
-                                f"{None if prev is None else prev[0]})"
-                            )
-                        elif nc >= prev[1]:
-                            errors.append(
-                                f"{path}:{lineno}: shard_boruvka_scan "
-                                f"n_comp {nc} did not decrease (prev "
-                                f"{prev[1]}) — Borůvka must contract "
-                                f"components every round"
-                            )
-                        last_shard_round[proc] = (rnd, nc)
-            # Deep-observability invariants (hdbscan_tpu/obs): per-event
-            # schemas in the helper; the peak-covers-samples and monotone-
-            # progress checks need cross-event state so they live here.
-            if stage in ("mem_sample", "mem_phase_peak", "heartbeat",
-                         "watchdog_stall", "router_span"):
-                errors += _check_obs(path, lineno, stage, ev)
-                if stage == "mem_sample":
-                    mx = ev.get("max_device_bytes")
-                    if _nonneg_int(mx):
+                # Fault-tolerance invariants (hdbscan_tpu/fault + stream/wal.py):
+                # per-event schemas in the helper; the shed/span request-id
+                # exclusivity and the per-(process, wal) seq contiguity need
+                # cross-event state so they live in this loop.
+                if stage in ("fault_injected", "request_shed", "circuit_state",
+                             "retry_backoff", "wal_append", "wal_recover"):
+                    errors += _check_fault(path, lineno, stage, ev)
+                    if stage == "request_shed":
+                        rid = ev.get("request_id")
+                        if isinstance(rid, str) and rid:
+                            seen = seen_request_ids.setdefault(proc, set())
+                            if rid in seen:
+                                errors.append(
+                                    f"{path}:{lineno}: request_shed request_id "
+                                    f"{rid!r} repeated within process {proc!r} — "
+                                    f"a request terminates as exactly one of "
+                                    f"span/shed"
+                                )
+                            seen.add(rid)
+                    elif stage == "wal_append":
+                        wseq = ev.get("wal_seq")
+                        if _nonneg_int(wseq):
+                            key = (proc, ev.get("wal"))
+                            prev = last_wal_seq.get(key)
+                            reset = wseq == 0 and ev.get("kind") == "begin"
+                            if prev is not None and wseq != prev + 1 and not reset:
+                                errors.append(
+                                    f"{path}:{lineno}: wal_append seq {wseq} not "
+                                    f"contiguous (prev {prev}) for wal "
+                                    f"{ev.get('wal')!r}"
+                                )
+                            last_wal_seq[key] = wseq
+                # Fleet invariants (hdbscan_tpu/fleet): router routing/health
+                # events and tenant-registry lifecycle events.
+                if stage in ("fleet_route", "replica_health", "tenant_load",
+                             "tenant_evict"):
+                    errors += _check_fleet(path, lineno, stage, ev)
+                # Sharded-fit invariants (parallel/shard.py): per-event schemas
+                # in the helper; the round-contiguity and component-contraction
+                # checks need cross-event state so they live in this loop.
+                if stage in ("shard_knn_build", "shard_panel_sweep",
+                             "shard_knn_exchange", "shard_boruvka_scan",
+                             "replication_gate"):
+                    errors += _check_shard(path, lineno, stage, ev)
+                    if stage == "shard_boruvka_scan":
+                        rnd, nc = ev.get("round"), ev.get("n_comp")
+                        if _nonneg_int(rnd) and _pos_int(nc):
+                            prev = last_shard_round.get(proc)
+                            if rnd == 0:
+                                pass  # a fresh scanner restarts the sequence
+                            elif prev is None or rnd != prev[0] + 1:
+                                errors.append(
+                                    f"{path}:{lineno}: shard_boruvka_scan round "
+                                    f"{rnd} not contiguous (prev "
+                                    f"{None if prev is None else prev[0]})"
+                                )
+                            elif nc >= prev[1]:
+                                errors.append(
+                                    f"{path}:{lineno}: shard_boruvka_scan "
+                                    f"n_comp {nc} did not decrease (prev "
+                                    f"{prev[1]}) — Borůvka must contract "
+                                    f"components every round"
+                                )
+                            last_shard_round[proc] = (rnd, nc)
+                # Deep-observability invariants (hdbscan_tpu/obs): per-event
+                # schemas in the helper; the peak-covers-samples and monotone-
+                # progress checks need cross-event state so they live here.
+                if stage in ("mem_sample", "mem_phase_peak", "heartbeat",
+                             "watchdog_stall", "router_span"):
+                    errors += _check_obs(path, lineno, stage, ev)
+                    if stage == "mem_sample":
+                        mx = ev.get("max_device_bytes")
+                        if _nonneg_int(mx):
+                            key = (proc, ev.get("phase"))
+                            if mx > mem_running_max.get(key, -1):
+                                mem_running_max[key] = mx
+                    elif stage == "mem_phase_peak":
+                        peak = ev.get("max_device_bytes")
                         key = (proc, ev.get("phase"))
-                        if mx > mem_running_max.get(key, -1):
-                            mem_running_max[key] = mx
-                elif stage == "mem_phase_peak":
-                    peak = ev.get("max_device_bytes")
-                    key = (proc, ev.get("phase"))
-                    running = mem_running_max.pop(key, None)
-                    if _nonneg_int(peak) and running is not None and (
-                        peak < running
-                    ):
-                        errors.append(
-                            f"{path}:{lineno}: mem_phase_peak "
-                            f"max_device_bytes {peak} < running sample max "
-                            f"{running} for phase {ev.get('phase')!r} — a "
-                            f"phase peak cannot under-report its own samples"
-                        )
-                elif stage == "heartbeat":
-                    p = ev.get("progress")
-                    if isinstance(p, (int, float)) and not isinstance(p, bool):
-                        key = (proc, ev.get("phase"), ev.get("task"))
-                        prev = hb_progress.get(key)
-                        if prev is not None and float(p) < prev:
+                        running = mem_running_max.pop(key, None)
+                        if _nonneg_int(peak) and running is not None and (
+                            peak < running
+                        ):
                             errors.append(
-                                f"{path}:{lineno}: heartbeat progress {p} "
-                                f"moved backwards (prev {prev}) for task "
-                                f"{key[1]!r}/{key[2]!r}"
+                                f"{path}:{lineno}: mem_phase_peak "
+                                f"max_device_bytes {peak} < running sample max "
+                                f"{running} for phase {ev.get('phase')!r} — a "
+                                f"phase peak cannot under-report its own samples"
                             )
-                        hb_progress[key] = max(prev or 0.0, float(p))
-            # Per-device wall events: each device's timeline must be ordered.
-            device = ev.get("device")
-            if isinstance(device, int) and isinstance(seq, int):
-                key = (proc, device)
-                prev = last_dev_seq.get(key)
-                if prev is not None and seq <= prev:
-                    errors.append(
-                        f"{path}:{lineno}: device {device} seq {seq} not "
-                        f"increasing (prev {prev})"
-                    )
-                last_dev_seq[key] = seq
+                    elif stage == "heartbeat":
+                        p = ev.get("progress")
+                        if isinstance(p, (int, float)) and not isinstance(p, bool):
+                            key = (proc, ev.get("phase"), ev.get("task"))
+                            prev = hb_progress.get(key)
+                            if prev is not None and float(p) < prev:
+                                errors.append(
+                                    f"{path}:{lineno}: heartbeat progress {p} "
+                                    f"moved backwards (prev {prev}) for task "
+                                    f"{key[1]!r}/{key[2]!r}"
+                                )
+                            hb_progress[key] = max(prev or 0.0, float(p))
+                # Mesh-timeline invariants (obs/timeline.py, obs/flightrec.py):
+                # per-event schemas (including the telescoping decomposition) in
+                # the helper; round contiguity per (process, device, phase)
+                # needs cross-event state so it lives here. A device's rounds
+                # within one phase may only repeat, advance by one, or reset to
+                # a lower value (a fresh scanner) — a forward jump means the
+                # recorder dropped a round.
+                if stage in ("device_timeline", "straggler_flag", "flight_dump"):
+                    errors += _check_timeline(path, lineno, stage, ev)
+                    if stage == "device_timeline":
+                        rnd = ev.get("round")
+                        dev = ev.get("device")
+                        if _nonneg_int(rnd) and _nonneg_int(dev):
+                            key = (proc, dev, ev.get("phase"))
+                            prev = last_tl_round.get(key)
+                            if prev is not None and rnd > prev + 1:
+                                errors.append(
+                                    f"{path}:{lineno}: device_timeline round "
+                                    f"{rnd} skipped ahead (prev {prev}) for "
+                                    f"device {dev} phase {ev.get('phase')!r}"
+                                )
+                            last_tl_round[key] = rnd
+                # Per-device wall events: each device's timeline must be ordered.
+                device = ev.get("device")
+                if isinstance(device, int) and isinstance(seq, int):
+                    key = (proc, device)
+                    prev = last_dev_seq.get(key)
+                    if prev is not None and seq <= prev:
+                        errors.append(
+                            f"{path}:{lineno}: device {device} seq {seq} not "
+                            f"increasing (prev {prev})"
+                        )
+                    last_dev_seq[key] = seq
+        if path != live_path:
+            rotation_carry = dict(last_seq)
+    path = live_path
     # The single-sync contract: the device MST pipeline fetches ONCE per
     # forest build, so a process's host_sync count must equal its
     # tree_build_device count (core/mst_device.py / models/exact._fit_device).
@@ -962,8 +1033,19 @@ def _check_obs(path: str, lineno: int, stage: str, ev: dict) -> list[str]:
                     f"{where} {key}={ev.get(key)!r} not a non-negative int"
                 )
         if stage == "mem_phase_peak":
+            sampled = ev.get("sampled")
+            if "sampled" in ev and not isinstance(sampled, bool):
+                errors.append(f"{where} sampled={sampled!r} not a bool")
             for key in ("samples", "devices"):
-                if not _pos_int(ev.get(key)):
+                # ``sampled: false`` marks a phase whose sampling failed or
+                # raced teardown (audit.py) — its counts are honestly zero.
+                if sampled is False:
+                    if not _nonneg_int(ev.get(key)):
+                        errors.append(
+                            f"{where} {key}={ev.get(key)!r} not a "
+                            f"non-negative int"
+                        )
+                elif not _pos_int(ev.get(key)):
                     errors.append(
                         f"{where} {key}={ev.get(key)!r} not a positive int"
                     )
@@ -1030,6 +1112,107 @@ def _check_obs(path: str, lineno: int, stage: str, ev: dict) -> list[str]:
             )
         if not isinstance(ev.get("replied"), bool):
             errors.append(f"{where} replied={ev.get('replied')!r} not a bool")
+    return errors
+
+
+#: Every reason a flight-recorder bundle may be dumped (obs/flightrec.py).
+FLIGHT_DUMP_REASONS = (
+    "watchdog_stall", "replication_gate", "slo_breach", "exception",
+    "sigterm", "manual",
+)
+
+#: The three telescoping segments of a device_timeline row.
+TIMELINE_SEGMENTS = ("compute_s", "comm_s", "host_s")
+
+
+def _check_timeline(path: str, lineno: int, stage: str, ev: dict) -> list[str]:
+    """The three mesh-timeline schemas (obs/timeline.py, obs/flightrec.py):
+    device_timeline rows must telescope — compute_s + comm_s + host_s equals
+    wall_s within ``WALL_TOLERANCE`` — straggler_flag rows must be
+    self-consistent (the flagged wall really exceeds threshold × median),
+    and flight_dump rows must name a known reason and a bundle path. Round
+    contiguity lives in the main loop (it needs per-device state)."""
+    errors: list[str] = []
+    where = f"{path}:{lineno}: {stage}"
+    if stage == "device_timeline":
+        if not isinstance(ev.get("phase"), str) or not ev.get("phase"):
+            errors.append(f"{where} lacks a non-empty string 'phase'")
+        for key in ("device", "round", "comm_bytes"):
+            if not _nonneg_int(ev.get(key)):
+                errors.append(
+                    f"{where} {key}={ev.get(key)!r} not a non-negative int"
+                )
+        segs_ok = True
+        for key in TIMELINE_SEGMENTS:
+            if not _finite_nonneg(ev.get(key)):
+                errors.append(
+                    f"{where} {key}={ev.get(key)!r} not a finite "
+                    f"non-negative number"
+                )
+                segs_ok = False
+        if ev.get("attribution") != "model":
+            errors.append(
+                f"{where} attribution={ev.get('attribution')!r} != 'model' — "
+                f"the comm/compute split comes from a cost model, and the "
+                f"event must say so"
+            )
+        wall = ev.get("wall_s")
+        if segs_ok and _finite_nonneg(wall):
+            total = sum(float(ev[key]) for key in TIMELINE_SEGMENTS)
+            if not math.isclose(
+                total, float(wall), rel_tol=0.0, abs_tol=WALL_TOLERANCE
+            ):
+                errors.append(
+                    f"{where} segments sum to {total} but wall_s={wall} "
+                    f"(tol {WALL_TOLERANCE}) — the decomposition must "
+                    f"telescope exactly"
+                )
+    elif stage == "straggler_flag":
+        if not isinstance(ev.get("phase"), str) or not ev.get("phase"):
+            errors.append(f"{where} lacks a non-empty string 'phase'")
+        for key in ("device", "round"):
+            if not _nonneg_int(ev.get(key)):
+                errors.append(
+                    f"{where} {key}={ev.get(key)!r} not a non-negative int"
+                )
+        if not _pos_int(ev.get("streak")):
+            errors.append(
+                f"{where} streak={ev.get('streak')!r} not a positive int"
+            )
+        thr = ev.get("threshold")
+        if not _finite_nonneg(thr) or float(thr) < 1.0:
+            errors.append(f"{where} threshold={thr!r} not a number >= 1")
+        ratio = ev.get("ratio")
+        if not _finite_nonneg(ratio):
+            errors.append(f"{where} ratio={ratio!r} not a finite number")
+        elif _finite_nonneg(thr) and float(ratio) < float(thr) - WALL_TOLERANCE:
+            # The event rounds ratio to 6 decimals; give the comparison the
+            # same tolerance every other rounded-wall check gets.
+            errors.append(
+                f"{where} ratio={ratio} below threshold={thr} — a flag "
+                f"must only fire at or above the configured skew"
+            )
+        med = ev.get("median_s")
+        dev_wall = ev.get("wall_s")
+        if _finite_nonneg(med) and _finite_nonneg(dev_wall) and (
+            float(dev_wall) < float(med)
+        ):
+            errors.append(
+                f"{where} wall_s={dev_wall} < median_s={med} — a straggler "
+                f"cannot be faster than the round median"
+            )
+    else:  # flight_dump
+        if ev.get("reason") not in FLIGHT_DUMP_REASONS:
+            errors.append(
+                f"{where} reason={ev.get('reason')!r} not in "
+                f"{FLIGHT_DUMP_REASONS}"
+            )
+        if not isinstance(ev.get("path"), str) or not ev.get("path"):
+            errors.append(f"{where} lacks a non-empty string 'path'")
+        if not _nonneg_int(ev.get("events")):
+            errors.append(
+                f"{where} events={ev.get('events')!r} not a non-negative int"
+            )
     return errors
 
 

@@ -1,14 +1,22 @@
 """Deep-observability end-to-end (README "Observability").
 
-Two legs:
+Four legs:
 
 - **CLI fit**: a real ``python -m hdbscan_tpu`` subprocess with
   ``--trace-out``/``--report``/``--assert-not-replicated`` and the
   watchdog armed. The trace must satisfy ``scripts/check_trace.py``'s obs
-  schemas, the report must carry the per-phase memory watermark table
-  (schema ``hdbscan-tpu-report/2``), and the replication gate must pass
-  cleanly on the single-device run (the 8-device trip/pass legs live in
-  ``tests/unit/test_obs.py``).
+  schemas, the report must carry the per-phase memory watermark table,
+  and the replication gate must pass cleanly on the single-device run
+  (the 8-device trip/pass legs live in ``tests/unit/test_obs.py``).
+- **Sharded CLI timeline/roofline/flight**: the forced-8-device sharded
+  fit with the mesh timeline armed — per-device ``device_timeline``
+  events telescoping within 1e-6, a ``hdbscan-tpu-report/3`` with
+  timeline + roofline sections (bound classification for every traced
+  phase, ``cpu_smoke``-tagged), and zero flight bundles on the healthy
+  run (``scripts/check_flight.py --allow-empty`` green).
+- **200k sharded scan** (slow lane): the ISSUE-scale mesh leg — the
+  exact ring k-NN core-distance scan over 200k points, with the same
+  telescoping/roofline acceptance bar.
 - **Fleet join** (slow lane): real replica subprocesses behind the router
   with ``replica_trace_dir`` set — every routed request's ``router_span``
   must join exactly one replica ``request_span`` on the propagated
@@ -17,6 +25,7 @@ Two legs:
 """
 
 import json
+import math
 import os
 import subprocess
 import sys
@@ -27,7 +36,7 @@ import pytest
 
 from hdbscan_tpu import HDBSCANParams, obs
 from hdbscan_tpu.utils.telemetry import REPORT_SCHEMA
-from scripts import check_trace
+from scripts import check_flight, check_trace
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -93,6 +102,127 @@ def test_cli_fit_deep_observability(tmp_path):
     # The report pairs with its trace under the full validator.
     _, rep_errors = check_trace.validate_report(report, trace_events=events)
     assert rep_errors == [], rep_errors
+
+
+def _assert_timeline_report(events, rep, n_dev=8):
+    """The shared ISSUE acceptance bar for a traced mesh run: per-device
+    segments telescope to phase walls within 1e-6, the report's timeline
+    table covers every traced phase, and the roofline section classifies
+    each one compute/memory/comm-bound under honest ``cpu_smoke`` tags."""
+    tl = [e for e in events if e["stage"] == "device_timeline"]
+    assert tl, "run emitted no device_timeline events"
+    assert {e["device"] for e in tl} == set(range(n_dev))
+    for e in tl:
+        total = e["compute_s"] + e["comm_s"] + e["host_s"]
+        assert math.isclose(total, e.get("wall_s"), rel_tol=0.0, abs_tol=1e-6)
+        assert e["attribution"] == "model"
+    assert any(e["comm_bytes"] > 0 for e in tl), "no comm bytes attributed"
+
+    assert rep["schema"] == REPORT_SCHEMA
+    table = rep["timeline"]
+    traced_phases = {e["phase"] for e in tl}
+    assert set(table) == traced_phases
+    for row in table.values():
+        assert row["rounds"] >= 1 and row["devices"] == n_dev
+        assert 0.0 <= row["comm_frac"] <= 1.0
+        assert row["skew"] >= 1.0
+
+    roof = rep["roofline"]
+    assert "cpu_smoke" in roof["tags"], "forced CPU mesh must stay honest"
+    assert roof["ridge_intensity"] > 0
+    assert traced_phases <= set(roof["phases"])
+    for row in roof["phases"].values():
+        assert row["bound"] in ("compute", "memory", "comm")
+
+
+def test_cli_sharded_fit_timeline_roofline_flight(tmp_path):
+    """Sharded fit on the forced-8-device mesh with the full deep-obs
+    surface armed: mesh timeline events valid and telescoping, report/3
+    timeline + roofline sections, and the always-on flight recorder
+    writing *nothing* on a healthy run."""
+    from hdbscan_tpu.cli import main
+
+    rng = np.random.default_rng(11)
+    pts = np.concatenate(
+        [rng.normal(0.0, 1.0, (1024, 2)), rng.normal(8.0, 1.0, (1024, 2))]
+    )
+    rng.shuffle(pts)
+    csv = tmp_path / "blobs.csv"
+    np.savetxt(csv, pts, delimiter=",")
+    trace = tmp_path / "trace.jsonl"
+    report = tmp_path / "report.json"
+    flight_dir = tmp_path / "flight"
+    rc = main(
+        [
+            f"file={csv}",
+            "minPts=5",
+            "minClSize=10",
+            "fit_sharding=sharded",
+            "--trace-out", str(trace),
+            "--report", str(report),
+            "--flight-dir", str(flight_dir),
+            f"out_dir={tmp_path}",
+        ]
+    )
+    assert rc == 0
+
+    events, errors = check_trace.validate_trace(str(trace))
+    assert errors == [], errors
+    with open(report, encoding="utf-8") as f:
+        rep = json.load(f)
+    _assert_timeline_report(events, rep)
+    _, rep_errors = check_trace.validate_report(str(report), trace_events=events)
+    assert rep_errors == [], rep_errors
+
+    # Healthy run: the flight recorder dumped nothing — it creates the
+    # directory lazily at first dump, so the dir itself must not exist ...
+    assert not flight_dir.exists()
+    # ... and the validator agrees, but only under --allow-empty.
+    flight_dir.mkdir()
+    assert check_flight.main(["--allow-empty", str(flight_dir)]) == 0
+    assert check_flight.main([str(flight_dir)]) == 1
+
+
+@pytest.mark.slow
+def test_sharded_200k_scan_timeline_roofline(tmp_path):
+    """The ISSUE-scale mesh leg: 200k points through the exact ring
+    k-NN core-distance scan (~300s on the shared-core CPU smoke mesh;
+    the full Boruvka fit adds O(n^2) *per round* and the sharded
+    rp-forest tier's panel sweep is slower still here — 400s at 25k —
+    so this is the largest honest 200k program, same precedent as
+    test_sharded_scan.py's 100k leg), with the report/3 timeline +
+    roofline acceptance bar."""
+    from hdbscan_tpu.parallel.mesh import get_mesh
+    from hdbscan_tpu.parallel.shard import shard_core_distances
+    from hdbscan_tpu.utils.telemetry import build_report
+    from hdbscan_tpu.utils.tracing import JsonlSink, Tracer
+
+    rng = np.random.default_rng(3)
+    centers = rng.uniform(-40.0, 40.0, size=(32, 3))
+    data = centers[np.arange(200_000) % 32] + rng.normal(
+        0, 0.5, (200_000, 3)
+    )
+    trace_path = str(tmp_path / "trace.jsonl")
+    tracer = Tracer(sinks=[JsonlSink(trace_path)])
+    from hdbscan_tpu.obs.timeline import TimelineRecorder
+
+    rec = TimelineRecorder(trace=tracer)
+    with obs.installed(timeline=rec):
+        core = shard_core_distances(
+            data, 5, mesh=get_mesh(), trace=tracer, index="exact"
+        )
+    tracer.close()
+    core = np.asarray(core)
+    assert core.shape == (200_000,)
+    assert np.all(np.isfinite(core)) and np.all(core > 0)
+
+    rep = build_report(tracer, timeline=rec.phase_table())
+    events, errors = check_trace.validate_trace(trace_path)
+    assert errors == [], errors
+    _assert_timeline_report(events, rep)
+    # 200k rows over 8 shards: every traced round moved real panel bytes.
+    tl = [e for e in events if e["stage"] == "device_timeline"]
+    assert sum(e["comm_bytes"] for e in tl) >= 200_000 * 3 * 4
 
 
 @pytest.fixture(scope="module")

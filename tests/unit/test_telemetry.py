@@ -309,4 +309,6 @@ def test_check_trace_is_stdlib_only():
         else:
             continue
         for mod in mods:
-            assert mod.split(".")[0] in ("__future__", "json", "math", "sys"), mod
+            assert mod.split(".")[0] in (
+                "__future__", "json", "math", "os", "sys",
+            ), mod

@@ -1,0 +1,553 @@
+"""Mesh timelines, roofline attribution, and the flight recorder
+(hdbscan_tpu/obs/timeline.py, roofline.py, flightrec.py).
+
+Covers the ISSUE acceptance legs that fit in the unit lane, all on the
+forced-8-device CPU mesh (conftest):
+
+- every ``device_timeline`` event telescopes — ``compute_s + comm_s +
+  host_s == wall_s`` within 1e-6 — both for synthetic rounds and through
+  a real ring k-NN scan, and the written trace satisfies
+  ``scripts/check_trace.py``'s new schemas;
+- skew/straggler math: a device at >= ``skew_threshold``x the round
+  median for ``straggler_rounds`` consecutive rounds is flagged, the
+  counter increments per flagged round, streaks reset on recovery;
+- a deliberately stalled device — the fault harness's ``phase_stall``
+  site fires inside ``_per_device_walls`` — trips the detector within K
+  rounds of a real ring scan;
+- the flight recorder dumps a valid bundle on a watchdog stall (and
+  exactly zero bundles on a healthy run), validated by
+  ``scripts/check_flight.py``;
+- ``JsonlSink`` rotation keeps at most two files with exactly contiguous
+  ``seq`` across the boundary, and ``check_trace.py`` validates the pair
+  as one logical trace.
+"""
+
+import glob
+import json
+import math
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from hdbscan_tpu import obs
+from hdbscan_tpu.fault import inject
+from hdbscan_tpu.obs.audit import MemoryAuditor
+from hdbscan_tpu.obs.flightrec import FlightRecorder
+from hdbscan_tpu.obs.heartbeat import Heartbeats
+from hdbscan_tpu.obs.roofline import (
+    COMM_BOUND_FRAC,
+    classify_bound,
+    roofline_section,
+)
+from hdbscan_tpu.obs.timeline import (
+    MODEL_COMM_BYTES_S,
+    TimelineRecorder,
+    _split_exec,
+)
+from hdbscan_tpu.parallel.mesh import get_mesh
+from hdbscan_tpu.parallel.ring import ring_knn_core_distances
+from hdbscan_tpu.utils import flops as flops_mod
+from hdbscan_tpu.utils.tracing import JsonlSink, Tracer
+from scripts import check_flight, check_trace
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="timelines need a multi-device mesh"
+)
+
+TILES = dict(row_tile=64, col_tile=128)
+
+
+@pytest.fixture(autouse=True)
+def _clean_installs():
+    """Never leak a process-global recorder/fault-plan across tests."""
+    yield
+    obs.clear()
+    inject.clear()
+
+
+def _events(tracer, stage):
+    return [e for e in tracer.events if e.name == stage]
+
+
+def _blobs(n, d=5, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=6.0, size=(4, d))
+    pts = np.concatenate(
+        [rng.normal(c, 0.8, size=(n // 4, d)) for c in centers]
+        + [rng.normal(size=(n - 4 * (n // 4), d))]
+    )
+    return pts.astype(np.float64)
+
+
+class _Counter:
+    """hdbscan_tpu_straggler_flags_total test double."""
+
+    def __init__(self):
+        self.calls = []
+
+    def inc(self, value=1.0, **labels):
+        self.calls.append((value, labels))
+
+
+# -- the cost-model split ---------------------------------------------------
+
+
+def test_split_exec_telescopes_exactly():
+    comp, comm = _split_exec(0.5, comm_bytes=MODEL_COMM_BYTES_S,
+                             flops=flops_mod.PEAK_FLOPS)
+    # Equal model times -> an even split; the halves sum back exactly.
+    assert comp == pytest.approx(0.25)
+    assert comm == pytest.approx(0.25)
+    assert comp + comm == 0.5
+
+
+def test_split_exec_degenerate_cases():
+    assert _split_exec(0.0, 1e9, 1e12) == (0.0, 0.0)
+    # No model signal at all: the whole wall is compute, never NaN.
+    assert _split_exec(0.3, 0, 0.0) == (0.3, 0.0)
+    # Pure comm: the whole wall attributes to the ring.
+    comp, comm = _split_exec(0.3, 1e9, 0.0)
+    assert comp == 0.0 and comm == 0.3
+
+
+# -- record_round: telescoping + skew ---------------------------------------
+
+
+def test_record_round_events_telescope():
+    tracer = Tracer()
+    rec = TimelineRecorder(trace=tracer)
+    walls = [(d, 0.010 + 0.001 * d) for d in range(8)]
+    stats = rec.record_round(
+        "ring_knn_scan", 0, walls, upload_s=0.004, fetch_s=0.003,
+        comm_bytes=7 * 2**20, flops=4.2e9,
+    )
+    evs = _events(tracer, "device_timeline")
+    assert len(evs) == 8
+    assert {e.fields["device"] for e in evs} == set(range(8))
+    for e in evs:
+        f = e.fields
+        assert f["attribution"] == "model"
+        assert f["comm_bytes"] == 7 * 2**20
+        total = f["compute_s"] + f["comm_s"] + f["host_s"]
+        assert math.isclose(total, e.wall_s, rel_tol=0.0, abs_tol=1e-6)
+        # Host segments bracket the dispatch: the same measured value
+        # lands on every device's row.
+        assert f["host_s"] == pytest.approx(0.007, abs=1e-9)
+    assert stats["skew"] == pytest.approx(0.017 / 0.0135, rel=1e-4)
+    assert stats["flagged"] == []
+
+
+def test_record_round_empty_is_noop():
+    rec = TimelineRecorder()
+    assert rec.record_round("p", 0, []) is None
+    assert rec.phase_table() == {}
+
+
+def test_phase_table_accumulates_and_derives():
+    rec = TimelineRecorder()
+    for rnd in range(3):
+        rec.record_round("scan", rnd, [(d, 0.010) for d in range(8)],
+                         comm_bytes=2**20, flops=1e9)
+    tbl = rec.phase_table()["scan"]
+    assert tbl["rounds"] == 3
+    assert tbl["devices"] == 8
+    assert tbl["comm_bytes"] == 3 * 8 * 2**20
+    assert tbl["flops"] == pytest.approx(3e9)
+    assert tbl["wall_s"] == pytest.approx(0.030, abs=1e-8)
+    assert 0.0 <= tbl["comm_frac"] <= 1.0
+    assert tbl["skew"] >= 1.0
+
+
+def test_straggler_trips_after_k_rounds_and_resets():
+    tracer = Tracer()
+    counter = _Counter()
+    rec = TimelineRecorder(skew_threshold=2.0, straggler_rounds=3,
+                           straggler_counter=counter, trace=tracer)
+
+    def round_walls(slow_dev=None):
+        return [
+            (d, 0.030 if d == slow_dev else 0.010) for d in range(8)
+        ]
+
+    # Two slow rounds: streak 2 < K, nothing fires.
+    for rnd in range(2):
+        stats = rec.record_round("scan", rnd, round_walls(slow_dev=7))
+        assert stats["flagged"] == []
+    assert _events(tracer, "straggler_flag") == []
+    # Third consecutive slow round: flag fires, and keeps firing per round.
+    for rnd in (2, 3):
+        stats = rec.record_round("scan", rnd, round_walls(slow_dev=7))
+        assert stats["flagged"] == [7]
+    flags = _events(tracer, "straggler_flag")
+    assert [e.fields["streak"] for e in flags] == [3, 4]
+    for e in flags:
+        f = e.fields
+        assert f["device"] == 7
+        assert f["ratio"] >= f["threshold"] == 2.0
+        assert e.wall_s >= f["median_s"] > 0
+    assert counter.calls == [
+        (1.0, {"device": "7"}), (1.0, {"device": "7"}),
+    ]
+    # A healthy round resets the streak; the next slow round starts at 1.
+    assert rec.record_round("scan", 4, round_walls())["flagged"] == []
+    assert rec.record_round("scan", 5, round_walls(slow_dev=7))["flagged"] == []
+    st = rec.state()
+    assert st["flags_total"] == 2
+    assert st["flags"] == {"7": 2}
+    assert st["streaks"] == {"7": 1}
+    assert st["rounds"] == 6
+
+
+def test_straggler_needs_multiple_devices_and_positive_median():
+    rec = TimelineRecorder(skew_threshold=1.0, straggler_rounds=1)
+    # One device can't straggle relative to itself.
+    assert rec.record_round("p", 0, [(0, 5.0)])["flagged"] == []
+    # A zero median (all-zero walls) never divides, never flags.
+    stats = rec.record_round("p", 1, [(0, 0.0), (1, 0.0)])
+    assert stats["flagged"] == [] and stats["skew"] == 1.0
+
+
+def test_recorder_knob_validation():
+    with pytest.raises(ValueError, match="skew_threshold"):
+        TimelineRecorder(skew_threshold=0.5)
+    with pytest.raises(ValueError, match="straggler_rounds"):
+        TimelineRecorder(straggler_rounds=0)
+
+
+# -- through a real ring scan -----------------------------------------------
+
+
+def test_ring_scan_timeline_telescopes_and_validates(tmp_path):
+    """ISSUE acceptance: a real ring k-NN scan produces device_timeline
+    rows for every mesh device that telescope within 1e-6, and the trace
+    passes check_trace's new schemas."""
+    path = str(tmp_path / "trace.jsonl")
+    tracer = Tracer(sinks=[JsonlSink(path)])
+    rec = TimelineRecorder(trace=tracer)
+    data = _blobs(256)
+    with obs.installed(timeline=rec):
+        ring_knn_core_distances(data, 5, "euclidean", mesh=get_mesh(),
+                                trace=tracer, **TILES)
+    tracer.close()
+    evs = _events(tracer, "device_timeline")
+    assert {e.fields["device"] for e in evs} == {
+        d.id for d in jax.devices()
+    }
+    for e in evs:
+        f = e.fields
+        total = f["compute_s"] + f["comm_s"] + f["host_s"]
+        assert math.isclose(total, e.wall_s, rel_tol=0.0, abs_tol=1e-6)
+        assert f["comm_bytes"] > 0  # the ring moved real panel bytes
+    # The summary event carries the round's skew stats.
+    summary = _events(tracer, "ring_knn_scan")[0].fields
+    assert summary["skew"] >= 1.0
+    assert summary["max_device_wall_s"] >= summary["median_device_wall_s"]
+    _, errors = check_trace.validate_trace(path)
+    assert errors == []
+    assert rec.phase_table()["ring_knn_scan"]["rounds"] == 1
+
+
+def test_injected_straggler_flagged_within_k_rounds():
+    """ISSUE acceptance: the phase_stall site deterministically inflates
+    the highest-id device, and the detector flags it within K rounds."""
+    mesh = get_mesh()
+    data = _blobs(256)
+    # Warm the jit cache first so compile wall can't drown the stall.
+    ring_knn_core_distances(data, 5, "euclidean", mesh=mesh, **TILES)
+    tracer = Tracer()
+    counter = _Counter()
+    rec = TimelineRecorder(skew_threshold=2.0, straggler_rounds=3,
+                           straggler_counter=counter, trace=tracer)
+    with obs.installed(timeline=rec):
+        inject.install("phase_stall:count=3,delay_s=0.25")
+        for _ in range(3):
+            ring_knn_core_distances(data, 5, "euclidean", mesh=mesh, **TILES)
+    flags = _events(tracer, "straggler_flag")
+    slowest = max(d.id for d in jax.devices())
+    assert len(flags) == 1  # the streak reaches K on the third round
+    assert flags[0].fields["device"] == slowest
+    assert flags[0].fields["streak"] == 3
+    assert counter.calls == [(1.0, {"device": str(slowest)})]
+    assert rec.state()["flags"] == {str(slowest): 1}
+
+
+# -- roofline ---------------------------------------------------------------
+
+
+def test_classify_bound():
+    ridge = flops_mod.PEAK_FLOPS / 819e9
+    assert classify_bound(None, ridge, 0.9) == "comm"
+    assert classify_bound(ridge * 2, ridge, COMM_BOUND_FRAC) == "comm"
+    assert classify_bound(ridge * 2, ridge, 0.1) == "compute"
+    assert classify_bound(ridge / 2, ridge, 0.1) == "memory"
+    assert classify_bound(None, ridge, None) == "memory"
+
+
+def test_roofline_section_joins_timeline_and_flops():
+    rec = TimelineRecorder()
+    rec.record_round("scan", 0, [(d, 0.010) for d in range(8)],
+                     comm_bytes=2**20, flops=1e9)
+    agg = {"scan": {"gflops": 1.0, "gbytes": 0.5, "wall_s": 0.010}}
+    sec = roofline_section(agg, rec.phase_table(), tags=["cpu_smoke"])
+    assert sec["tags"] == ["cpu_smoke"]
+    assert sec["ridge_intensity"] > 0
+    row = sec["phases"]["scan"]
+    assert row["bound"] in ("compute", "memory", "comm")
+    assert row["arithmetic_intensity"] == pytest.approx(2.0)
+    assert row["achieved_gflops_s"] == pytest.approx(100.0, rel=1e-3)
+    assert row["mfu"] > 0
+    assert row["rounds"] == 1 and row["devices"] == 8
+
+
+def test_roofline_section_empty_is_none():
+    assert roofline_section({}, {}) is None
+    # A phase with neither flops, bytes, nor timeline row is skipped.
+    assert roofline_section({"p": {"gflops": 0.0, "gbytes": 0.0}}) is None
+
+
+# -- flight recorder --------------------------------------------------------
+
+
+def test_flight_dumps_on_watchdog_stall(tmp_path):
+    """ISSUE acceptance: a watchdog stall auto-dumps one self-contained
+    bundle that check_flight validates green."""
+    flight_dir = str(tmp_path / "flight")
+    tracer = Tracer()
+    flight = FlightRecorder(flight_dir, manifest={"argv": ["test"]},
+                            tracer=tracer)
+    tracer.add_sink(flight)
+    inject.install("phase_stall:count=1,delay_s=0.5")
+    hub = Heartbeats(tracer=tracer, heartbeat_s=0.01, watchdog_s=0.1)
+    with obs.installed(heartbeats=hub):
+        with hub.task("stalled_phase", total=2) as t:
+            t.beat(1)  # injected 0.5 s stall before the liveness refresh
+    hub.close()
+    assert hub.stalls >= 1
+    assert len(flight.dumps) == len(glob.glob(
+        os.path.join(flight_dir, "flight-*.json")
+    )) == hub.stalls
+    bundle, errors = check_flight.validate_bundle(flight.dumps[0])
+    assert errors == []
+    assert bundle["reason"] == "watchdog_stall"
+    assert bundle["manifest"] == {"argv": ["test"]}
+    assert any(r["stage"] == "watchdog_stall" for r in bundle["events"])
+    assert all(r["stage"] == "heartbeat" for r in bundle["heartbeats"])
+    assert "--- thread" in bundle["stacks"]
+    assert check_flight.main([flight_dir]) == 0
+
+
+def test_flight_zero_dumps_on_healthy_run(tmp_path):
+    flight_dir = str(tmp_path / "flight")
+    tracer = Tracer()
+    flight = FlightRecorder(flight_dir, tracer=tracer)
+    tracer.add_sink(flight)
+    hub = Heartbeats(tracer=tracer, heartbeat_s=0.01, watchdog_s=0.5)
+    with hub.task("healthy", total=4) as t:
+        for done in range(4):
+            t.beat(done)
+            threading.Event().wait(0.02)
+    hub.close()
+    assert flight.dumps == []
+    # An armed recorder on a healthy run leaves no filesystem trace at all.
+    assert not os.path.exists(flight_dir)
+    assert check_flight.main([str(tmp_path), "--allow-empty"]) == 0
+    assert check_flight.main([str(tmp_path)]) == 1  # no bundles = not proof
+
+
+def test_flight_manual_dump_emits_event(tmp_path):
+    tracer = Tracer()
+    flight = FlightRecorder(str(tmp_path), tracer=tracer)
+    tracer.add_sink(flight)
+    tracer("some_phase", wall_s=0.25, detail="x")
+    # An installed auditor contributes its watermark TABLE (phase -> row
+    # dict, not a list) to the bundle — the validator must accept it
+    # (caught live on a replication_gate dump from the sharded CLI).
+    aud = MemoryAuditor(source="live_arrays", interval_s=0.005)
+    with obs.installed(auditor=aud):
+        with obs.mem_phase("wm_phase"):
+            time.sleep(0.03)
+        path = flight.dump("manual", extra={"why": "test"})
+    bundle, errors = check_flight.validate_bundle(path)
+    assert errors == []
+    assert isinstance(bundle["watermarks"], dict)
+    assert "wm_phase" in bundle["watermarks"]
+    assert bundle["extra"] == {"why": "test"}
+    assert bundle["events_seen"] >= 1
+    evs = _events(tracer, "flight_dump")
+    assert len(evs) == 1
+    assert evs[0].fields["reason"] == "manual"
+    assert evs[0].fields["path"] == path
+    with pytest.raises(ValueError, match="reason"):
+        flight.dump("because")
+
+
+def test_flight_ring_is_bounded(tmp_path):
+    tracer = Tracer()
+    flight = FlightRecorder(str(tmp_path), capacity=16, heartbeat_tail=2,
+                            tracer=tracer)
+    tracer.add_sink(flight)
+    for i in range(64):
+        tracer("spam", wall_s=0.001, i=i)
+    snap = flight.snapshot()
+    assert len(snap["events"]) == 16
+    assert snap["events_seen"] == 64
+    assert snap["events"][-1]["i"] == 63  # newest survive, oldest dropped
+    with pytest.raises(ValueError, match="capacity"):
+        FlightRecorder(str(tmp_path), capacity=8)
+
+
+# -- trace rotation ---------------------------------------------------------
+
+
+def test_jsonl_sink_rotates_and_check_trace_accepts(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    sink = JsonlSink(path, rotate_bytes=4096)
+    tracer = Tracer(sinks=[sink])
+    for i in range(200):
+        tracer("rotation_filler", wall_s=0.001, i=i)
+    tracer.close()
+    assert sink.rotations >= 1
+    assert os.path.exists(path) and os.path.exists(path + ".1")
+    # At most two files ever: the live file and one rotated predecessor.
+    assert sorted(glob.glob(path + "*")) == [path, path + ".1"]
+    assert os.path.getsize(path + ".1") <= 4096
+    # seq is exactly contiguous across the boundary: nothing was lost.
+    with open(path + ".1") as f:
+        rotated = [json.loads(line) for line in f]
+    with open(path) as f:
+        live = [json.loads(line) for line in f]
+    assert live[0]["seq"] == rotated[-1]["seq"] + 1
+    seqs = [e["seq"] for e in rotated + live]
+    assert seqs == list(range(seqs[0], seqs[0] + len(seqs)))
+    events, errors = check_trace.validate_trace(path)
+    assert errors == []
+    assert len(events) == len(rotated) + len(live)
+
+
+def test_jsonl_sink_rotation_off_by_default(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    tracer = Tracer(sinks=[JsonlSink(path)])
+    for i in range(200):
+        tracer("rotation_filler", wall_s=0.001, i=i)
+    tracer.close()
+    assert not os.path.exists(path + ".1")
+
+
+def test_check_trace_rejects_rotated_seq_gap(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    sink = JsonlSink(path, rotate_bytes=4096)
+    tracer = Tracer(sinks=[sink])
+    for i in range(200):
+        tracer("rotation_filler", wall_s=0.001, i=i)
+    tracer.close()
+    with open(path) as f:
+        lines = f.readlines()
+    with open(path, "w") as f:  # drop the live file's first line
+        f.writelines(lines[1:])
+    _, errors = check_trace.validate_trace(path)
+    assert any("rotated set discontinuous" in e for e in errors)
+
+
+# -- validator schemas ------------------------------------------------------
+
+
+def _trace_line(stage, **fields):
+    return dict({"schema": "hdbscan-tpu-trace/1", "stage": stage,
+                 "seq": 1, "process": 1, "wall_s": 0.01}, **fields)
+
+
+def _write_trace(tmp_path, rows):
+    path = str(tmp_path / "t.jsonl")
+    with open(path, "w") as f:
+        for i, row in enumerate(rows):
+            row["seq"] = i + 1
+            f.write(json.dumps(row) + "\n")
+    return path
+
+
+def test_check_trace_flags_broken_telescoping(tmp_path):
+    good = _trace_line(
+        "device_timeline", phase="scan", round=0, device=0, wall_s=0.010,
+        compute_s=0.006, comm_s=0.003, host_s=0.001, comm_bytes=1024,
+        attribution="model",
+    )
+    bad = dict(good, compute_s=0.009)  # sums to 0.013 != 0.010
+    _, errors = check_trace.validate_trace(_write_trace(tmp_path, [good]))
+    assert errors == []
+    _, errors = check_trace.validate_trace(_write_trace(tmp_path, [bad]))
+    assert any("telescope" in e for e in errors)
+
+
+def test_check_trace_flags_round_skip_and_allows_reset(tmp_path):
+    def tl(rnd):
+        return _trace_line(
+            "device_timeline", phase="scan", round=rnd, device=0,
+            wall_s=0.010, compute_s=0.010, comm_s=0.0, host_s=0.0,
+            comm_bytes=0, attribution="model",
+        )
+
+    # 0, 1, 0 (a fresh scanner resets) is fine; 0, 2 skipped a round.
+    _, errors = check_trace.validate_trace(
+        _write_trace(tmp_path, [tl(0), tl(1), tl(0)])
+    )
+    assert errors == []
+    _, errors = check_trace.validate_trace(
+        _write_trace(tmp_path, [tl(0), tl(2)])
+    )
+    assert any("skipped ahead" in e for e in errors)
+
+
+def test_check_trace_straggler_flag_schema(tmp_path):
+    good = _trace_line(
+        "straggler_flag", phase="scan", round=3, device=7, streak=3,
+        wall_s=0.030, median_s=0.010, ratio=3.0, threshold=2.0,
+    )
+    _, errors = check_trace.validate_trace(_write_trace(tmp_path, [good]))
+    assert errors == []
+    bad = dict(good, ratio=1.5)  # flagged below its own threshold
+    _, errors = check_trace.validate_trace(_write_trace(tmp_path, [bad]))
+    assert any("below threshold" in e for e in errors)
+
+
+def test_check_trace_flight_dump_schema(tmp_path):
+    good = _trace_line(
+        "flight_dump", reason="manual", path="/tmp/flight-1-000-manual.json",
+        events=12,
+    )
+    _, errors = check_trace.validate_trace(_write_trace(tmp_path, [good]))
+    assert errors == []
+    bad = dict(good, reason="felt_like_it")
+    _, errors = check_trace.validate_trace(_write_trace(tmp_path, [bad]))
+    assert any("reason" in e for e in errors)
+
+
+# -- audit: zero-sample phases stay honest ----------------------------------
+
+
+def test_audit_zero_sample_phase_gets_sampled_false_row():
+    """Satellite fix: a phase whose sampling failed entirely still lands
+    in the watermark table — ``sampled: false``, not a missing key."""
+    tracer = Tracer()
+    aud = MemoryAuditor(tracer=tracer, interval_s=0.005,
+                        source="live_arrays")
+    # Simulate the sampler dying mid-run: memory_stats is unavailable on
+    # CPU, so every sample attempt from here on raises (and is swallowed
+    # best-effort by the phase bracket).
+    aud._source_pref = "memory_stats"
+    with aud.phase("unsampled"):
+        pass
+    row = aud.watermark_table()["unsampled"]
+    assert row["sampled"] is False
+    assert row["samples"] == 0
+    assert row["max_device_bytes"] == 0
+    peak = _events(tracer, "mem_phase_peak")[0].fields
+    assert peak["sampled"] is False and peak["samples"] == 0
+    # The zero row is schema-valid (check_trace accepts sampled: false).
+    errors = check_trace._check_obs("t", 1, "mem_phase_peak", dict(
+        peak, schema="hdbscan-tpu-trace/1", stage="mem_phase_peak",
+    ))
+    assert errors == []
