@@ -949,6 +949,60 @@ def _mesh_leg(argv: list[str]) -> None:
             "efficiency": round(mst1_s / (n_dev * mst8_s), 4),
         },
     }
+
+    # Host-boundary comparison at fixed shard size: one full sharded fit
+    # with the per-round host contraction (the pre-in-jit path — the r14
+    # baseline shape) vs one with mst_backend=device, where every Borůvka
+    # round runs inside a single while_loop dispatch and the fit crosses
+    # the host boundary exactly once (trace event ``host_sync``).
+    # host_frac = host-attributed seconds / attributed seconds over the
+    # fit's timeline phases (upload + per-round/final fetches); syncs are
+    # trace-counted. Lower is better on both.
+    from hdbscan_tpu.config import HDBSCANParams
+
+    def fit_leg(mst_backend):
+        events = []
+        leg_tl = TimelineRecorder()
+        obs.install(timeline=leg_tl)
+        try:
+            params = HDBSCANParams(
+                min_points=min_pts,
+                min_cluster_size=10,
+                fit_sharding="sharded",
+                mst_backend=mst_backend,
+            )
+            exact.fit(data, params, mesh=mesh8)  # warm: compile cost out
+            t0 = time.monotonic()
+            exact.fit(
+                data, params, mesh=mesh8,
+                trace=lambda stage, **kw: events.append((stage, kw)),
+            )
+            wall = time.monotonic() - t0
+        finally:
+            obs.clear()
+        table = leg_tl.phase_table()
+        host_s = sum(p["host_s"] for p in table.values())
+        attr = sum(
+            p["compute_s"] + p["comm_s"] + p["host_s"] for p in table.values()
+        )
+        syncs = sum(1 for stage, _ in events if stage == "host_sync")
+        return {
+            "wall_s": round(wall, 3),
+            "host_frac": round(host_s / attr, 4) if attr > 0 else 0.0,
+            "host_syncs": syncs,
+        }
+
+    leg_host = fit_leg("host")
+    leg_dev = fit_leg("device")
+    host_frac_down = leg_dev["host_frac"] < leg_host["host_frac"]
+    print(
+        f"[bench] mesh sharded fit: host-mst wall={leg_host['wall_s']}s "
+        f"host_frac={leg_host['host_frac']} | device-mst "
+        f"wall={leg_dev['wall_s']}s host_frac={leg_dev['host_frac']} "
+        f"host_syncs_per_fit={leg_dev['host_syncs']} "
+        f"host_frac_down={host_frac_down}",
+        file=sys.stderr,
+    )
     headline = min(p["efficiency"] for p in phases.values())
     platform = jax.devices()[0].platform
     print(
@@ -982,6 +1036,12 @@ def _mesh_leg(argv: list[str]) -> None:
                 "mesh_comm_frac": comm_frac,
                 "mesh_skew": skew,
                 "mesh_mfu": mfu,
+                "mesh_host_syncs_per_fit": leg_dev["host_syncs"],
+                "mesh_host_frac": leg_dev["host_frac"],
+                "mesh_host_frac_host_mst": leg_host["host_frac"],
+                "mesh_host_frac_down": host_frac_down,
+                "mesh_fit_wall_device_s": leg_dev["wall_s"],
+                "mesh_fit_wall_host_s": leg_host["wall_s"],
                 "mesh_timeline": tl_table,
                 "mesh_linear_target": 0.8,
                 "platform": platform,

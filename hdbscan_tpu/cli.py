@@ -70,10 +70,13 @@ tiled scans, ``ring`` shards rows over the mesh and circulates column
 panels via ``ppermute``, and ``auto`` selects ring only on a multi-device
 TPU mesh. ``fit_sharding`` picks the end-to-end partition tier (README "One
 sharded program", ``parallel/shard.py``): ``replicated`` keeps the existing
-engines, ``sharded`` routes the whole fit through ONE partitioned program —
-row-sharded core scans plus fully row-sharded Borůvka rounds, the path the
-``--assert-not-replicated`` gate certifies end to end — and ``auto`` picks
-sharded only on a multi-device TPU mesh. The run manifest records the
+engines, ``sharded`` routes the fit through ONE partitioned program —
+row-sharded core scans plus fully row-sharded Borůvka rounds (with
+``mst_backend=device`` the contraction cascade runs in-jit and the fit makes
+exactly one host sync), the path the ``--assert-not-replicated`` gate
+certifies end to end — and ``auto`` picks sharded only on a multi-device TPU
+mesh. The MR pipeline honors the tier too (sharded global cores, boundary
+rescan and glue harvests); it no longer forces the exact program. The run manifest records the
 partition-rule table. ``tree_backend`` picks the host finalize engine for the condensed
 tree (README "Finalize pipeline"): ``reference`` is the per-node Python
 walk, ``vectorized`` the array-level engine with bitwise-identical outputs,
@@ -455,12 +458,17 @@ def _main_fit(argv: list[str]) -> int:
         t0 = time.monotonic()
         from hdbscan_tpu.parallel.shard import resolve_fit_sharding
 
-        if resolve_fit_sharding(params.fit_sharding, mesh) == "sharded":
+        if (
+            resolve_fit_sharding(params.fit_sharding, mesh) == "sharded"
+            and n <= params.processing_units
+        ):
             # The ONE partitioned program (``parallel/shard.py``): the
             # whole exact fit runs row-sharded — the end-to-end path the
-            # ``--assert-not-replicated`` gate certifies. The mr pipeline's
-            # per-block packing would reintroduce replicated glue scans, so
-            # sharded routing always takes the exact program.
+            # ``--assert-not-replicated`` gate certifies. Above
+            # processing_units the MR pipeline keeps the sharded scanners
+            # (global cores, boundary rescan, glue harvests all route
+            # through ``parallel/shard.py``) instead of forcing the exact
+            # program — see the mr branch below.
             from hdbscan_tpu.models import exact
 
             result = exact.fit(data, params, mesh=mesh, trace=tracer)
@@ -471,11 +479,18 @@ def _main_fit(argv: list[str]) -> int:
             mode = "exact"
         else:
             # consensus_draws > 1 dispatches to consensus.fit inside.
+            # Under fit_sharding=sharded the per-level/boundary scans run
+            # the sharded engines (mr_hdbscan routes them internally).
             result = mr_hdbscan.fit(data, params, mesh=mesh, trace=tracer)
+            sharded_tag = (
+                "-sharded"
+                if resolve_fit_sharding(params.fit_sharding, mesh) == "sharded"
+                else ""
+            )
             mode = (
-                f"mr-consensus ({params.consensus_draws} draws)"
+                f"mr-consensus{sharded_tag} ({params.consensus_draws} draws)"
                 if params.consensus_draws > 1
-                else f"mr ({result.n_levels} levels)"
+                else f"mr{sharded_tag} ({result.n_levels} levels)"
             )
         wall = time.monotonic() - t0
         tracer("fit", mode=mode.split(" ")[0], rows=n, wall_s=round(wall, 6))

@@ -226,19 +226,26 @@ class HDBSCANParams:
     #: meshes and host elsewhere. Outputs are bitwise identical across
     #: backends (ring parity tests, tests/unit/test_ring.py).
     scan_backend: str = "auto"
-    #: End-to-end partition tier for the exact fit (``parallel/shard.py``):
-    #: "replicated" keeps the existing engines (some phase somewhere holds a
-    #: full point-set copy per device — the pre-shard behavior),
-    #: "sharded" runs ONE partitioned program — row-sharded core distances
-    #: (ring k-NN or the per-shard rp-forest build + ring-circulated
-    #: candidate-panel exchange) feeding fully row-sharded Borůvka rounds
-    #: (component labels circulate as a second panel; per-round edge
-    #: all-gather only at the host contraction) — per-device HBM stays
-    #: O(n/devices · d) in every phase, the program the
-    #: ``--assert-not-replicated`` gate certifies. "auto" (default) picks
-    #: sharded on multi-device TPU meshes and replicated elsewhere. With
-    #: ``knn_index="exact"`` the sharded fit is bitwise identical to the
-    #: replicated one (forced-8-device parity tests).
+    #: End-to-end partition tier (``parallel/shard.py``): "replicated" keeps
+    #: the existing engines (some phase somewhere holds a full point-set copy
+    #: per device — the pre-shard behavior), "sharded" runs ONE partitioned
+    #: program — row-sharded core distances (ring k-NN or the per-shard
+    #: rp-forest build + ring-circulated candidate-panel exchange) feeding
+    #: fully row-sharded Borůvka rounds. With ``mst_backend="host"`` the
+    #: rounds contract on host (per-round edge all-gather); with
+    #: ``mst_backend="device"``/"auto" the whole contraction cascade runs
+    #: in-jit (scatter-min tie-break, cross-device panel reduction,
+    #: pointer-doubling collapse inside one ``while_loop``) and the fit makes
+    #: exactly ONE host sync — the final edge fetch feeding the device merge
+    #: forest. Per-device HBM stays O(n/devices · d) in every phase — the
+    #: program the ``--assert-not-replicated`` gate certifies. The MR
+    #: pipeline honors the tier too: global cores (weighted dedup scan
+    #: included), the boundary rescan, and every Borůvka glue harvest route
+    #: through the sharded scanners (block pruning is disabled under sharded
+    #: — its windowed scans keep replicated geometry panels). "auto"
+    #: (default) picks sharded on multi-device TPU meshes and replicated
+    #: elsewhere. With ``knn_index="exact"`` the sharded fit is bitwise
+    #: identical to the replicated one (forced-8-device parity tests).
     fit_sharding: str = "auto"
     #: Host finalize engine for the condensed-tree tail (``core/tree.py`` vs
     #: ``core/tree_vec.py``): "reference" keeps the per-node Python

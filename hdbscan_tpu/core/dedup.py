@@ -85,24 +85,52 @@ def global_weighted_core_distances(
     row_tile: int = 1024,
     col_tile: int = 8192,
     dtype=np.float32,
+    *,
+    mesh=None,
+    trace=None,
+    fit_sharding: str = "replicated",
 ) -> np.ndarray:
     """One tiled scan + multiset cumsum: the weighted global core distances.
 
     Shared by the exact and MR dedup paths so the k-selection rule and the
-    coverage invariant live in one place.
+    coverage invariant live in one place. Under ``fit_sharding="sharded"``
+    the (m, k) neighbor scan rides the row-sharded ring engine (queries,
+    panels and per-point lists all shard with their rows; bitwise the host
+    scan), so the dedup tier honors the residency contract too — only the
+    (m, k) host fetch feeding the multiset cumsum leaves the devices.
     """
-    from hdbscan_tpu.ops.tiled import knn_core_distances
+    from hdbscan_tpu.parallel.shard import resolve_fit_sharding
 
-    _, knn_d, knn_i = knn_core_distances(
-        data,
-        min_pts,
-        metric,
-        k=max(min_pts, 2),
-        row_tile=row_tile,
-        col_tile=col_tile,
-        dtype=dtype,
-        return_indices=True,
-    )
+    k = max(min_pts, 2)
+    if resolve_fit_sharding(fit_sharding, mesh) == "sharded":
+        from hdbscan_tpu.parallel.ring import ring_knn_core_distances
+
+        _, knn_d, knn_i = ring_knn_core_distances(
+            data,
+            min_pts,
+            metric,
+            k=k,
+            row_tile=row_tile,
+            col_tile=col_tile,
+            dtype=dtype,
+            return_indices=True,
+            mesh=mesh,
+            trace=trace,
+        )
+    else:
+        from hdbscan_tpu.ops.tiled import knn_core_distances
+
+        _, knn_d, knn_i = knn_core_distances(
+            data,
+            min_pts,
+            metric,
+            k=k,
+            row_tile=row_tile,
+            col_tile=col_tile,
+            dtype=dtype,
+            return_indices=True,
+            trace=trace,
+        )
     return weighted_core_distances(knn_d, knn_i, counts, min_pts)
 
 

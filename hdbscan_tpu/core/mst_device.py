@@ -494,6 +494,43 @@ def build_merge_forest_device(
 # ---------------------------------------------------------------------------
 
 
+def _collapse_labels(comp, valid, has_edge, tgt_comp, n: int):
+    """Shared pointer-doubling collapse over per-LABEL winners.
+
+    ``comp``/``valid``: (n_pad,) labels + realness mask (labels are
+    representative vertex ids < n); ``has_edge``/``tgt_comp``: (n,) per-label
+    winner existence + the winning edge's TARGET component label. Both the
+    replicated contraction (:func:`_contract_round`) and the sharded in-jit
+    rounds (``parallel/shard``) funnel through this exact code, so the
+    cycle-resolution and emission-order semantics cannot drift between them.
+
+    Returns (emit_mask(n,), rep(n,), n_comp, edges_added) with ``emit_mask``
+    in ascending-label order (the host's emission order).
+    """
+    labels = jnp.arange(n, dtype=jnp.int32)
+    t = jnp.where(has_edge, tgt_comp, labels)
+
+    # Pointer doubling with orbit-min accumulation: every label lands on
+    # its group's cycle and the cycle minimum becomes the group root.
+    mn = labels
+
+    def dbl(_, c):
+        mn, s = c
+        return jnp.minimum(mn, mn[s]), s[s]
+
+    mn, s = lax.fori_loop(0, _doubling_rounds(n), dbl, (mn, t))
+    rep = mn[s]
+    is_root = rep == labels
+    active = (
+        jnp.zeros((n,), bool)
+        .at[jnp.where(valid, comp, n)]
+        .set(True, mode="drop")
+    )
+    emit_mask = active & ~is_root & has_edge
+    n_comp = jnp.sum(active & is_root)
+    return emit_mask, rep, n_comp, jnp.sum(emit_mask)
+
+
 def _contract_round(comp, bw, bj, valid, n: int):
     """One Borůvka contraction in label space — the in-jit twin of
     ``utils/unionfind.contract_min_edges``.
@@ -542,28 +579,11 @@ def _contract_round(comp, bw, bj, valid, n: int):
     has_edge = row_min < sentinel
     win_row = jnp.where(has_edge, row_min, 0)
 
-    labels = jnp.arange(n, dtype=jnp.int32)
-    t = jnp.where(has_edge, comp[jnp.clip(bj[win_row], 0, n_pad - 1)], labels)
-
-    # Pointer doubling with orbit-min accumulation: every label lands on
-    # its group's cycle and the cycle minimum becomes the group root.
-    mn = labels
-
-    def dbl(_, c):
-        mn, s = c
-        return jnp.minimum(mn, mn[s]), s[s]
-
-    mn, s = lax.fori_loop(0, _doubling_rounds(n), dbl, (mn, t))
-    rep = mn[s]
-    is_root = rep == labels
-    active = (
-        jnp.zeros((n,), bool)
-        .at[jnp.where(valid, comp, n)]
-        .set(True, mode="drop")
+    tgt_comp = comp[jnp.clip(bj[win_row], 0, n_pad - 1)]
+    emit_mask, rep, n_comp, added = _collapse_labels(
+        comp, valid, has_edge, tgt_comp, n
     )
-    emit_mask = active & ~is_root & has_edge
-    n_comp = jnp.sum(active & is_root)
-    return emit_mask, win_row, rep, n_comp, jnp.sum(emit_mask)
+    return emit_mask, win_row, rep, n_comp, added
 
 
 @partial(

@@ -433,24 +433,32 @@ def fit(
     # Device-resident MST -> forest pipeline (``core/mst_device.py``): every
     # Borůvka round and the union-find forest scan run in-jit, ONE host sync
     # downstream of the core-distance scan. The ring scanner shards its own
-    # per-round host reduction, so the single-program device path only runs
-    # when the scan backend is the replicated one — and never under the
-    # sharded program (its edge pool lives replicated on one device).
-    if (
-        resolve_mst_backend(params, n) == "device"
-        and resolve_scan_backend(getattr(params, "scan_backend", "auto"), mesh)
+    # per-round host reduction, so the replicated device path skips that
+    # mode — but the SHARDED program now carries its own in-jit rounds
+    # (``parallel/shard.shard_boruvka_mst``), so ``fit_sharding=sharded``
+    # routes here whenever the MST backend resolves "device": row-sharded
+    # cores, the while_loop contraction, and the sharded forest scan, ONE
+    # ``host_sync`` per fit.
+    sharded = (
+        resolve_fit_sharding(getattr(params, "fit_sharding", "auto"), mesh)
+        == "sharded"
+    )
+    if resolve_mst_backend(params, n) == "device" and (
+        sharded
+        or resolve_scan_backend(getattr(params, "scan_backend", "auto"), mesh)
         != "ring"
-        and resolve_fit_sharding(
-            getattr(params, "fit_sharding", "auto"), mesh
-        )
-        != "sharded"
     ):
+        if sharded:
+            from hdbscan_tpu.parallel.mesh import get_mesh
+
+            mesh = mesh if mesh is not None else get_mesh()
         result = _fit_device(
             data,
             params,
             row_tile=row_tile,
             col_tile=col_tile,
             dtype=dtype,
+            mesh=mesh if sharded else None,
             num_constraints_satisfied=num_constraints_satisfied,
             trace=trace,
         )
@@ -488,6 +496,57 @@ def fit(
     )
 
 
+#: (mesh, n) -> jitted row-sharded forest-events program (out_shardings
+#: pinned so the union event stream never lands replicated).
+_FOREST_EVENTS_SHARDED_CACHE: dict = {}
+
+
+def _forest_events_sharded(mesh, n: int):
+    key = (mesh, n)
+    fn = _FOREST_EVENTS_SHARDED_CACHE.get(key)
+    if fn is None:
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from hdbscan_tpu.core.mst_device import forest_events_device
+        from hdbscan_tpu.parallel.mesh import BATCH_AXIS
+
+        # The union-find scan is inherently sequential over the GLOBAL edge
+        # order, so every device gathers the sharded edge buffers (in-jit
+        # transient, invisible to the replication audit) and runs the scan
+        # identically; each keeps only its slice of the event stream, so
+        # the Python-held outputs stay O(n/D) per device. Manual SPMD on
+        # purpose: asking GSPMD to partition the scan's stacked outputs
+        # miscompiles under x64 (s64 induction vs s32 partition offsets).
+        def per_device(u, v, w):
+            uf = jax.lax.all_gather(u, BATCH_AXIS, tiled=True)
+            vf = jax.lax.all_gather(v, BATCH_AXIS, tiled=True)
+            wf = jax.lax.all_gather(w, BATCH_AXIS, tiled=True)
+            events = forest_events_device(uf, vf, wf, n)
+            shard = u.shape[0]
+            off = jax.lax.axis_index(BATCH_AXIS) * shard
+            return {
+                k: jax.lax.dynamic_slice_in_dim(a, off, shard)
+                for k, a in events.items()
+            }
+
+        fn = jax.jit(
+            shard_map(
+                per_device,
+                mesh=mesh,
+                in_specs=(P(BATCH_AXIS), P(BATCH_AXIS), P(BATCH_AXIS)),
+                out_specs=P(BATCH_AXIS),
+                # The scan has no replication rule; the gathered inputs are
+                # replicated by construction and the outputs are sliced
+                # per device anyway.
+                check_rep=False,
+            )
+        )
+        _FOREST_EVENTS_SHARDED_CACHE[key] = fn
+    return fn
+
+
 def _fit_device(
     data: np.ndarray,
     params: HDBSCANParams,
@@ -495,6 +554,7 @@ def _fit_device(
     row_tile: int,
     col_tile: int,
     dtype,
+    mesh=None,
     num_constraints_satisfied,
     trace,
 ) -> HDBSCANResult | None:
@@ -509,6 +569,17 @@ def _fit_device(
     The merge forest then reconstructs with vectorized host numpy
     (``mst_device.assemble_merge_forest``) and feeds the shared finalize
     tail unchanged.
+
+    ``mesh`` non-None selects the SHARDED tier of the same contract: cores
+    from the row-sharded scanners (``parallel/shard.shard_core_distances``),
+    the in-jit sharded Borůvka rounds
+    (``parallel/shard.shard_boruvka_mst`` — ppermute panel reduction +
+    replicated pointer-doubling collapse inside a ``while_loop``), and the
+    forest scan pinned row-sharded via ``out_shardings`` so no Python-held
+    O(n) buffer replicates. Still exactly one ``host_sync``; the
+    retrospective ``mst_round`` events carry ``sharded: true`` and the
+    timeline receives modeled per-round rows from the round-count counter
+    (``ring._emit_modeled_rounds``) instead of per-round host walls.
 
     A pool that fails the post-fetch tie-eligibility gate falls back only
     for the forest build (the fetched MST edges are reused; no second
@@ -538,12 +609,22 @@ def _fit_device(
     t0 = time.monotonic()
     fsnap = _flops.snapshot()
     with obs.mem_phase("core_distances"):
-        core, _ = knn_core_distances(
-            data, params.min_points, params.dist_function, row_tile=row_tile,
-            col_tile=col_tile, dtype=dtype, fetch_knn=False,
-            backend=params.knn_backend, index=index, index_opts=index_opts,
-            trace=trace,
-        )
+        if mesh is not None:
+            from hdbscan_tpu.parallel.shard import shard_core_distances
+
+            core = shard_core_distances(
+                data, params.min_points, params.dist_function,
+                row_tile=row_tile, col_tile=col_tile, dtype=dtype, mesh=mesh,
+                trace=trace, knn_backend=params.knn_backend, index=index,
+                index_opts=index_opts,
+            )
+        else:
+            core, _ = knn_core_distances(
+                data, params.min_points, params.dist_function,
+                row_tile=row_tile, col_tile=col_tile, dtype=dtype,
+                fetch_knn=False, backend=params.knn_backend, index=index,
+                index_opts=index_opts, trace=trace,
+            )
     if trace is not None:
         wall = time.monotonic() - t0
         trace(
@@ -551,17 +632,39 @@ def _fit_device(
         )
 
     t0 = time.monotonic()
+    holds = ()
     with obs.mem_phase("boruvka_mst_device"), obs.task(
         "boruvka_device", total=1
     ):
-        res = boruvka_mst_device(
-            data, core, params.dist_function, row_tile=row_tile,
-            col_tile=col_tile, dtype=dtype,
-        )
-        # Padded (+inf, self-loop) tail rows pass straight through the forest
-        # scan as non-merges, so the event program consumes the fixed buffers
-        # without a host-side slice in between.
-        events = forest_events_device(res["u"], res["v"], res["w"], n)
+        if mesh is not None:
+            from hdbscan_tpu.parallel.mesh import device_count
+            from hdbscan_tpu.parallel.shard import shard_boruvka_mst
+
+            res, holds = shard_boruvka_mst(
+                data, core, params.dist_function, row_tile=row_tile,
+                col_tile=col_tile, dtype=dtype, mesh=mesh,
+            )
+            # The forest scan consumes the row-sharded edge buffers and its
+            # outputs stay row-sharded (out_shardings) — the Python-visible
+            # footprint of the whole MST+forest stage is O(n/D) per device.
+            events = _forest_events_sharded(mesh, n)(
+                res["u"], res["v"], res["w"]
+            )
+        else:
+            res = boruvka_mst_device(
+                data, core, params.dist_function, row_tile=row_tile,
+                col_tile=col_tile, dtype=dtype,
+            )
+            # Padded (+inf, self-loop) tail rows pass straight through the
+            # forest scan as non-merges, so the event program consumes the
+            # fixed buffers without a host-side slice in between.
+            events = forest_events_device(res["u"], res["v"], res["w"], n)
+        walls = None
+        if mesh is not None:
+            from hdbscan_tpu.parallel.ring import _per_device_walls
+
+            walls = _per_device_walls(events["sw"], t0)
+            mst_wall = time.monotonic() - t0
         t1 = time.monotonic()
         fetched = jax.device_get(
             {
@@ -578,8 +681,39 @@ def _fit_device(
             }
         )
         sync_wall = time.monotonic() - t1
+    # Free the device side of the fetch eagerly — everything downstream is
+    # host numpy, and deferred deletion would charge the finalize phases'
+    # replication budget with the (n_pad,) buffers.
+    for arr in holds:
+        arr.delete()
+    if mesh is not None:
+        for arr in (*res.values(), *events.values()):
+            arr.delete()
     rounds = int(fetched["rounds"])
     count = int(fetched["count"])
+    if mesh is not None:
+        # The while_loop ran every round in ONE dispatch: credit the scan
+        # FLOPs from the fetched round counter, and replay the program wall
+        # as modeled per-round timeline rows (no per-round host walls exist).
+        from hdbscan_tpu.parallel.ring import (
+            _emit_modeled_rounds,
+            _ring_geometry,
+        )
+
+        n_dev = device_count(mesh)
+        rt, ct, shard, n_pad = _ring_geometry(n, n_dev, row_tile, col_tile)
+        d = data.shape[1]
+        _flops.add_scan(n_pad * max(rounds, 1), n_pad, d, row_tile=rt)
+        itemsize = np.dtype(dtype).itemsize
+        panel_bytes = shard * (d + 1) * itemsize + shard * 4
+        _emit_modeled_rounds(
+            trace, "shard_mst_device", mst_wall, walls, n_dev,
+            max(rounds, 1),
+            fetch_s=sync_wall,
+            comm_bytes=max(rounds, 1) * (n_dev - 1) * panel_bytes,
+            flops=2.0 * max(rounds, 1) * float(n_pad) * n_pad * d,
+            n=n, shard=shard,
+        )
     if trace is not None:
         # Dispatch is async: the sync wall carries the device compute, the
         # retrospective round events replay the per-round stats it landed.
@@ -589,6 +723,7 @@ def _fit_device(
                 round=r,
                 components=int(fetched["stat_comp"][r]),
                 edges_added=int(fetched["stat_edges"][r]),
+                **({"sharded": True} if mesh is not None else {}),
             )
         trace(
             "host_sync",
@@ -675,6 +810,9 @@ def _fit_dedup(
         row_tile=row_tile,
         col_tile=col_tile,
         dtype=dtype,
+        mesh=mesh,
+        trace=trace,
+        fit_sharding=getattr(params, "fit_sharding", "auto"),
     )
     if trace is not None:
         trace("core_distances", n=len(uniq))

@@ -610,9 +610,12 @@ def _fit_rows(
         if weights is not None:
             from hdbscan_tpu.core.dedup import global_weighted_core_distances
 
-            core = global_weighted_core_distances(
-                data, weights, params.min_points, metric
-            )
+            with obs.mem_phase("global_cores"):
+                core = global_weighted_core_distances(
+                    data, weights, params.min_points, metric,
+                    mesh=mesh, trace=trace,
+                    fit_sharding=params.fit_sharding,
+                )
         else:
             from hdbscan_tpu.core.knn import resolve_index_for
             from hdbscan_tpu.parallel.ring import resolve_scan_backend
@@ -703,6 +706,7 @@ def _fit_rows(
                     core=core[act] if global_core else None,
                     mesh=mesh,
                     scan_backend=params.scan_backend,
+                    fit_sharding=params.fit_sharding,
                     trace=trace,
                 )
             pool_u.append(act[gu_l])
@@ -990,7 +994,18 @@ def _fit_rows(
         from hdbscan_tpu.utils.flops import counter as flops_counter
         from hdbscan_tpu.utils.flops import phase_stats
 
-        pruned = params.boundary_block_pruning and metric in PRUNABLE_METRICS
+        from hdbscan_tpu.parallel.shard import resolve_fit_sharding
+
+        # Block pruning's windowed scans keep a replicated BlockGeometry
+        # device copy per round — incompatible with the sharded residency
+        # contract, so sharded fits take the full-sweep glue/refine path
+        # (whose scans ARE sharded, via ShardBoruvkaScanner).
+        sharded = resolve_fit_sharding(params.fit_sharding, mesh) == "sharded"
+        pruned = (
+            params.boundary_block_pruning
+            and metric in PRUNABLE_METRICS
+            and not sharded
+        )
 
         # 1) The boundary set: per final block, the lowest-margin fraction
         #    (final_block, NOT subset: subset ids are per-level and collide
@@ -1104,9 +1119,8 @@ def _fit_rows(
             from hdbscan_tpu.parallel.ring import resolve_scan_backend
 
             index, index_opts = resolve_index_for(params, n)
-            from hdbscan_tpu.parallel.shard import resolve_fit_sharding
 
-            if resolve_fit_sharding(params.fit_sharding, mesh) == "sharded":
+            if sharded:
                 from hdbscan_tpu.parallel.shard import (
                     shard_core_distances_rows,
                 )
@@ -1180,7 +1194,8 @@ def _fit_rows(
             else:
                 gu, gv, gw = boruvka_glue_edges(
                     data[bset_g], final_block[bset_g], metric, core=core[bset_g],
-                    mesh=mesh, scan_backend=params.scan_backend, trace=trace,
+                    mesh=mesh, scan_backend=params.scan_backend,
+                    fit_sharding=params.fit_sharding, trace=trace,
                 )
             # Exact-f64 weights for the appended glue edges (same tie-
             # determinism rationale as the final-pool reweight): the
@@ -1290,7 +1305,7 @@ def _fit_rows(
                     ru, rv, rw = boruvka_glue_edges(
                         data[bset_g], groups_r[bset_g], metric, core=core[bset_g],
                         mesh=mesh, scan_backend=params.scan_backend,
-                        trace=trace,
+                        fit_sharding=params.fit_sharding, trace=trace,
                     )
                 ru, rv = bset_g[ru], bset_g[rv]
             else:
@@ -1298,7 +1313,8 @@ def _fit_rows(
                     break
                 ru, rv, rw = boruvka_glue_edges(
                     data, groups_r, metric, core=core if global_core else None,
-                    mesh=mesh, scan_backend=params.scan_backend, trace=trace,
+                    mesh=mesh, scan_backend=params.scan_backend,
+                    fit_sharding=params.fit_sharding, trace=trace,
                 )
             if len(ru) == 0:
                 break
@@ -1349,7 +1365,8 @@ def _fit_rows(
                 break
             ru, rv, rw = boruvka_glue_edges(
                 data, g, metric, core=core, mesh=mesh,
-                scan_backend=params.scan_backend, trace=trace,
+                scan_backend=params.scan_backend,
+                fit_sharding=params.fit_sharding, trace=trace,
             )
             if len(ru) == 0:
                 break

@@ -242,6 +242,41 @@ class TimelineRecorder:
             "flagged": [dev for dev, _, _ in flagged],
         }
 
+    def record_modeled_rounds(self, phase: str, rounds: int, walls, *,
+                              upload_s=0.0, fetch_s=0.0, comm_bytes=0,
+                              flops=0.0, trace=None) -> dict | None:
+        """Record an in-jit multi-round program as modeled per-round rows.
+
+        A ``while_loop`` over Borůvka rounds executes all rounds inside ONE
+        dispatch (``parallel/shard.shard_boruvka_mst``), so per-round host
+        walls do not exist — only the program's per-device walls and the
+        round-count counter the fetch landed. This splits each device's
+        measured wall evenly across ``rounds`` and replays them through
+        :meth:`record_round` so the ``device_timeline`` rows, phase totals
+        and straggler detector see the same shape as host-stepped rounds.
+        The host segments stay where they physically happened — ``upload_s``
+        on round 0, ``fetch_s`` on the last — and ``comm_bytes``/``flops``
+        split evenly (round 0 takes the integer remainder). The split is a
+        model, same as the comm/compute attribution (``attribution:
+        "model"`` already rides every row). Returns the LAST round's skew
+        stats, or None for an empty program.
+        """
+        rounds = max(int(rounds), 1)
+        walls = [(int(d), float(w) / rounds) for d, w in walls]
+        comm_bytes = max(int(comm_bytes), 0)
+        per_comm, rem_comm = divmod(comm_bytes, rounds)
+        stats = None
+        for r in range(rounds):
+            stats = self.record_round(
+                phase, r, walls,
+                upload_s=upload_s if r == 0 else 0.0,
+                fetch_s=fetch_s if r == rounds - 1 else 0.0,
+                comm_bytes=per_comm + (rem_comm if r == 0 else 0),
+                flops=max(float(flops), 0.0) / rounds,
+                trace=trace,
+            )
+        return stats
+
     # -- reporting ---------------------------------------------------------
 
     def phase_table(self) -> dict[str, dict]:

@@ -120,6 +120,77 @@ def min_outgoing_pallas(
     return bw[0], bj[0]
 
 
+@partial(
+    jax.jit,
+    static_argnames=("metric", "row_tile", "col_tile", "interpret"),
+)
+def min_outgoing_panel(
+    rows, core_r, comp_r, valid_r, panel, core_c, comp_c, valid_c,
+    metric: str = "euclidean", row_tile: int = 1024, col_tile: int = 8192,
+    interpret: bool = False,
+):
+    """Sharded-shape launch: (r_pad, d) resident rows vs a (c_pad, d)
+    VISITING panel — the per-device step of the in-jit sharded Borůvka
+    rounds (``parallel/shard._shard_mst_fn``), where rows and columns are
+    different shards and carry separate core/label/validity vectors.
+
+    Same kernel as :func:`min_outgoing_pallas` (its operand refs are
+    already split row/column; the square launch just passes each array
+    twice). Returns ((r_pad,) best_w, (r_pad,) best_j) with ``best_j``
+    PANEL-LOCAL (the global column offset is traced per ring step, so the
+    caller adds it outside the kernel); -1 / +inf where no outgoing edge
+    exists in this panel.
+    """
+    r_pad, d = rows.shape
+    c_pad = panel.shape[0]
+    d_pad = max(LANES, -(-d // LANES) * LANES)
+    if d_pad != d:
+        rows = jnp.pad(rows, ((0, 0), (0, d_pad - d)))
+        panel = jnp.pad(panel, ((0, 0), (0, d_pad - d)))
+    grid = (r_pad // row_tile, c_pad // col_tile)
+    bw, bj = pl.pallas_call(
+        partial(_segmin_kernel, metric=metric, col_tile=col_tile),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((row_tile, d_pad), lambda i, j: (i, 0)),
+            pl.BlockSpec((col_tile, d_pad), lambda i, j: (j, 0)),
+            pl.BlockSpec((1, row_tile), lambda i, j: (0, i)),
+            pl.BlockSpec((1, col_tile), lambda i, j: (0, j)),
+            pl.BlockSpec((1, row_tile), lambda i, j: (0, i)),
+            pl.BlockSpec((1, col_tile), lambda i, j: (0, j)),
+            pl.BlockSpec((1, row_tile), lambda i, j: (0, i)),
+            pl.BlockSpec((1, col_tile), lambda i, j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, row_tile), lambda i, j: (0, i)),
+            pl.BlockSpec((1, row_tile), lambda i, j: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, r_pad), rows.dtype),
+            jax.ShapeDtypeStruct((1, r_pad), jnp.int32),
+        ],
+        interpret=interpret,
+    )(
+        rows, panel,
+        core_r.reshape(1, r_pad), core_c.reshape(1, c_pad),
+        comp_r.astype(jnp.int32).reshape(1, r_pad),
+        comp_c.astype(jnp.int32).reshape(1, c_pad),
+        valid_r.astype(jnp.int32).reshape(1, r_pad),
+        valid_c.astype(jnp.int32).reshape(1, c_pad),
+    )
+    return bw[0], bj[0]
+
+
+def panel_eligible(platform: str, dtype) -> bool:
+    """Static (build-time) eligibility of the sharded-shape Pallas launch.
+
+    Decided from the MESH platform (the sharded program builder knows its
+    devices before tracing; ``jax.devices()[0]`` may differ from the fit
+    mesh) — TPU + f32 operands, same policy as :func:`_pallas_eligible`.
+    """
+    return platform == "tpu" and np.dtype(dtype) == np.float32
+
+
 def _pallas_eligible(data) -> bool:
     """Static (trace-time) eligibility of the Pallas path."""
     try:

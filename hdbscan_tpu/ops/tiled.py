@@ -631,6 +631,7 @@ def boruvka_glue_edges(
     max_rounds: int = 64,
     mesh=None,
     scan_backend: str = "host",
+    fit_sharding: str = "replicated",
     trace=None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Exact inter-group MST "glue" edges — Borůvka rounds to connectivity.
@@ -654,12 +655,17 @@ def boruvka_glue_edges(
     replicated column set when ``mesh`` is given), "ring" (the ring-systolic
     sharded scanner, ``parallel/ring.py`` — panels circulate via ppermute,
     per-component winners reduce on-device), or "auto" (ring on multi-device
-    TPU meshes). Edges are bitwise identical across backends.
+    TPU meshes). ``fit_sharding`` resolving "sharded" overrides both with
+    the fully row-sharded scanner (``parallel/shard.ShardBoruvkaScanner``)
+    so the MR glue harvest keeps the one-sharded-program residency contract
+    — no replicated column set, no replicated winner buffers. Edges are
+    bitwise identical across backends.
 
     Returns (u, v, w) in LOCAL indices of ``data``, deterministically
     tie-broken by (w, u, v).
     """
     from hdbscan_tpu.parallel.ring import resolve_scan_backend
+    from hdbscan_tpu.parallel.shard import resolve_fit_sharding
     from hdbscan_tpu.utils.unionfind import contract_min_edges as _contract
 
     n = len(data)
@@ -669,7 +675,14 @@ def boruvka_glue_edges(
     n_comp = int(dense.max()) + 1
     if n_comp == 1:
         return np.zeros(0, np.int64), np.zeros(0, np.int64), np.zeros(0, np.float64)
-    if resolve_scan_backend(scan_backend, mesh) == "ring":
+    if resolve_fit_sharding(fit_sharding, mesh) == "sharded":
+        from hdbscan_tpu.parallel.shard import ShardBoruvkaScanner
+
+        scanner = ShardBoruvkaScanner(
+            data, core, metric, row_tile=row_tile, col_tile=col_tile,
+            dtype=dtype, mesh=mesh, trace=trace,
+        )
+    elif resolve_scan_backend(scan_backend, mesh) == "ring":
         from hdbscan_tpu.parallel.ring import RingBoruvkaScanner
 
         scanner = RingBoruvkaScanner(
@@ -700,6 +713,13 @@ def boruvka_glue_edges(
         eu.append(emit)
         ev.append(bj[emit])
         ew.append(bw[emit])
+    # The sharded scanner holds row-sharded device panels that must be
+    # freed NOW (deferred deletion reads as replication to the memory
+    # gate when glue harvests run back to back); the host scanners have
+    # no such buffers to drop.
+    close = getattr(scanner, "close", None)
+    if close is not None:
+        close()
     return (
         np.concatenate(eu) if eu else np.zeros(0, np.int64),
         np.concatenate(ev) if ev else np.zeros(0, np.int64),

@@ -202,6 +202,59 @@ def _emit_ring_trace(
         )
 
 
+def _emit_modeled_rounds(
+    trace, stage: str, wall: float, walls, n_dev: int, rounds: int, *,
+    upload_s: float = 0.0, fetch_s: float = 0.0, comm_bytes: int = 0,
+    flops: float = 0.0, **fields
+) -> None:
+    """Trace/timeline emission for an IN-JIT multi-round program.
+
+    The ``while_loop`` Borůvka rounds (``parallel/shard.shard_boruvka_mst``)
+    run every round inside one dispatch, so there is one measured wall for
+    the whole program and a round-count counter from the single fetch —
+    no per-round host walls to feed :func:`_emit_ring_trace` round by
+    round. The installed recorder replays the program as ``rounds`` modeled
+    per-round rows (:meth:`TimelineRecorder.record_modeled_rounds`: walls
+    and traffic split evenly, host segments pinned to the first/last
+    round), and ONE summary event lands with the total wall plus a
+    ``rounds`` field — its ``ppermute_steps`` stays the per-round
+    ``devices - 1`` the validator contract pins."""
+    tl = obs.timeline()
+    stats = None
+    if tl is not None:
+        stats = tl.record_modeled_rounds(
+            stage, rounds, walls, upload_s=upload_s, fetch_s=fetch_s,
+            comm_bytes=comm_bytes, flops=flops, trace=trace,
+        )
+    if trace is None:
+        return
+    if stats is not None:
+        fields = dict(
+            fields,
+            skew=stats["skew"],
+            max_device_wall_s=stats["max_wall_s"],
+            median_device_wall_s=stats["median_wall_s"],
+        )
+    if comm_bytes:
+        fields.setdefault("comm_bytes", int(comm_bytes))
+    trace(
+        stage,
+        wall_s=round(wall, 6),
+        devices=n_dev,
+        ppermute_steps=n_dev - 1,
+        rounds=int(rounds),
+        **fields,
+    )
+    for dev_id, w in walls:
+        trace(
+            "ring_device_wall",
+            wall_s=round(w, 6),
+            device=dev_id,
+            ring_stage=stage,
+            round=0,
+        )
+
+
 # --------------------------------------------------------------------------
 # Ring k-NN scan
 # --------------------------------------------------------------------------

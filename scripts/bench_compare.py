@@ -60,6 +60,12 @@ HEADLINE = {
     "mesh_comm_frac": "lower",
     "mesh_skew": "lower",
     "mesh_mfu": "higher",
+    # Host-boundary companions (in-jit sharded Borůvka, README "One sharded
+    # program"): trace-counted host_sync events per sharded device fit (the
+    # contract is exactly 1) and the timeline's host-attributed fraction of
+    # the fit — both lower-better, same cpu_smoke caveats as above.
+    "mesh_host_syncs_per_fit": "lower",
+    "mesh_host_frac": "lower",
 }
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
@@ -130,7 +136,8 @@ def load_round(path: str) -> dict:
                 metrics["stream_maintain_ari_vs_scratch"] = float(ari)
         if name == "mesh_scan_scaling_efficiency_8dev":
             for comp in ("mesh_peak_device_bytes_max", "mesh_comm_frac",
-                         "mesh_skew", "mesh_mfu"):
+                         "mesh_skew", "mesh_mfu",
+                         "mesh_host_syncs_per_fit", "mesh_host_frac"):
                 v = rec.get(comp)
                 if isinstance(v, (int, float)):
                     metrics[comp] = float(v)

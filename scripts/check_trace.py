@@ -26,12 +26,19 @@ events (``serve/predict.py``, README "Serving") add three: every
 sequence restarts, but each predictor's dispatch order is total.
 Device MST events (``core/mst_device.py``, README "Device-resident
 finalize") add three schemas: ``mst_round`` must carry an integer
-``round >= 0``, ``components >= 1`` and ``edges_added >= 0``; ``host_sync``
-positive ``arrays`` and non-negative ``bytes``; ``tree_build_device``
-(a finalize stage, so it also carries the ``backend`` tag) a boolean
-``fallback`` and ``nodes == -1`` exactly when it fell back — plus one
-GLOBAL invariant: the single-sync contract, per process exactly ONE
-``host_sync`` per ``tree_build_device`` forest build.
+``round >= 0``, ``components >= 1`` and ``edges_added >= 0`` — and rounds
+tagged ``sharded: true`` (the in-jit sharded Borůvka ``while_loop``,
+``parallel/shard.shard_boruvka_mst``) must additionally be CONTIGUOUS per
+process (each round exactly prev + 1, resetting to 0 on a fresh fit) with
+STRICTLY DECREASING ``components``; ``host_sync`` positive ``arrays`` and
+non-negative ``bytes``; ``tree_build_device`` (a finalize stage, so it
+also carries the ``backend`` tag) a boolean ``fallback`` and
+``nodes == -1`` exactly when it fell back — plus two GLOBAL invariants:
+the single-sync contract, per process exactly ONE ``host_sync`` per
+``tree_build_device`` forest build, and the sharded single-sync contract,
+per process at least one ``host_sync`` per ``shard_mst_device`` summary
+(a sharded ``mst_backend=device`` fit syncs exactly once — the final edge
+fetch).
 Approximate-neighbor events (``ops/rpforest.py``, README "Approximate
 neighbors") add three schemas: ``knn_index_build`` must carry positive
 integer ``trees``/``depth``/``leaf_size``/``n`` with ``max_leaf <=
@@ -133,7 +140,7 @@ positive ``streak``, ``threshold >= 1``, ``ratio >= threshold`` and
 ``flight_dump`` a ``reason`` from the known dump-reason set, a non-empty
 bundle ``path`` and a non-negative ``events`` count.
 Sharded-fit events (``parallel/shard.py``, README "One sharded program")
-add five schemas: ``shard_knn_build`` must carry positive integer
+add six schemas: ``shard_knn_build`` must carry positive integer
 ``devices``/``trees``/``depth``/``leaf_size``/``n``/``d`` with
 ``max_leaf <= leaf_size``; ``shard_panel_sweep`` positive
 ``devices``/``rows``/``trees``/``shard`` (its ``ppermute_steps ==
@@ -145,6 +152,11 @@ devices - 1`` rides the generic ring invariant above);
 (each scan is exactly prev + 1, resetting to 0 when a new scanner
 starts) and an ``n_comp`` that STRICTLY DECREASES across a scanner's
 rounds — Borůvka contracts components every round or the fit is looping;
+``shard_mst_device`` (the in-jit sharded Borůvka program summary,
+one per sharded ``mst_backend=device`` fit) positive
+``devices``/``rounds``/``n``/``shard`` — its per-round
+``ppermute_steps == devices - 1`` rides the generic ring invariant, and
+its one-host_sync contract is the global device-MST check above;
 ``replication_gate`` must carry ``ok == true`` (the event only exists on
 a passing gate), a positive ``threshold_bytes``/``phases`` and a
 ``worst_fraction`` in [0, 1).
@@ -222,6 +234,8 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
     last_dev_seq: dict = {}  # per-(process, device) seq for ring wall events
     last_batch_seq: dict = {}  # per-(process, predictor) predict_batch seq
     sync_counts: dict = {}  # per-process [host_syncs, device forest builds]
+    last_sharded_mst: dict = {}  # per-process (round, components), sharded
+    sharded_mst_fits: dict = {}  # per-process shard_mst_device fit count
     last_swap_gen: dict = {}  # per-(process, server) model_swap generation
     seen_request_ids: dict = {}  # per-process ids across span + shed events
     last_wal_seq: dict = {}  # per-(process, wal) wal_append seq
@@ -364,6 +378,38 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
                     if stage != "mst_round":
                         counts = sync_counts.setdefault(proc, [0, 0])
                         counts[0 if stage == "host_sync" else 1] += 1
+                    elif ev.get("sharded") is True:
+                        # In-jit sharded rounds (parallel/shard.py
+                        # shard_boruvka_mst): contiguous per process and
+                        # components strictly decreasing — replayed from
+                        # the single fetched round counter, so a stall
+                        # here means the while_loop looped without
+                        # contracting.
+                        rnd, nc = ev.get("round"), ev.get("components")
+                        if _nonneg_int(rnd) and _pos_int(nc):
+                            prev = last_sharded_mst.get(proc)
+                            if rnd == 0:
+                                pass  # a fresh sharded fit restarts
+                            elif prev is None or rnd != prev[0] + 1:
+                                errors.append(
+                                    f"{path}:{lineno}: sharded mst_round "
+                                    f"{rnd} not contiguous (prev "
+                                    f"{None if prev is None else prev[0]})"
+                                )
+                            elif nc >= prev[1]:
+                                errors.append(
+                                    f"{path}:{lineno}: sharded mst_round "
+                                    f"components {nc} did not decrease "
+                                    f"(prev {prev[1]}) — the in-jit "
+                                    f"Borůvka loop must contract every "
+                                    f"round"
+                                )
+                            last_sharded_mst[proc] = (rnd, nc)
+                # Each shard_mst_device summary marks one sharded fit with
+                # mst_backend=device; the end-of-file check pins its
+                # one-host_sync contract.
+                if stage == "shard_mst_device":
+                    sharded_mst_fits[proc] = sharded_mst_fits.get(proc, 0) + 1
                 # Streaming invariants (hdbscan_tpu/stream + serve/server.py):
                 # ingest row accounting, drift-check schema, and the blue/green
                 # contract — swap generations strictly increase per server.
@@ -444,7 +490,7 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
                 # checks need cross-event state so they live in this loop.
                 if stage in ("shard_knn_build", "shard_panel_sweep",
                              "shard_knn_exchange", "shard_boruvka_scan",
-                             "replication_gate"):
+                             "shard_mst_device", "replication_gate"):
                     errors += _check_shard(path, lineno, stage, ev)
                     if stage == "shard_boruvka_scan":
                         rnd, nc = ev.get("round"), ev.get("n_comp")
@@ -549,6 +595,19 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
                 f"for {builds} tree_build_device build(s) — the device MST "
                 f"pipeline must sync exactly once per forest build"
             )
+    # The sharded single-sync contract: a sharded fit with
+    # mst_backend=device (one shard_mst_device summary per fit) makes
+    # exactly ONE host sync — the final edge fetch feeding the device
+    # merge-forest assemble. Together with the equality above this pins
+    # one host_sync AND one forest build per sharded device fit.
+    for proc, fits in sharded_mst_fits.items():
+        syncs = sync_counts.get(proc, [0, 0])[0]
+        if fits > syncs:
+            errors.append(
+                f"{path}: process {proc!r} has {fits} sharded device fit(s) "
+                f"(shard_mst_device) but only {syncs} host_sync event(s) — "
+                f"each sharded fit must sync exactly once"
+            )
     return events, errors
 
 
@@ -616,6 +675,8 @@ def _check_mst_device(path: str, lineno: int, stage: str, ev: dict) -> list[str]
                 f"{where} edges_added={ev.get('edges_added')!r} not a "
                 f"non-negative int"
             )
+        if "sharded" in ev and not isinstance(ev.get("sharded"), bool):
+            errors.append(f"{where} sharded={ev.get('sharded')!r} not a bool")
     elif stage == "host_sync":
         if not _pos_int(ev.get("arrays")):
             errors.append(f"{where} arrays={ev.get('arrays')!r} not a positive int")
@@ -985,6 +1046,8 @@ def _check_shard(path: str, lineno: int, stage: str, ev: dict) -> list[str]:
     elif stage == "shard_boruvka_scan":
         pos("devices", "n_comp")
         nonneg("round", "candidates")
+    elif stage == "shard_mst_device":
+        pos("devices", "rounds", "n", "shard")
     else:  # replication_gate
         if ev.get("ok") is not True:
             errors.append(

@@ -94,6 +94,10 @@ class TestShardedFitCLI:
                 "minPts=5",
                 "minClSize=10",
                 "fit_sharding=sharded",
+                # Sharded routing honors processing_units now (above it the
+                # MR pipeline runs with sharded scanners); pin the exact
+                # one-program leg the gate certifies.
+                "processing_units=16384",
                 "--assert-not-replicated",
                 "--trace-out",
                 str(trace),
@@ -118,6 +122,44 @@ class TestShardedFitCLI:
             "shard_boruvka_scan",
             "replication_gate",
         } <= stages
+
+    def test_sharded_device_fit_one_sync(self, tmp_path, capsys):
+        """The in-jit contraction leg: ``mst_backend=device`` runs every
+        Borůvka round in ONE while_loop dispatch, so the whole fit crosses
+        the host boundary exactly once — and must still be green under the
+        replication gate (the input panel is donated, the edge buffers
+        leave the program row-sharded)."""
+        trace = tmp_path / "trace.jsonl"
+        rc = main(
+            [
+                f"file={_two_blob_csv(tmp_path)}",
+                "minPts=5",
+                "minClSize=10",
+                "fit_sharding=sharded",
+                "mst_backend=device",
+                "processing_units=16384",
+                "--assert-not-replicated",
+                "--trace-out",
+                str(trace),
+                f"out_dir={tmp_path}",
+            ]
+        )
+        assert rc == 0
+        assert "exact-sharded" in capsys.readouterr().out
+        from scripts import check_trace
+
+        events, errors = check_trace.validate_trace(str(trace))
+        assert not errors, errors
+        syncs = [e for e in events if e.get("stage") == "host_sync"]
+        assert len(syncs) == 1, f"one host sync per sharded fit, got {syncs}"
+        rounds = [e for e in events if e.get("stage") == "mst_round"]
+        assert rounds and all(e.get("sharded") is True for e in rounds)
+        comps = [e["components"] for e in rounds]
+        assert comps == sorted(comps, reverse=True) and len(set(comps)) == len(
+            comps
+        ), f"components must strictly contract: {comps}"
+        gate = [e for e in events if e.get("stage") == "replication_gate"]
+        assert gate and gate[0].get("ok") is True
 
     @pytest.mark.slow
     def test_replicated_fit_trips_gate(self, tmp_path, capsys):

@@ -158,6 +158,9 @@ def test_cli_sharded_fit_timeline_roofline_flight(tmp_path):
             "minPts=5",
             "minClSize=10",
             "fit_sharding=sharded",
+            # Pin the exact one-program leg (sharded routing honors
+            # processing_units now; above it the MR pipeline runs).
+            "processing_units=4096",
             "--trace-out", str(trace),
             "--report", str(report),
             "--flight-dir", str(flight_dir),

@@ -43,6 +43,27 @@ class TestWeightedCoreDistances:
         np.testing.assert_allclose(core_u[inverse], want_rows, rtol=1e-5, atol=1e-7)
 
 
+class TestShardedWeightedCores:
+    def test_sharded_matches_replicated_bitwise(self, rng):
+        """``fit_sharding=sharded`` routes the weighted-core k-NN pass
+        through the row-sharded ring scanner on the forced-8-device mesh;
+        the ring scan's lex tie-break contract makes the result BITWISE
+        equal to the host scan, and the weighted expansion on top of the
+        fetched (m, k) lists is shared code — so exact equality, not
+        allclose."""
+        from hdbscan_tpu.core.dedup import global_weighted_core_distances
+        from hdbscan_tpu.parallel.mesh import get_mesh
+
+        rows = _dup_data(rng)
+        uniq, counts, _ = deduplicate(rows)
+        host = global_weighted_core_distances(uniq, counts, 6, "euclidean")
+        shard = global_weighted_core_distances(
+            uniq, counts, 6, "euclidean",
+            mesh=get_mesh(), fit_sharding="sharded",
+        )
+        np.testing.assert_array_equal(shard, host)
+
+
 class TestDedupFitEquivalence:
     def test_labels_match_full_row_exact(self, rng):
         rows = _dup_data(rng)

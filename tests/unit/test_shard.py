@@ -172,3 +172,38 @@ class TestReplicationGateOnFit:
             with pytest.raises(ReplicatedBufferError, match="leak"):
                 obs.assert_not_replicated(n, pts.dtype.itemsize)
             del bad
+
+
+class TestFusedForestSweep:
+    """The double-buffered rp-forest panel exchange: ONE shard_map program
+    builds the per-shard trees and runs the candidate sweep, issuing each
+    panel's ppermute BEFORE scanning the current panel."""
+
+    def test_overlap_attribution_and_schema(self, rng):
+        from hdbscan_tpu.obs import TimelineRecorder
+
+        data = rng.standard_normal((3000, 4))
+        mesh = get_mesh()
+        tl = TimelineRecorder()
+        obs.install(timeline=tl)
+        events = []
+        try:
+            core = shard.shard_forest_core_distances(
+                data, 6, "euclidean", trees=3, leaf_size=256, mesh=mesh,
+                trace=lambda s, **kw: events.append((s, kw)),
+            )
+        finally:
+            obs.clear()
+        assert np.isfinite(core).all() and len(core) == 3000
+        build = next(kw for s, kw in events if s == "shard_knn_build")
+        # Fused program: the build event is a geometry record (its wall
+        # hides under the exchange), flagged so readers don't sum it.
+        assert build["fused"] is True and build["wall_s"] == 0.0
+        sweep = next(kw for s, kw in events if s == "shard_panel_sweep")
+        assert sweep["ppermute_steps"] == sweep["devices"] - 1
+        # The cost model must attribute BOTH comm (the ppermute ring) and
+        # compute (the tile scans) to the fused phase — a zero on either
+        # side means the overlap split collapsed into one bucket.
+        row = tl.phase_table()["shard_panel_sweep"]
+        assert row["comm_s"] > 0 and row["compute_s"] > 0
+        assert row["wall_s"] > 0

@@ -1,0 +1,164 @@
+"""In-jit sharded Borůvka (``parallel/shard.shard_boruvka_mst``) vs the
+host contraction (``utils/unionfind.contract_min_edges``): bitwise parity.
+
+The in-jit program runs every round — scan, cross-device winner reduction,
+pointer-doubling collapse, slot emission — inside ONE ``while_loop``
+dispatch, so none of its intermediate decisions are observable. The only
+acceptable contract is therefore bitwise: the emitted (u, v, w) edge list
+must equal, edge for edge in order, the host loop that scans per-point
+best-outgoing candidates and contracts them with ``contract_min_edges``.
+
+The sweep (>= 300 randomized trials) drives the tie-break cascade with the
+degenerate inputs that historically break lexicographic scatter-min code:
+exact duplicate points (zero distances), all-equal weights (a constant
+core distance above every pairwise distance makes EVERY mutual-reachability
+weight identical — the whole selection runs on the (lo, hi, row) keys),
+uneven shards (n far from multiples of the 128-row padded shard), and the
+n = 1 / n = 2 edge cases. Trials are bucketed on a fixed palette of n so
+the jitted program compiles once per shape, not once per trial.
+"""
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu.core.distances import pairwise_distance
+from hdbscan_tpu.parallel.mesh import get_mesh
+from hdbscan_tpu.parallel.shard import shard_boruvka_mst
+from hdbscan_tpu.utils.unionfind import contract_min_edges
+
+MAX_ROUNDS = 64
+
+
+def _reference_edges(pts, core, metric="euclidean", dtype=np.float32):
+    """The host-contraction Borůvka loop in its plainest possible form.
+
+    Per-point best outgoing candidate = (w, j) lex over ascending global
+    column id (``np.argmin`` returns the FIRST minimum, which is exactly
+    the scan's documented ascending-column tie-break), then one
+    ``contract_min_edges`` round — the same helper the sharded
+    host-contraction fit path calls between device scans.
+    """
+    n = len(pts)
+    pts32 = np.asarray(pts, dtype)
+    d = np.asarray(pairwise_distance(pts32, pts32, metric), dtype)
+    c = np.asarray(core, dtype)
+    w = np.maximum(d, np.maximum(c[:, None], c[None, :]))
+    comp = np.arange(n, dtype=np.int64)
+    eu, ev, ew = [], [], []
+    for _ in range(MAX_ROUNDS):
+        if len(np.unique(comp)) <= 1:
+            break
+        wm = np.where(comp[:, None] != comp[None, :], w, np.inf)
+        bw = wm.min(axis=1)
+        bj = np.where(np.isfinite(bw), wm.argmin(axis=1), -1).astype(np.int64)
+        emit, comp, _ = contract_min_edges(comp, bj, bw.astype(np.float64))
+        if len(emit) == 0:
+            break
+        eu.append(emit)
+        ev.append(bj[emit])
+        ew.append(bw[emit])
+    if not eu:
+        z = np.zeros(0, np.int64)
+        return z, z, np.zeros(0, np.float64)
+    return (
+        np.concatenate(eu),
+        np.concatenate(ev),
+        np.concatenate(ew).astype(np.float64),
+    )
+
+
+def _device_edges(pts, core, mesh, metric="euclidean"):
+    import jax
+
+    res, holds = shard_boruvka_mst(pts, core, metric, mesh=mesh)
+    fetched = jax.device_get(res)
+    for arr in (*res.values(), *holds):
+        arr.delete()
+    count = int(fetched["count"])
+    return (
+        np.asarray(fetched["u"][:count], np.int64),
+        np.asarray(fetched["v"][:count], np.int64),
+        np.asarray(fetched["w"][:count], np.float64),
+    )
+
+
+def _assert_bitwise(pts, core, mesh, metric="euclidean"):
+    hu, hv, hw = _reference_edges(pts, core, metric)
+    du, dv, dw = _device_edges(pts, core, mesh, metric)
+    np.testing.assert_array_equal(du, hu)
+    np.testing.assert_array_equal(dv, hv)
+    # Device weights are f32; the reference computes in f32 and widens, so
+    # equality here is exact, not approximate.
+    np.testing.assert_array_equal(dw, hw)
+
+
+def _make_trial(rng, n, d=2):
+    """One adversarial (points, cores) draw.
+
+    Integer-grid coordinates keep every distance exactly representable in
+    f32 under any summation order, so a weight mismatch can only come from
+    the contraction logic — the thing under test — never from arithmetic.
+    """
+    kind = rng.choice(["duplicates", "all_equal_w", "generic"])
+    if kind == "duplicates":
+        # A handful of distinct sites, heavily repeated: zero distances,
+        # massive (w, lo, hi, row) tie pile-ups.
+        sites = rng.integers(0, 4, size=(max(2, n // 8), d))
+        pts = sites[rng.integers(0, len(sites), size=n)].astype(np.float64)
+        core = rng.integers(0, 3, size=n).astype(np.float64)
+    elif kind == "all_equal_w":
+        # Constant core above every pairwise distance: every mutual
+        # reachability weight equals it, so the selection runs entirely
+        # on the secondary (lo, hi, row) keys.
+        pts = rng.integers(0, 5, size=(n, d)).astype(np.float64)
+        core = np.full(n, 64.0)
+    else:
+        pts = rng.integers(0, 50, size=(n, d)).astype(np.float64)
+        core = rng.integers(0, 8, size=n).astype(np.float64)
+    return pts, core
+
+
+class TestShardMSTParity:
+    """The randomized sweep: >= 300 trials across 8 compile shapes."""
+
+    # (n, trials): small-n edge cases, a single-shard uneven size, the
+    # 2-device and 8-device uneven splits, and the exactly-even 8x128
+    # geometry. Total = 305 trials.
+    PALETTE = [
+        (1, 3),
+        (2, 12),
+        (3, 15),
+        (60, 95),
+        (129, 95),
+        (700, 30),
+        (1024, 30),
+        (1031, 25),
+    ]
+
+    @pytest.mark.parametrize(
+        "n,trials", PALETTE, ids=[f"n{n}" for n, _ in PALETTE]
+    )
+    def test_randomized_sweep(self, n, trials):
+        mesh = get_mesh()
+        rng = np.random.default_rng(1000 + n)
+        for _ in range(trials):
+            pts, core = _make_trial(rng, n)
+            _assert_bitwise(pts, core, mesh)
+
+    def test_trial_budget_is_at_least_300(self):
+        assert sum(t for _, t in self.PALETTE) >= 300
+
+    def test_all_points_identical(self):
+        # n identical points: every distance zero, every weight equals the
+        # shared core — the maximal tie, resolved purely by vertex ids.
+        mesh = get_mesh()
+        pts = np.ones((60, 2))
+        core = np.full(60, 2.0)
+        _assert_bitwise(pts, core, mesh)
+
+    def test_manhattan_metric(self):
+        mesh = get_mesh()
+        rng = np.random.default_rng(7)
+        pts = rng.integers(0, 20, size=(60, 3)).astype(np.float64)
+        core = rng.integers(0, 5, size=60).astype(np.float64)
+        _assert_bitwise(pts, core, mesh, metric="manhattan")

@@ -278,6 +278,77 @@ class TestCheckTraceScript:
         assert any("device 0 seq" in e for e in errors)
         assert check_trace.main([str(bad)]) == 1
 
+    def test_sharded_mst_invariants(self, tmp_path):
+        """In-jit sharded Borůvka schema (parallel/shard.py): ``mst_round``
+        events tagged ``sharded: true`` must be contiguous with strictly
+        decreasing ``components``, and every ``shard_mst_device`` fit must
+        land exactly one ``host_sync``."""
+        import json
+
+        from scripts import check_trace
+
+        def fit_events(seq0, comps=(57, 9, 1)):
+            evs = [
+                {"schema": TRACE_SCHEMA, "stage": "shard_mst_device",
+                 "wall_s": 0.4, "devices": 8, "rounds": len(comps),
+                 "n": 600, "shard": 128, "seq": seq0, "process": 0},
+            ]
+            for r, c in enumerate(comps):
+                evs.append(
+                    {"schema": TRACE_SCHEMA, "stage": "mst_round",
+                     "wall_s": 0.0, "round": r, "components": c,
+                     "edges_added": 1, "sharded": True,
+                     "seq": seq0 + 1 + r, "process": 0}
+                )
+            evs.append(
+                {"schema": TRACE_SCHEMA, "stage": "host_sync", "wall_s": 0.1,
+                 "arrays": 10, "bytes": 4096,
+                 "seq": seq0 + 1 + len(comps), "process": 0}
+            )
+            evs.append(
+                {"schema": TRACE_SCHEMA, "stage": "tree_build_device",
+                 "wall_s": 0.1, "fallback": False, "nodes": 599,
+                 "backend": "device",
+                 "seq": seq0 + 2 + len(comps), "process": 0}
+            )
+            return evs
+
+        good = tmp_path / "shard_mst_ok.jsonl"
+        good.write_text(
+            "".join(json.dumps(e) + "\n" for e in fit_events(1))
+        )
+        _, errors = check_trace.validate_trace(str(good))
+        assert errors == []
+
+        # A round that fails to contract — the while_loop looped for free.
+        stall = tmp_path / "shard_mst_stall.jsonl"
+        stall.write_text(
+            "".join(
+                json.dumps(e) + "\n" for e in fit_events(1, comps=(57, 57, 9))
+            )
+        )
+        _, errors = check_trace.validate_trace(str(stall))
+        assert any("did not decrease" in e for e in errors)
+
+        # A dropped round: the replayed counter must be contiguous.
+        gap = tmp_path / "shard_mst_gap.jsonl"
+        evs = fit_events(1)
+        evs[2]["round"] = 2  # 0, 2, 2...
+        gap.write_text("".join(json.dumps(e) + "\n" for e in evs))
+        _, errors = check_trace.validate_trace(str(gap))
+        assert any("not contiguous" in e for e in errors)
+
+        # Two sharded device fits but only one host_sync between them.
+        twofit = tmp_path / "shard_mst_twofit.jsonl"
+        evs = fit_events(1)
+        evs.insert(1, {"schema": TRACE_SCHEMA, "stage": "shard_mst_device",
+                       "wall_s": 0.4, "devices": 8, "rounds": 3, "n": 600,
+                       "shard": 128, "seq": 99, "process": 0})
+        twofit.write_text("".join(json.dumps(e) + "\n" for e in evs))
+        _, errors = check_trace.validate_trace(str(twofit))
+        assert any("sync exactly once" in e for e in errors)
+        assert check_trace.main([str(twofit)]) == 1
+
     def test_wall_mismatch_detected(self, tmp_path):
         from scripts import check_trace
 
