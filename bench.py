@@ -36,6 +36,12 @@ Secondary rows in the same JSON line:
   neighbors") at the literal config: end-to-end wall vs the exact headline
   (``rpforest_e2e_vs_exact``), ARI, and the engine's own traced build wall,
   post-merge sampled recall and query throughput (``knn_index_*`` events),
+- the fused forest-query kernel (``knn_backend=fused``, README "Kernel
+  depth"): the same rpforest config through the one-program Pallas scan —
+  wall vs the unfused leg, a live bitwise f32 label check, a modeled
+  roofline row (``fused_forest_ai_flops_per_byte`` vs the unfused chain's
+  AI at the traced geometry), and the ``knn_precision=bf16`` knob's ARI
+  against the fused-f32 labels,
 - the streaming ingest leg (README "Streaming"): sustained ``/ingest``
   throughput through the served model (rows/s), the absorb ratio on
   near-manifold traffic, and the blue/green swap pause p50/p99 over repeated
@@ -1329,6 +1335,89 @@ def main(argv: list[str] | None = None) -> None:
             len(data) / max(last_q.wall_s, 1e-9), 1
         )
 
+    # --- fused forest-query kernel leg (knn_backend=fused) -----------------
+    # Same rpforest literal config through the r16 one-program scan
+    # (ops/pallas_forest, README "Kernel depth"): leaf gather -> MXU
+    # distance tiles -> on-chip compare-exchange k-best registers, rescan
+    # panels reduced without materializing the (rows, k^2) candidate matrix
+    # in HBM. f32 is bitwise-identical to the unfused leg above — checked
+    # here on live labels, pinned by tests/unit/test_pallas_forest.py. The
+    # roofline row models the scan phase's arithmetic intensity both ways
+    # at the traced geometry (the unfused chain round-trips per-row
+    # candidate distances through HBM; the fused program ships operands and
+    # k-best rows only) — scripts/bench_compare.py tracks the fused AI
+    # higher-better. A bf16 secondary run reports the knn_precision knob's
+    # ARI against the fused-f32 labels (acceptance >= 0.99x f32).
+    esnap_ff = len(tracer.events)
+    ff_params = HDBSCANParams(
+        min_points=LIT_MIN_PTS,
+        min_cluster_size=MIN_CL_SIZE,
+        knn_index="rpforest",
+        rpf_trees=4,
+        rpf_leaf_size=1024,
+        rpf_rescan_rounds=1,
+        knn_backend="fused",
+    )
+    tracer("bench_leg", leg="exact/fused_forest")
+    r_unf = exact.fit(
+        data, ff_params.replace(knn_backend="auto"), mesh=mesh, trace=tracer
+    )
+    exact.fit(data, ff_params, mesh=mesh, trace=tracer)  # warm XLA compiles
+    ff_wall, ff_spread, r_ff, _, ff_tree = timed_runs(
+        lambda: exact.fit(data, ff_params, mesh=mesh, trace=tracer)
+    )
+    ff_fields = {
+        "fused_forest_e2e_wall_s": round(ff_wall, 3),
+        "fused_forest_e2e_spread_s": [
+            round(ff_spread[0], 3),
+            round(ff_spread[1], 3),
+        ],
+        "fused_forest_vs_unfused": round(rpf_wall / ff_wall, 3),
+        "fused_forest_e2e_ari": round(ari(r_ff.labels), 4),
+        "fused_forest_bitwise_f32": bool(
+            np.array_equal(r_ff.labels, r_unf.labels)
+        ),
+        "fused_forest_e2e_tree_wall_s": round(ff_tree, 3),
+    }
+    ff_events = [
+        e for e in tracer.events[esnap_ff:] if e.name == "knn_fused_forest"
+    ]
+    if ff_events:
+        # Roofline row at the traced geometry: analytic scan FLOPs over
+        # modeled HBM bytes, leaf height capped at the configured
+        # leaf_size. Same convention as devicebench's fused_forest_* rows.
+        ev = ff_events[-1].fields
+        lmax, d_feat, f32b = ff_params.rpf_leaf_size, data.shape[1], 4
+        flops = 2.0 * ev["n"] * ev["trees"] * lmax * d_feat
+        bytes_unf = f32b * ev["n"] * (
+            ev["trees"] * lmax * d_feat
+            + 2 * ev["trees"] * lmax
+            + 2 * ev["k"]
+        )
+        bytes_fus = f32b * ev["n"] * (
+            ev["trees"] * lmax * d_feat + 2 * ev["k"]
+        )
+        ff_fields["fused_forest_ai_flops_per_byte"] = round(
+            flops / bytes_fus, 3
+        )
+        ff_fields["fused_forest_ai_unfused"] = round(flops / bytes_unf, 3)
+        ff_fields["fused_forest_refine_rows"] = int(ev["refine_rows"])
+    r_bf = exact.fit(
+        data, ff_params.replace(knn_precision="bf16"), mesh=mesh,
+        trace=tracer,
+    )
+    ff_fields["fused_forest_bf16_ari_vs_f32"] = round(
+        adjusted_rand_index(r_bf.labels, r_ff.labels), 4
+    )
+    print(
+        f"[bench] exact/fused_forest: wall={ff_wall:.2f}s "
+        f"[{ff_spread[0]:.2f}, {ff_spread[1]:.2f}] "
+        f"vs_unfused={ff_fields['fused_forest_vs_unfused']}x "
+        f"bitwise_f32={ff_fields['fused_forest_bitwise_f32']} "
+        f"bf16_ari_vs_f32={ff_fields['fused_forest_bf16_ari_vs_f32']}",
+        file=sys.stderr,
+    )
+
     # --- distributed DB pipeline (reference's live method) -----------------
     mr_params = HDBSCANParams(
         min_points=CAL_MIN_PTS,
@@ -1488,6 +1577,7 @@ def main(argv: list[str] | None = None) -> None:
                 "db_flat_tree_wall_s": round(fl_tree, 3),
                 **mst_device_fields,
                 **rpf_fields,
+                **ff_fields,
                 **predict_fields,
                 **stream_fields,
                 **ring_fields,

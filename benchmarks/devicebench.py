@@ -45,6 +45,15 @@ dispatch/transfer-bound, kernels are not worth optimizing" (ROADMAP r4 item
   O(n^2 d) scan on the same rows, with recomputed recall@k and a paired
   full-fit ARI-vs-exact. Acceptance: ``vs_exact >= 3`` at n=200k,
   leaf_size=1024.
+- ``fused_forest_*``: the r16 fused forest-query program
+  (``ops/pallas_forest``, README "Kernel depth") — leaf-scan and rescan
+  candidate-panel phase pairs, unfused production chain vs the fused
+  kernel body vs the actual Pallas program (full batch on TPU,
+  ``interpret:true`` wiring rows off it), with modeled roofline
+  ``ai_flops_per_byte`` per row. Acceptance: body >= 1.5x unfused
+  ``gflops_s`` at the 200k proxy; arithmetic intensity up on both scan
+  phases (the unfused chain round-trips the candidate matrix through
+  HBM).
 
 FLOP convention matches ``utils/flops`` (2*rows*cols*d logical; the
 f32-HIGHEST cross matmul runs ~6 bf16 passes, so a perfectly MXU-bound
@@ -880,6 +889,222 @@ def bench_rpforest(out_path, n=200_000, d=8, min_pts=16, k=16, trees=4,
     ))
 
 
+def bench_fused_forest(out_path, n=200_000, d=8, k=16, trees=4,
+                       leaf_size=1024, iters=3, seed=0):
+    """Fused forest-query program legs (README "Kernel depth").
+
+    Two phase pairs on the same forest geometry, unfused production chain
+    vs the fused kernel BODY (the r6 ``fused_body`` convention: the
+    kernel-resident math jitted as plain jnp, so off-TPU rows measure the
+    algorithm, not the Pallas interpreter), plus the actual Pallas
+    programs — full-batch on TPU, small-batch ``interpret:true`` wiring
+    rows off it:
+
+    - ``fused_forest_leafscan_unfused`` / ``_body`` / ``_pallas``: the
+      per-leaf candidate scan — ``rpforest._leaf_scan`` ((Lmax, Lmax)
+      distance matrix in HBM + ``lax.top_k`` + lexsort) vs
+      ``pallas_forest.leaf_topk_values`` (distance tile + k-pass lex
+      registers, matrix never leaves VMEM on TPU).
+    - ``fused_forest_rescan_unfused`` / ``_body`` / ``_pallas``: the
+      rescan candidate-panel reduction — vmapped ``pairwise_distance`` +
+      ``dedup_lex_merge`` of the (m, k²) matrix vs
+      ``pallas_forest.rescan_topk_values``.
+
+    Acceptance (ISSUE 19): body rows >= 1.5x ``gflops_s`` over their
+    unfused twin at the 200k proxy. ``ai_flops_per_byte`` is the MODELED
+    TPU roofline arithmetic intensity (same analytic convention both
+    rows: the unfused chain round-trips the candidate distance matrix
+    through HBM, the fused body does not) — the companion
+    ``bench_compare`` headline tracks it higher-better.
+    """
+    from hdbscan_tpu.ops import pallas_forest as pf
+    from hdbscan_tpu.ops.rpforest import (
+        _dedup_lex_merge,
+        _leaf_scan,
+        build_forest,
+    )
+
+    rng = np.random.default_rng(seed)
+    centers = rng.uniform(-10, 10, (32, d))
+    data = (centers[rng.integers(0, 32, n)]
+            + rng.normal(0, 0.6, (n, d))).astype(np.float32)
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    forest = build_forest(data, trees=trees, leaf_size=leaf_size, seed=seed)
+    lmax = forest.max_leaf
+    bsz = min(forest.num_leaves, max(1, (1 << 25) // (lmax * lmax)))
+    members = jnp.asarray(forest.members[0, :bsz])
+    mask = jnp.asarray(forest.leaf_mask[:bsz])
+    data_dev = jnp.asarray(data)
+    sentinel = n
+    kk = min(k, lmax)
+    form = pf.euclid_form(lmax, lmax, d)
+    f32 = 4
+    base = dict(
+        n=n, d=d, k=kk, trees=trees, leaf_size=leaf_size,
+        leaves=forest.num_leaves, max_leaf=lmax, leaf_batch=bsz,
+        iters=iters, seed=seed, platform=platform,
+        cpu_smoke=platform != "tpu", device=str(jax.devices()[0]),
+        peak_flops=PEAK_FLOPS,
+    )
+
+    # --- leaf scan pair ----------------------------------------------------
+    flops_l = 2.0 * bsz * lmax * lmax * d
+    # HBM traffic model: operand gather + outputs both ways; the unfused
+    # chain additionally writes the (B, Lmax, Lmax) matrix and reads it
+    # back for top_k.
+    bytes_l_unf = f32 * (
+        bsz * lmax * d + 2 * bsz * lmax * lmax + 2 * bsz * lmax * kk
+    )
+    bytes_l_fus = f32 * (bsz * lmax * d + 2 * bsz * lmax * kk)
+
+    def run_leaf_unfused():
+        nd, _ = _leaf_scan(data_dev, members, mask, kk, "euclidean", sentinel)
+        return jnp.sum(jnp.where(jnp.isfinite(nd), nd, 0.0))
+
+    wall_u, spread = _time_call(run_leaf_unfused, iters)
+    _emit(out_path, dict(
+        leg="fused_forest_leafscan_unfused", wall_s=round(wall_u, 4),
+        spread_s=spread, gflops=round(flops_l / 1e9, 1),
+        gflops_s=round(flops_l / wall_u / 1e9, 2),
+        mfu=round(flops_l / wall_u / PEAK_FLOPS, 5),
+        ai_flops_per_byte=round(flops_l / bytes_l_unf, 2), **base,
+    ))
+
+    lp = pf._ceil_to(max(lmax, pf.SUBLANES), pf.LANES)
+    dp = pf.LANES
+
+    @jax.jit
+    def leaf_body():
+        pts = jnp.pad(
+            data_dev[members], ((0, 0), (0, lp - lmax), (0, dp - d))
+        )
+        ids = jnp.pad(
+            members.astype(jnp.int32), ((0, 0), (0, lp - lmax)),
+            constant_values=sentinel,
+        )
+        cm = jnp.pad(mask.astype(jnp.int32), ((0, 0), (0, lp - lmax)))
+        nd, ni = jax.vmap(
+            lambda p, i, c: pf.leaf_topk_values(
+                p, i, c, kk, d_real=d, metric="euclidean", form=form,
+                precision="f32", sentinel=sentinel,
+            )
+        )(pts, ids, cm)
+        nd, ni = nd[:, :lmax], ni[:, :lmax]
+        order = jnp.lexsort((ni, nd), axis=-1)
+        nd = jnp.take_along_axis(nd, order, axis=-1)
+        return jnp.sum(jnp.where(jnp.isfinite(nd), nd, 0.0))
+
+    wall_b, spread = _time_call(lambda: leaf_body(), iters)
+    _emit(out_path, dict(
+        leg="fused_forest_leafscan_body", wall_s=round(wall_b, 4),
+        spread_s=spread, gflops=round(flops_l / 1e9, 1),
+        gflops_s=round(flops_l / wall_b / 1e9, 2),
+        mfu=round(flops_l / wall_b / PEAK_FLOPS, 5),
+        ai_flops_per_byte=round(flops_l / bytes_l_fus, 2),
+        vs_unfused=round(wall_u / wall_b, 3),
+        note=(
+            "CPU proxy inverts this pair: lax.top_k is a tuned native "
+            "kernel on CPU while the k-pass registers are TPU-VPU-shaped "
+            "(r5 measured top_k at ~90% of on-chip scan wall); the "
+            "compiled TPU leg is the real test" if not on_tpu else None
+        ), **base,
+    ))
+
+    # Actual Pallas program: full batch on TPU (the staged real leg);
+    # off-TPU a small-batch interpreter wiring row, honestly marked.
+    bsz_p = bsz if on_tpu else min(bsz, 8)
+    flops_p = 2.0 * bsz_p * lmax * lmax * d
+
+    def run_leaf_pallas():
+        nd, _ = pf.forest_leaf_topk(
+            data_dev, members[:bsz_p], mask[:bsz_p], kk, "euclidean", form,
+            "f32", sentinel, interpret=not on_tpu,
+        )
+        return jnp.sum(jnp.where(jnp.isfinite(nd), nd, 0.0))
+
+    wall, spread = _time_call(run_leaf_pallas, iters)
+    _emit(out_path, dict(
+        leg="fused_forest_leafscan_pallas", wall_s=round(wall, 4),
+        spread_s=spread, interpret=not on_tpu, leaf_batch_pallas=bsz_p,
+        gflops=round(flops_p / 1e9, 1),
+        gflops_s=round(flops_p / wall / 1e9, 2),
+        mfu=round(flops_p / wall / PEAK_FLOPS, 5),
+        ai_flops_per_byte=round(flops_l / bytes_l_fus, 2), **base,
+    ))
+
+    # --- rescan candidate-panel pair --------------------------------------
+    m = min(n, 1 << 14)
+    cc = kk * kk
+    cand = jnp.asarray(rng.integers(0, n, (m, cc)).astype(np.int32))
+    q = data_dev[:m]
+    flops_r = 2.0 * m * cc * d
+    bytes_r_unf = f32 * (m * d + m * cc * d + 2 * m * cc + 2 * m * kk)
+    bytes_r_fus = f32 * (m * d + m * cc * d + 2 * m * kk)
+
+    @jax.jit
+    def rescan_unfused():
+        cpts = data_dev[cand]
+        cd = jax.vmap(
+            lambda qq, pts: pairwise_distance(qq[None, :], pts, "euclidean")[0]
+        )(q, cpts)
+        nd, _ = _dedup_lex_merge(cd, cand, kk, sentinel)
+        return jnp.sum(jnp.where(jnp.isfinite(nd), nd, 0.0))
+
+    wall_u, spread = _time_call(lambda: rescan_unfused(), iters)
+    _emit(out_path, dict(
+        leg="fused_forest_rescan_unfused", wall_s=round(wall_u, 4),
+        spread_s=spread, rows=m, cand_cols=cc,
+        gflops=round(flops_r / 1e9, 1),
+        gflops_s=round(flops_r / wall_u / 1e9, 2),
+        mfu=round(flops_r / wall_u / PEAK_FLOPS, 5),
+        ai_flops_per_byte=round(flops_r / bytes_r_unf, 2), **base,
+    ))
+
+    for precision in ("f32",) + (("bf16",) if on_tpu else ()):
+
+        @partial(jax.jit, static_argnames=("prec",))
+        def rescan_body(prec=precision):
+            cpts = data_dev[cand]
+            nd, _ = pf.rescan_topk_values(
+                q, cpts, cand, kk, d_real=d, metric="euclidean",
+                precision=prec, sentinel=sentinel,
+            )
+            return jnp.sum(jnp.where(jnp.isfinite(nd), nd, 0.0))
+
+        wall_b, spread = _time_call(lambda: rescan_body(), iters)
+        tag = "" if precision == "f32" else "_bf16"
+        _emit(out_path, dict(
+            leg=f"fused_forest_rescan_body{tag}", wall_s=round(wall_b, 4),
+            spread_s=spread, rows=m, cand_cols=cc, precision=precision,
+            gflops=round(flops_r / 1e9, 1),
+            gflops_s=round(flops_r / wall_b / 1e9, 2),
+            mfu=round(flops_r / wall_b / PEAK_FLOPS, 5),
+            ai_flops_per_byte=round(flops_r / bytes_r_fus, 2),
+            vs_unfused=round(wall_u / wall_b, 3), **base,
+        ))
+
+    m_p = m if on_tpu else min(m, 256)
+
+    def run_rescan_pallas():
+        nd, _ = pf.forest_rescan_topk(
+            q[:m_p], data_dev[cand[:m_p]], cand[:m_p], kk, "euclidean",
+            "f32", sentinel, interpret=not on_tpu,
+        )
+        return jnp.sum(jnp.where(jnp.isfinite(nd), nd, 0.0))
+
+    flops_rp = 2.0 * m_p * cc * d
+    wall, spread = _time_call(run_rescan_pallas, iters)
+    _emit(out_path, dict(
+        leg="fused_forest_rescan_pallas", wall_s=round(wall, 4),
+        spread_s=spread, interpret=not on_tpu, rows=m_p, cand_cols=cc,
+        gflops=round(flops_rp / 1e9, 1),
+        gflops_s=round(flops_rp / wall / 1e9, 2),
+        mfu=round(flops_rp / wall / PEAK_FLOPS, 5),
+        ai_flops_per_byte=round(flops_r / bytes_r_fus, 2), **base,
+    ))
+
+
 def bench_predict(out_path, n=100_000, d=8, iters=50, seed=0, max_batch=256):
     """Serving predict-throughput leg (README "Serving").
 
@@ -949,7 +1174,7 @@ def main():
     ap.add_argument(
         "--legs",
         default="dispatch,exact,rescan,ring,finalize,mst_device,rpforest,"
-                "predict",
+                "fused_forest,predict",
     )
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument("--compile-cache", default="auto",
@@ -1014,6 +1239,11 @@ def main():
         bench_rpforest(
             args.out, n=args.rpf_n, d=args.rpf_d, trees=args.rpf_trees,
             leaf_size=args.rpf_leaf_size, ari_n=args.rpf_ari_n,
+        )
+    if "fused_forest" in legs:
+        bench_fused_forest(
+            args.out, n=args.rpf_n, d=args.rpf_d, trees=args.rpf_trees,
+            leaf_size=args.rpf_leaf_size, iters=args.iters,
         )
     if "predict" in legs:
         bench_predict(

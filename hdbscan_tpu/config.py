@@ -193,6 +193,16 @@ class HDBSCANParams:
     #: falls back to the guarded XLA scan when the shape/metric/platform is
     #: ineligible, so the knob is safe under every parameterization.
     knn_backend: str = "auto"
+    #: Distance-tile precision of the FUSED forest-query program
+    #: (``knn_backend="fused"`` + ``knn_index="rpforest"``,
+    #: ``ops/pallas_forest``): "f32" (default) is bitwise identical to the
+    #: unfused engine; "bf16" computes the leaf/rescan distance tiles from
+    #: bf16 MXU operands with f32 accumulation and re-distances the
+    #: surviving k-best exactly in f32 (``pallas_forest.refine_f32``) —
+    #: euclidean only, quality pinned by the recall/ARI gate in
+    #: tests/unit/test_pallas_forest.py. Every other path ignores the knob
+    #: and stays f32-exact.
+    knn_precision: str = "f32"
     #: Neighbor-graph TIER for the core-distance scans — orthogonal to
     #: ``knn_backend`` (which picks the kernel evaluating distance tiles):
     #: "exact" (default) runs the O(n² d) scans bitwise-unchanged,
@@ -482,6 +492,11 @@ class HDBSCANParams:
                 "knn_backend must be 'auto', 'xla', 'pallas' or 'fused', "
                 f"got {self.knn_backend!r}"
             )
+        if self.knn_precision not in ("f32", "bf16"):
+            raise ValueError(
+                "knn_precision must be 'f32' or 'bf16', "
+                f"got {self.knn_precision!r}"
+            )
         if self.predict_backend not in ("auto", "xla", "fused", "rpforest"):
             raise ValueError(
                 "predict_backend must be 'auto', 'xla', 'fused' or "
@@ -707,6 +722,7 @@ FLAG_FIELDS = {
     "consensus": ("consensus_draws", int),
     "block_pruning": ("boundary_block_pruning", _bool),
     "knn_backend": ("knn_backend", str),
+    "knn_precision": ("knn_precision", str),
     "knn_index": ("knn_index", str),
     "knn_index_threshold": ("knn_index_threshold", int),
     "rpf_trees": ("rpf_trees", int),

@@ -31,8 +31,10 @@ def resolve_index_for(params, n: int) -> tuple[str, dict]:
     ``ops.blockscan`` core-distance entry points: ``index`` is "exact" or
     "rpforest" (``config.knn_index`` with "auto" resolved at the
     ``knn_index_threshold`` flip point), and ``index_opts`` carries the
-    forest knobs (trees / leaf_size / rescan_rounds / seed) — empty for
-    the exact tier so the exact call sites stay byte-identical.
+    forest knobs (trees / leaf_size / rescan_rounds / seed, plus the
+    ``knn_backend``/``knn_precision`` pair that gates the fused Pallas
+    forest program, ``ops/pallas_forest``) — empty for the exact tier so
+    the exact call sites stay byte-identical.
     """
     from hdbscan_tpu.ops.rpforest import resolve_knn_index
 
@@ -46,6 +48,8 @@ def resolve_index_for(params, n: int) -> tuple[str, dict]:
         "leaf_size": params.rpf_leaf_size,
         "rescan_rounds": params.rpf_rescan_rounds,
         "seed": params.seed,
+        "knn_backend": params.knn_backend,
+        "knn_precision": params.knn_precision,
     }
 
 

@@ -663,6 +663,73 @@ def _boruvka_rounds_device(
     }
 
 
+#: Round cap for the in-jit Borůvka ``while_loop`` (this module and the
+#: sharded twin, ``parallel/shard.shard_boruvka_mst``). Borůvka at least
+#: halves the component count every productive round, so 64 covers any
+#: addressable n; hitting the cap means the contraction is broken, not
+#: that the input is large. Checked after the fetch by
+#: :func:`assert_rounds_converged`.
+DEFAULT_MAX_ROUNDS = 64
+
+
+def assert_rounds_converged(
+    rounds: int,
+    count: int,
+    n: int,
+    *,
+    max_rounds: int = DEFAULT_MAX_ROUNDS,
+    stat_comp=None,
+    stat_edges=None,
+    where: str = "boruvka_mst_device",
+) -> None:
+    """Raise if the fixed-round Borůvka ``while_loop`` exited at its cap
+    with components still unmerged.
+
+    The in-jit loop (``_boruvka_rounds_device`` and the sharded twin)
+    cannot raise from inside the program, and a capped exit is silent: the
+    edge buffers simply come back short, which downstream reads as a
+    forest with spurious extra roots — exactly the failure mode a
+    miscontraction (or a metric emitting NaN weights, which stalls
+    ``progress``) produces. Callers check the FETCHED ``rounds``/``count``
+    scalars here, after the one host sync they already perform.
+
+    A clean exit is either ``count == n - 1`` (spanning tree complete) or
+    a final round that added no edges (``progress`` False — genuinely
+    disconnected data under a finite-break metric, every component
+    saturated). Hitting ``max_rounds`` while the last round still added
+    edges is neither, and raises with the per-round component/edge tail so
+    the divergence is diagnosable from the exception alone.
+    """
+    if rounds < max_rounds or count >= max(n - 1, 0):
+        return
+    last_added = None
+    tail = ""
+    if stat_edges is not None:
+        stat_edges = np.asarray(stat_edges)
+        last_added = int(stat_edges[max_rounds - 1])
+        if last_added == 0:
+            return  # saturated (disconnected input), not capped mid-merge
+        show = min(4, max_rounds)
+        comps = (
+            np.asarray(stat_comp)[-show:].tolist()
+            if stat_comp is not None
+            else "?"
+        )
+        tail = (
+            f"; last {show} rounds: components={comps}, "
+            f"edges_added={stat_edges[-show:].tolist()}"
+        )
+    raise RuntimeError(
+        f"{where}: Borůvka round cap hit without convergence — "
+        f"{rounds} rounds (max_rounds={max_rounds}) emitted {count} of "
+        f"{max(n - 1, 0)} spanning edges and the loop was still merging"
+        f"{tail}. Borůvka halves components every round, so a capped exit "
+        f"indicates a contraction/scan defect (or NaN edge weights), not "
+        f"input size; rerun with a larger max_rounds only to gather "
+        f"diagnostics."
+    )
+
+
 def boruvka_mst_device(
     data: np.ndarray,
     core: np.ndarray,
@@ -670,7 +737,7 @@ def boruvka_mst_device(
     row_tile: int = 1024,
     col_tile: int = 8192,
     dtype=np.float32,
-    max_rounds: int = 64,
+    max_rounds: int = DEFAULT_MAX_ROUNDS,
 ):
     """Device-resident Borůvka MST: pad once, run every round in one jit.
 

@@ -592,6 +592,7 @@ def _fit_device(
     from hdbscan_tpu.core.knn import resolve_index_for
     from hdbscan_tpu.core.mst_device import (
         assemble_merge_forest,
+        assert_rounds_converged,
         boruvka_mst_device,
         forest_events_device,
     )
@@ -691,6 +692,15 @@ def _fit_device(
             arr.delete()
     rounds = int(fetched["rounds"])
     count = int(fetched["count"])
+    # A capped while_loop exit is silent on device — short edge buffers
+    # would flow into the forest scan as spurious extra roots. Check the
+    # fetched round counters loudly, for both the sharded and single-device
+    # program (same cap, same stat tail).
+    assert_rounds_converged(
+        rounds, count, n,
+        stat_comp=fetched["stat_comp"], stat_edges=fetched["stat_edges"],
+        where="shard_boruvka_mst" if mesh is not None else "boruvka_mst_device",
+    )
     if mesh is not None:
         # The while_loop ran every round in ONE dispatch: credit the scan
         # FLOPs from the fetched round counter, and replay the program wall

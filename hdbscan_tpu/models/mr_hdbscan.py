@@ -620,6 +620,10 @@ def _fit_rows(
             from hdbscan_tpu.core.knn import resolve_index_for
             from hdbscan_tpu.parallel.ring import resolve_scan_backend
 
+            # index_opts carries the forest knobs INCLUDING the
+            # knn_backend/knn_precision pair, so on the rpforest tier every
+            # engine below (tiled, ring, sharded panel sweep) sees the same
+            # fused-forest routing decision the exact fit makes.
             index, index_opts = resolve_index_for(params, n)
             from hdbscan_tpu.parallel.shard import resolve_fit_sharding
 

@@ -514,16 +514,13 @@ def _merge_knn_device(cur_d, cur_i, new_d, new_i, k: int):
     host merge: it drove core distances BELOW the full-sweep truth).
     Invalid slots carry id -1 / distance +inf; -1 duplicates are exempt
     from the dedup mask (they are all inf anyway).
+
+    Shared contract home: ``ops/lexmerge.merge_sorted_dedup`` (the
+    negative-id-convention form of the repo-wide lex merge).
     """
-    cat_d = jnp.concatenate([cur_d, new_d], axis=1)
-    cat_i = jnp.concatenate([cur_i, new_i], axis=1)
-    order = jnp.argsort(cat_i, axis=1, stable=True)
-    ci = jnp.take_along_axis(cat_i, order, axis=1)
-    cd = jnp.take_along_axis(cat_d, order, axis=1)
-    dup = (ci[:, 1:] == ci[:, :-1]) & (ci[:, 1:] >= 0)
-    cd = cd.at[:, 1:].set(jnp.where(dup, jnp.inf, cd[:, 1:]))
-    nb, sel = jax.lax.top_k(-cd, k)
-    return -nb, jnp.take_along_axis(ci, sel, axis=1)
+    from hdbscan_tpu.ops.lexmerge import merge_sorted_dedup
+
+    return merge_sorted_dedup(cur_d, cur_i, new_d, new_i, k)
 
 
 #: Block the dispatch queue on the merge buffer every N chunks: without

@@ -1,0 +1,207 @@
+"""The repo-wide (distance, id) lexicographic k-best merge — ONE home.
+
+Every neighbor-list producer in the codebase ranks candidates by the same
+total order: ascending distance, ties broken by ascending global id, with
+duplicate ids collapsed to their smallest-distance copy. Before this module
+the contract lived in three independent copies — the XLA lexsort merge
+(``ops/rpforest._dedup_lex_merge``), the Pallas in-kernel compare-exchange
+merge (``ops/pallas_knn._fused_merge_tile``), and the blockscan window
+merge (``ops/blockscan._merge_knn_device``) — which is exactly how a
+tie-break drifts. All three now delegate here, as does the fused
+forest-query program family (``ops/pallas_forest``).
+
+Two representation conventions coexist and are both honored:
+
+* **Sentinel ids** (rpforest/serving): empty or masked slots carry
+  ``(+inf, sentinel)`` with ``sentinel = n`` (> every real id), so the lex
+  order itself pushes them past every real candidate.
+* **Negative ids** (pallas_knn / blockscan): empty slots carry
+  ``(+inf, -1)``; ``-1`` is *exempt* from dedup (all copies are +inf) and
+  wins +inf ties so masked padding columns never displace an empty slot.
+
+Kernel-side helpers (``shift_insert`` / ``merge_tile_contiguous`` /
+``merge_tile_candidates``) are plain jnp on values and run unchanged inside
+Pallas kernel bodies, under ``shard_map``, and in ordinary jit code — the
+"same kernel body per shard" reuse of the sharded panel sweep.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: In-kernel "no id" value for sentinel-convention scratch: larger than any
+#: real int32 id, so a lex tie at +inf never prefers it over a real slot.
+ID_MAX = jnp.iinfo(jnp.int32).max
+
+
+def lex_improves(new_d, new_i, cur_d, cur_i):
+    """True where (new_d, new_i) lex-precedes (cur_d, cur_i).
+
+    THE tie-break predicate: smaller distance wins; equal distances go to
+    the smaller id. Every merge below routes its take decision through
+    this single expression.
+    """
+    return (new_d < cur_d) | ((new_d == cur_d) & (new_i < cur_i))
+
+
+def shift_insert(best, t: int, new_t, take):
+    """Merged slot t gets ``new_t``; where the tile won, old slots shift
+    right. ``best``: (rows, k) running registers; ``take``: (rows,) bool."""
+    slot_iota = jax.lax.broadcasted_iota(jnp.int32, best.shape, 1)
+    shifted = jnp.concatenate([best[:, :1], best[:, :-1]], axis=1)
+    out = jnp.where((slot_iota > t) & take[:, None], shifted, best)
+    return jnp.where(slot_iota == t, new_t[:, None], out)
+
+
+def merge_tile_contiguous(bd, bi, dist, base, k: int):
+    """Merge one distance tile whose column ids are ``base + column`` into
+    running (distance, id) k-best registers, ascending by (d, id) lex order.
+
+    Two-way merge of two lex-ascending streams: the running best (inserts
+    preserve order) and the tile minima (min-extraction; ``argmin`` takes
+    the first = lowest column among equal distances, which IS the lex
+    minimum because ids ascend with columns). Per slot t the lex-smaller
+    head wins; the (+inf, -1) empty-slot convention applies (module
+    docstring). Returns the merged (bd, bi) values — the Pallas callers
+    write them back to their output refs.
+    """
+    r, c = dist.shape
+    col_iota = jax.lax.broadcasted_iota(jnp.int32, (r, c), 1)
+    cur = dist
+    for t in range(k):
+        m = jnp.min(cur, axis=1)
+        a = jnp.argmin(cur, axis=1).astype(jnp.int32)
+        mi = base + a
+        cd = bd[:, t]
+        ci = bi[:, t]
+        take = lex_improves(m, mi, cd, ci)
+        cur = jnp.where((col_iota == a[:, None]) & take[:, None], jnp.inf, cur)
+        bd = shift_insert(bd, t, jnp.where(take, m, cd), take)
+        bi = shift_insert(bi, t, jnp.where(take, mi, ci), take)
+    return bd, bi
+
+
+def merge_tile_candidates(bd, bi, dist, ids, k: int):
+    """Merge a candidate tile with ARBITRARY (unsorted, possibly duplicated)
+    global ids into running (distance, id) k-best registers — the fused
+    forest-query merge (``ops/pallas_forest``).
+
+    Differences from :func:`merge_tile_contiguous`, both forced by ids not
+    ascending with columns:
+
+    * extraction is lex-correct: per pass the tile minimum distance is
+      found first, then the SMALLEST id among the columns achieving it —
+      ``argmin`` first-hit would resolve distance ties by position;
+    * duplicates collapse: a tile id already in the running registers is
+      dropped before the merge (its copies carry bitwise-equal distances —
+      same points, same op shapes — so dropping keeps the min copy), and
+      within the tile every copy of the extracted (d, id) pair is removed
+      at once while exactly one is inserted.
+
+    Empty slots carry (+inf, sentinel-or-ID_MAX); masked columns must
+    carry distance +inf with an id >= every real id so the prepass also
+    annihilates them against empty slots.
+    """
+    cur = dist
+    # Dedup prepass: drop tile columns whose id already occupies a running
+    # slot at a lex-no-worse distance (k broadcast passes, the same O(r*c*k)
+    # cost profile as the merge loop itself).
+    for t in range(k):
+        match = ids == bi[:, t, None]
+        cur = jnp.where(match & (bd[:, t, None] <= cur), jnp.inf, cur)
+    for t in range(k):
+        m = jnp.min(cur, axis=1)
+        mi = jnp.min(
+            jnp.where(cur == m[:, None], ids, ID_MAX), axis=1
+        ).astype(jnp.int32)
+        cd = bd[:, t]
+        ci = bi[:, t]
+        # Finite guard on top of the lex predicate: once a tile row is
+        # exhausted its removed/dropped columns sit at +inf with their REAL
+        # ids, and without the guard (inf, real_id) would lex-beat an empty
+        # (inf, sentinel) slot — the unfused dedup merge only ever emits
+        # (inf, sentinel) tails.
+        take = lex_improves(m, mi, cd, ci) & jnp.isfinite(m)
+        hit = (cur == m[:, None]) & (ids == mi[:, None]) & take[:, None]
+        cur = jnp.where(hit, jnp.inf, cur)
+        bd = shift_insert(bd, t, jnp.where(take, m, cd), take)
+        bi = shift_insert(bi, t, jnp.where(take, mi, ci), take)
+    return bd, bi
+
+
+def topk_tile_candidates(dist, ids, k: int):
+    """Lex k-best of one candidate tile alone (duplicate ids collapsed),
+    starting from empty registers — the kernel-side reduction of a rescan /
+    serving candidate panel. Returns ((r, k) d, (r, k) id) with (+inf,
+    ID_MAX) in unused slots; callers map ID_MAX back to their sentinel.
+
+    Reducing a tile to its k lex-best distinct ids before an XLA
+    :func:`dedup_lex_merge` against a k-wide running list is exact: any
+    candidate outside the tile's own k-best is lex-preceded by k distinct
+    tile ids whose merged entries can only improve, so it can never enter
+    the final k-best.
+    """
+    r = dist.shape[0]
+    bd = jnp.full((r, k), jnp.inf, dist.dtype)
+    bi = jnp.full((r, k), ID_MAX, jnp.int32)
+    cur = dist
+    for t in range(k):
+        m = jnp.min(cur, axis=1)
+        mi = jnp.min(
+            jnp.where(cur == m[:, None], ids, ID_MAX), axis=1
+        ).astype(jnp.int32)
+        take = lex_improves(m, mi, bd[:, t], bi[:, t]) & jnp.isfinite(m)
+        hit = (cur == m[:, None]) & (ids == mi[:, None]) & take[:, None]
+        cur = jnp.where(hit, jnp.inf, cur)
+        bd = shift_insert(bd, t, jnp.where(take, m, bd[:, t]), take)
+        bi = shift_insert(bi, t, jnp.where(take, mi, bi[:, t]), take)
+    return bd, bi
+
+
+def dedup_lex_merge(all_d, all_i, k: int, sentinel: int):
+    """k-best of per-row candidate lists under (distance, id) lex order,
+    with duplicate ids collapsed to their smallest-distance copy first —
+    without the dedup, the same neighbor reached through several trees
+    occupies several of the k slots and silently caps recall.
+
+    The XLA (lexsort) form of the contract, sentinel-id convention —
+    formerly ``ops/rpforest._dedup_lex_merge``.
+    """
+    order = jnp.lexsort((all_d, all_i), axis=-1)  # by id, then distance
+    si = jnp.take_along_axis(all_i, order, axis=-1)
+    sd = jnp.take_along_axis(all_d, order, axis=-1)
+    dup = jnp.concatenate(
+        [jnp.zeros_like(si[:, :1], bool), si[:, 1:] == si[:, :-1]], axis=-1
+    )
+    sd = jnp.where(dup, jnp.inf, sd)
+    si = jnp.where(dup, sentinel, si)
+    order = jnp.lexsort((si, sd), axis=-1)  # the established lex tie-break
+    return (
+        jnp.take_along_axis(sd, order, axis=-1)[:, :k],
+        jnp.take_along_axis(si, order, axis=-1)[:, :k],
+    )
+
+
+def merge_sorted_dedup(cur_d, cur_i, new_d, new_i, k: int):
+    """Rowwise dedup-merge of two (r, k) ascending neighbor lists on device.
+
+    Deduplicates by column id first: two jobs whose fixed-width windows
+    overlap legitimately scan the overlap columns twice, and a duplicated
+    neighbor would displace a real one from the k-list (measured on the old
+    host merge: it drove core distances BELOW the full-sweep truth).
+    Invalid slots carry id -1 / distance +inf; -1 duplicates are exempt
+    from the dedup mask (they are all inf anyway).
+
+    The negative-id-convention form of the contract — formerly
+    ``ops/blockscan._merge_knn_device``.
+    """
+    cat_d = jnp.concatenate([cur_d, new_d], axis=1)
+    cat_i = jnp.concatenate([cur_i, new_i], axis=1)
+    order = jnp.argsort(cat_i, axis=1, stable=True)
+    ci = jnp.take_along_axis(cat_i, order, axis=1)
+    cd = jnp.take_along_axis(cat_d, order, axis=1)
+    dup = (ci[:, 1:] == ci[:, :-1]) & (ci[:, 1:] >= 0)
+    cd = cd.at[:, 1:].set(jnp.where(dup, jnp.inf, cd[:, 1:]))
+    nb, sel = jax.lax.top_k(-cd, k)
+    return -nb, jnp.take_along_axis(ci, sel, axis=1)

@@ -1,0 +1,736 @@
+"""Fused forest-query Pallas program family (ROADMAP item 5).
+
+One program family takes a tile of query rows plus a visited-leaf
+candidate set and produces final k-best (distance, id) rows on-chip:
+
+* ``_leaf_topk_kernel`` — per-leaf dense scan: distance tile (bf16 MXU
+  tiles with f32 accumulation under ``precision="bf16"``, the exact
+  unfused forms at f32) + k-pass min/argmin extraction, so the (B, Lmax,
+  Lmax) distance block and the ``lax.top_k`` over it never reach HBM —
+  only the (B, Lmax, kk) result does.
+* ``_tree_merge_kernel`` — on-chip compare-exchange k-best merge ACROSS
+  trees under the repo-wide (distance, id) lex tie-break
+  (``ops/lexmerge.merge_tile_candidates``), replacing the XLA concat +
+  (n, T·kk) dedup-lexsort.
+* ``_rescan_topk_kernel`` — the rescan rounds' candidate-panel
+  reduction: the (m, k²) distance matrix is reduced to the tile's k
+  lex-best DISTINCT ids in VMEM, so only an (m, 2k) merge reaches the
+  XLA dedup (never the k² panel + (m, k+k²) lexsort). Exact: any
+  candidate outside the tile's own dedup'd k-best is lex-preceded by k
+  distinct tile ids whose merged entries can only improve.
+* ``_cand_minout_kernel`` — the second program entry: the same candidate
+  panel continued into the Borůvka per-component segment-min
+  (mutual-reachability max + component mask + per-row min) without
+  materializing the candidate weight matrix. Standalone + devicebench
+  staged: the exact Borůvka glue (``ops/tiled.boruvka_glue_edges``)
+  deliberately keeps its full scans — a candidate-restricted segment-min
+  would change exact-glue semantics.
+
+Pipeline idiom: every kernel runs under a ``pallas_call`` grid whose
+block fetches Pallas auto-pipelines — leaf tile t+1 streams HBM→VMEM
+while tile t computes (the double-buffered idiom; same machinery as
+``ops/pallas_knn``'s revisited-output kernels).
+
+Bitwise-parity contract (f32): the leaf kernel replicates the unfused
+``rpforest._leaf_scan`` chain exactly — the SAME euclidean form the real
+(Lmax, Lmax, d) shape selects (``euclid_form``; feature padding is
+sliced off in-kernel so reduction shapes match), extraction in
+``lax.top_k`` order (ascending distance, position-preference on ties),
+the same ``isinf → sentinel`` fixup, and the same XLA lexsort epilogue —
+so ``knn_backend="fused"`` at ``knn_precision="f32"`` is bitwise
+identical to the unfused rpforest path (pinned by the randomized parity
+sweep in ``tests/unit/test_pallas_forest.py``). ``precision="bf16"``
+computes distance tiles from bf16 operands with f32 accumulation
+(euclidean only) and relies on ``refine_f32`` — an exact f32 re-distance
+of the surviving k-best — to restore ranking quality (recall/ARI gate in
+the same test file).
+
+Acceptance honesty: on this CPU container every Pallas path runs in
+``interpret=True`` mode (recorded as such, as in BENCH_r06/r07); the
+real-TPU legs are staged in ``benchmarks/devicebench.py``.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from hdbscan_tpu.core.distances import (
+    _DIFF_FORM_BUDGET,
+    _cross_f32,
+    pairwise_distance,
+)
+from hdbscan_tpu.ops import lexmerge
+
+LANES = 128  # TPU lane count: feature/k/leaf axes pad to this
+SUBLANES = 8
+#: Row tile of the cross-tree merge kernel (revisited output blocks).
+MERGE_ROW_TILE = 256
+#: Row tile of the rescan / segment-min kernels — the (rt, k², d) panel
+#: block stays well under VMEM at k <= 128.
+RESCAN_ROW_TILE = 8
+
+#: Metrics the fused family supports. ``pearson`` is excluded: it centers
+#: by the feature-axis mean, which zero-padding to the lane boundary would
+#: silently change.
+FUSED_METRICS = ("euclidean", "manhattan", "supremum", "cosine")
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def euclid_form(r: int, c: int, d: int) -> str:
+    """The euclidean form the unfused scan selects at the REAL shape.
+
+    Mirrors ``core/distances._sq_euclidean``'s shape test; the kernels
+    force this form regardless of lane padding so f32 results stay
+    bitwise identical to the unfused path.
+    """
+    return "diff" if r * c * d <= _DIFF_FORM_BUDGET else "dot"
+
+
+def dist_tile(xr, xc, metric: str, *, d_real: int, form: str, precision: str):
+    """(r, c) distance tile of two feature-padded row sets.
+
+    f32: slices operands back to ``d_real`` features and replays the
+    unfused ops exactly (forced ``form`` for euclidean; the other metrics
+    are shape-independent elementwise/rowwise reductions). bf16: MXU
+    cross term from bf16 operands with f32 accumulation, norms in f32
+    from the unquantized operands — euclidean only, selection-grade.
+    Runs unchanged inside Pallas kernel bodies, under ``shard_map``, and
+    in plain jit (the per-shard sweep reuse).
+    """
+    if precision == "bf16":
+        if metric != "euclidean":
+            raise ValueError("bf16 distance tiles support euclidean only")
+        # Center on the row-tile mean before quantizing: euclidean
+        # distances are translation-invariant, and bf16's absolute dot
+        # error scales with the operand norms — centering removes the
+        # dataset offset from both (measured ~3x tighter on offset data).
+        # Padded feature columns are all-zero, so the mean keeps them 0.
+        mu = jnp.mean(xr, axis=0)
+        xr = xr - mu
+        xc = xc - mu
+        cross = jax.lax.dot_general(
+            xr.astype(jnp.bfloat16),
+            xc.astype(jnp.bfloat16),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        nr = jnp.sum(xr * xr, axis=-1)
+        nc = jnp.sum(xc * xc, axis=-1)
+        return jnp.sqrt(jnp.maximum(nr[:, None] + nc[None, :] - 2.0 * cross, 0.0))
+    xs = xr[:, :d_real]
+    ys = xc[:, :d_real]
+    if metric == "euclidean":
+        if form == "diff":
+            diff = xs[:, None, :] - ys[None, :, :]
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+        return jnp.sqrt(
+            jnp.maximum(
+                jnp.sum(xs * xs, axis=-1)[:, None]
+                + jnp.sum(ys * ys, axis=-1)[None, :]
+                - 2.0 * _cross_f32(xs, ys),
+                0.0,
+            )
+        )
+    return pairwise_distance(xs, ys, metric)
+
+
+def rows_dist(q, cpts, metric: str, *, d_real: int, precision: str):
+    """(r, C) distances of each query row to ITS candidate panel row.
+
+    f32 replays the unfused rescan line (``vmap`` of a (1, d) × (C, d)
+    ``pairwise_distance``) on ``d_real``-sliced operands — bitwise equal
+    per row. bf16: batched bf16 dot with f32 accumulation + f32 norms.
+    """
+    if precision == "bf16":
+        if metric != "euclidean":
+            raise ValueError("bf16 distance tiles support euclidean only")
+        # Same tile-mean centering as ``dist_tile`` (translation
+        # invariance): shrinks the operands bf16 actually quantizes.
+        mu = jnp.mean(q, axis=0)
+        q = q - mu
+        cpts = cpts - mu
+        cross = jax.lax.dot_general(
+            q.astype(jnp.bfloat16),
+            cpts.astype(jnp.bfloat16),
+            (((1,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        nr = jnp.sum(q * q, axis=-1)
+        nc = jnp.sum(cpts * cpts, axis=-1)
+        return jnp.sqrt(jnp.maximum(nr[:, None] + nc - 2.0 * cross, 0.0))
+    qs = q[:, :d_real]
+    cs = cpts[:, :, :d_real]
+    return jax.vmap(
+        lambda qq, cc: pairwise_distance(qq[None, :], cc, metric)[0]
+    )(qs, cs)
+
+
+# ---------------------------------------------------------------------------
+# Shared kernel bodies (plain jnp on values): the Pallas kernels call these
+# on their VMEM blocks, the sharded panel sweep and the devicebench
+# fused-body legs call them on ordinary arrays — the SAME body per shard.
+
+
+def leaf_topk_values(
+    pts, ids, colmask, kk: int, *, d_real: int, metric: str, form: str,
+    precision: str, sentinel: int,
+):
+    """One leaf block -> ((Lp, kk) d, (Lp, kk) id) in lax.top_k order.
+
+    Extraction replicates the unfused chain element-for-element: k passes
+    of min + FIRST-position argmin reproduce ``lax.top_k``'s ascending
+    (distance, position) sequence (top_k prefers lower indices on ties),
+    ids gather through the leaf's member map, +inf rows map to
+    ``sentinel`` — callers then apply the same (id, distance) lexsort
+    epilogue as ``rpforest._leaf_scan``.
+    """
+    dist = dist_tile(
+        pts, pts, metric, d_real=d_real, form=form, precision=precision
+    )
+    dist = jnp.where(colmask[None, :] != 0, dist, jnp.inf)
+    r, c = dist.shape
+    col_iota = jax.lax.broadcasted_iota(jnp.int32, (r, c), 1)
+    cur = dist
+    dcols, icols = [], []
+    for _ in range(kk):
+        m = jnp.min(cur, axis=1)
+        a = jnp.argmin(cur, axis=1).astype(jnp.int32)
+        gid = jnp.where(jnp.isinf(m), sentinel, jnp.take(ids, a))
+        cur = jnp.where(col_iota == a[:, None], jnp.inf, cur)
+        dcols.append(m)
+        icols.append(gid)
+    return jnp.stack(dcols, axis=1), jnp.stack(icols, axis=1)
+
+
+def rescan_topk_values(
+    q, cpts, cids, k: int, *, d_real: int, metric: str, precision: str,
+    sentinel: int,
+):
+    """Candidate-panel reduction: ((r, k) d, (r, k) id), lex k-best
+    distinct, +inf slots at ``lexmerge.ID_MAX`` (callers map to sentinel)."""
+    dist = rows_dist(q, cpts, metric, d_real=d_real, precision=precision)
+    dist = jnp.where(cids == sentinel, jnp.inf, dist)
+    return lexmerge.topk_tile_candidates(dist, cids, k)
+
+
+def cand_minout_values(
+    q, cpts, cids, core_q, core_c, comp_q, comp_c, *, d_real: int,
+    metric: str, precision: str, sentinel: int,
+):
+    """Candidate-panel Borůvka reduction: per row the min mutual-reach
+    edge to a candidate in ANOTHER component — ((r,) w, (r,) global id),
+    (+inf, -1) where no outgoing candidate exists. First minimal panel
+    column wins ties (argmin first-hit), matching the XLA reference."""
+    dist = rows_dist(q, cpts, metric, d_real=d_real, precision=precision)
+    w = jnp.maximum(dist, jnp.maximum(core_q[:, None], core_c))
+    out = (comp_q[:, None] != comp_c) & (cids != sentinel)
+    w = jnp.where(out, w, jnp.inf)
+    bw = jnp.min(w, axis=1)
+    a = jnp.argmin(w, axis=1)
+    bj = jnp.take_along_axis(cids, a[:, None], axis=1)[:, 0]
+    return bw, jnp.where(jnp.isinf(bw), -1, bj)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernels + launch wrappers.
+
+
+def _leaf_topk_kernel(
+    pts_ref, ids_ref, cm_ref, outd_ref, outi_ref, *, kk: int, d_real: int,
+    metric: str, form: str, precision: str, sentinel: int,
+):
+    nd, ni = leaf_topk_values(
+        pts_ref[0], ids_ref[0], cm_ref[0], kk, d_real=d_real, metric=metric,
+        form=form, precision=precision, sentinel=sentinel,
+    )
+    r, kp = outd_ref.shape[1], outd_ref.shape[2]
+    if kp > kk:
+        nd = jnp.concatenate(
+            [nd, jnp.full((r, kp - kk), jnp.inf, nd.dtype)], axis=1
+        )
+        ni = jnp.concatenate(
+            [ni, jnp.full((r, kp - kk), sentinel, jnp.int32)], axis=1
+        )
+    outd_ref[0] = nd
+    outi_ref[0] = ni
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "kk", "metric", "form", "precision", "sentinel", "interpret",
+    ),
+)
+def forest_leaf_topk(
+    data, members, mask, kk: int, metric: str = "euclidean",
+    form: str = "diff", precision: str = "f32", sentinel: int = 0,
+    interpret: bool = False,
+):
+    """Fused leaf scan over a leaf batch: gather + pad, one grid step per
+    leaf (Pallas prefetches leaf t+1's block while t computes), slice +
+    the unfused lexsort epilogue. Returns (B, Lmax, kk) ascending (d, id)
+    — bitwise equal to ``rpforest._leaf_scan`` at f32.
+    """
+    bsz, lmax = members.shape
+    d = data.shape[1]
+    pts = data[members]  # (B, Lmax, d) leaf gather
+    lp = _ceil_to(max(lmax, SUBLANES), LANES)
+    dp = LANES
+    pts = jnp.pad(pts, ((0, 0), (0, lp - lmax), (0, dp - d)))
+    ids = jnp.pad(
+        members.astype(jnp.int32), ((0, 0), (0, lp - lmax)),
+        constant_values=sentinel,
+    )
+    cmask = jnp.pad(mask.astype(jnp.int32), ((0, 0), (0, lp - lmax)))
+    kp = _ceil_to(kk, LANES)
+    outd, outi = pl.pallas_call(
+        partial(
+            _leaf_topk_kernel, kk=kk, d_real=d, metric=metric, form=form,
+            precision=precision, sentinel=sentinel,
+        ),
+        grid=(bsz,),
+        in_specs=[
+            pl.BlockSpec((1, lp, dp), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, lp), lambda b: (b, 0)),
+            pl.BlockSpec((1, lp), lambda b: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, lp, kp), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, lp, kp), lambda b: (b, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bsz, lp, kp), data.dtype),
+            jax.ShapeDtypeStruct((bsz, lp, kp), jnp.int32),
+        ],
+        interpret=interpret,
+    )(pts, ids, cmask)
+    nd = outd[:, :lmax, :kk]
+    ni = outi[:, :lmax, :kk]
+    order = jnp.lexsort((ni, nd), axis=-1)
+    return (
+        jnp.take_along_axis(nd, order, axis=-1),
+        jnp.take_along_axis(ni, order, axis=-1),
+    )
+
+
+def _tree_merge_kernel(d_ref, i_ref, outd_ref, outi_ref, *, kk: int, sentinel: int):
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _():
+        outd_ref[...] = jnp.full_like(outd_ref, jnp.inf)
+        outi_ref[...] = jnp.full_like(outi_ref, sentinel)
+
+    bd, bi = lexmerge.merge_tile_candidates(
+        outd_ref[...], outi_ref[...], d_ref[0], i_ref[0], kk
+    )
+    outd_ref[...] = bd
+    outi_ref[...] = bi
+
+
+@partial(
+    jax.jit,
+    static_argnames=("kk", "sentinel", "row_tile", "interpret"),
+)
+def forest_merge_pallas(
+    stack_d, stack_i, kk: int, sentinel: int,
+    row_tile: int = MERGE_ROW_TILE, interpret: bool = False,
+):
+    """On-chip cross-tree k-best merge: (T, n, kk) per-tree lists ->
+    (n, kk) merged under the lex tie-break, revisited output blocks, one
+    tree tile per grid step. Equals ``lexmerge.dedup_lex_merge`` of the
+    concatenated lists because same-id copies across trees carry bitwise-
+    equal distances (same gathered points, same op shapes — pinned by the
+    parity sweep)."""
+    trees, n, _ = stack_d.shape
+    npd = _ceil_to(n, row_tile)
+    kp = _ceil_to(kk, LANES)
+    stack_d = jnp.pad(
+        stack_d, ((0, 0), (0, npd - n), (0, kp - kk)),
+        constant_values=jnp.inf,
+    )
+    stack_i = jnp.pad(
+        stack_i, ((0, 0), (0, npd - n), (0, kp - kk)),
+        constant_values=sentinel,
+    )
+    outd, outi = pl.pallas_call(
+        partial(_tree_merge_kernel, kk=kk, sentinel=sentinel),
+        grid=(npd // row_tile, trees),
+        in_specs=[
+            pl.BlockSpec((1, row_tile, kp), lambda i, t: (t, i, 0)),
+            pl.BlockSpec((1, row_tile, kp), lambda i, t: (t, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((row_tile, kp), lambda i, t: (i, 0)),
+            pl.BlockSpec((row_tile, kp), lambda i, t: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((npd, kp), stack_d.dtype),
+            jax.ShapeDtypeStruct((npd, kp), jnp.int32),
+        ],
+        interpret=interpret,
+    )(stack_d, stack_i)
+    return outd[:n, :kk], outi[:n, :kk]
+
+
+def _rescan_topk_kernel(
+    q_ref, cpts_ref, cids_ref, outd_ref, outi_ref, *, k: int, d_real: int,
+    metric: str, precision: str, sentinel: int,
+):
+    bd, bi = rescan_topk_values(
+        q_ref[...], cpts_ref[...], cids_ref[...], k, d_real=d_real,
+        metric=metric, precision=precision, sentinel=sentinel,
+    )
+    r, kp = outd_ref.shape
+    if kp > k:
+        bd = jnp.concatenate(
+            [bd, jnp.full((r, kp - k), jnp.inf, bd.dtype)], axis=1
+        )
+        bi = jnp.concatenate(
+            [bi, jnp.full((r, kp - k), lexmerge.ID_MAX, jnp.int32)], axis=1
+        )
+    outd_ref[...] = bd
+    outi_ref[...] = bi
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "k", "metric", "precision", "sentinel", "row_tile", "interpret",
+    ),
+)
+def forest_rescan_topk(
+    q, cpts, cids, k: int, metric: str = "euclidean",
+    precision: str = "f32", sentinel: int = 0,
+    row_tile: int = RESCAN_ROW_TILE, interpret: bool = False,
+):
+    """Rescan candidate-panel reduction: (m, C, d) panel -> (m, k) lex
+    k-best distinct (d, id) — the k² candidate distance matrix never
+    leaves VMEM. Callers dedup-merge the result against the running
+    k-best in XLA (an (m, 2k) merge instead of (m, k + k²))."""
+    m, c, d = cpts.shape
+    mp = _ceil_to(max(m, row_tile), row_tile)
+    cp = _ceil_to(c, LANES)
+    dp = LANES
+    q = jnp.pad(q, ((0, mp - m), (0, dp - d)))
+    cpts = jnp.pad(cpts, ((0, mp - m), (0, cp - c), (0, dp - d)))
+    cids = jnp.pad(
+        cids.astype(jnp.int32), ((0, mp - m), (0, cp - c)),
+        constant_values=sentinel,
+    )
+    kp = _ceil_to(k, LANES)
+    outd, outi = pl.pallas_call(
+        partial(
+            _rescan_topk_kernel, k=k, d_real=d, metric=metric,
+            precision=precision, sentinel=sentinel,
+        ),
+        grid=(mp // row_tile,),
+        in_specs=[
+            pl.BlockSpec((row_tile, dp), lambda i: (i, 0)),
+            pl.BlockSpec((row_tile, cp, dp), lambda i: (i, 0, 0)),
+            pl.BlockSpec((row_tile, cp), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((row_tile, kp), lambda i: (i, 0)),
+            pl.BlockSpec((row_tile, kp), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, kp), q.dtype),
+            jax.ShapeDtypeStruct((mp, kp), jnp.int32),
+        ],
+        interpret=interpret,
+    )(q, cpts, cids)
+    nd = outd[:m, :k]
+    ni = jnp.where(jnp.isinf(nd), sentinel, outi[:m, :k])
+    return nd, ni
+
+
+def _cand_minout_kernel(
+    q_ref, cpts_ref, cids_ref, coreq_ref, corec_ref, compq_ref, compc_ref,
+    bw_ref, bj_ref, *, d_real: int, metric: str, precision: str, sentinel: int,
+):
+    bw, bj = cand_minout_values(
+        q_ref[...], cpts_ref[...], cids_ref[...], coreq_ref[0],
+        corec_ref[...], compq_ref[0], compc_ref[...], d_real=d_real,
+        metric=metric, precision=precision, sentinel=sentinel,
+    )
+    bw_ref[0] = bw
+    bj_ref[0] = bj
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "metric", "precision", "sentinel", "row_tile", "interpret",
+    ),
+)
+def forest_min_outgoing(
+    q, cpts, cids, core_q, core_c, comp_q, comp_c,
+    metric: str = "euclidean", precision: str = "f32", sentinel: int = 0,
+    row_tile: int = RESCAN_ROW_TILE, interpret: bool = False,
+):
+    """Second program entry: forest candidate panel -> per-row min
+    outgoing mutual-reachability edge ((m,) w, (m,) global id; (+inf, -1)
+    when none) without materializing the candidate weight matrix in HBM.
+    Standalone (devicebench staged legs + interpret parity tests); the
+    exact Borůvka glue keeps its full scans by design."""
+    m, c, d = cpts.shape
+    mp = _ceil_to(max(m, row_tile), row_tile)
+    cp = _ceil_to(c, LANES)
+    dp = LANES
+    q = jnp.pad(q, ((0, mp - m), (0, dp - d)))
+    cpts = jnp.pad(cpts, ((0, mp - m), (0, cp - c), (0, dp - d)))
+    cids = jnp.pad(
+        cids.astype(jnp.int32), ((0, mp - m), (0, cp - c)),
+        constant_values=sentinel,
+    )
+    core_q2 = jnp.pad(core_q.astype(q.dtype), (0, mp - m)).reshape(1, mp)
+    core_c2 = jnp.pad(core_c.astype(q.dtype), ((0, mp - m), (0, cp - c)))
+    comp_q2 = jnp.pad(comp_q.astype(jnp.int32), (0, mp - m)).reshape(1, mp)
+    comp_c2 = jnp.pad(
+        comp_c.astype(jnp.int32), ((0, mp - m), (0, cp - c)),
+        constant_values=-1,
+    )
+    bw, bj = pl.pallas_call(
+        partial(
+            _cand_minout_kernel, d_real=d, metric=metric,
+            precision=precision, sentinel=sentinel,
+        ),
+        grid=(mp // row_tile,),
+        in_specs=[
+            pl.BlockSpec((row_tile, dp), lambda i: (i, 0)),
+            pl.BlockSpec((row_tile, cp, dp), lambda i: (i, 0, 0)),
+            pl.BlockSpec((row_tile, cp), lambda i: (i, 0)),
+            pl.BlockSpec((1, row_tile), lambda i: (0, i)),
+            pl.BlockSpec((row_tile, cp), lambda i: (i, 0)),
+            pl.BlockSpec((1, row_tile), lambda i: (0, i)),
+            pl.BlockSpec((row_tile, cp), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, row_tile), lambda i: (0, i)),
+            pl.BlockSpec((1, row_tile), lambda i: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, mp), q.dtype),
+            jax.ShapeDtypeStruct((1, mp), jnp.int32),
+        ],
+        interpret=interpret,
+    )(q, cpts, cids, core_q2, core_c2, comp_q2, comp_c2)
+    return bw[0, :m], bj[0, :m]
+
+
+@partial(jax.jit, static_argnames=("metric", "precision", "sentinel"))
+def forest_min_outgoing_xla(
+    q, cpts, cids, core_q, core_c, comp_q, comp_c,
+    metric: str = "euclidean", precision: str = "f32", sentinel: int = 0,
+):
+    """Test oracle: the same candidate segment-min as one XLA reduction."""
+    return cand_minout_values(
+        q, cpts, cids.astype(jnp.int32), core_q.astype(q.dtype),
+        core_c.astype(q.dtype), comp_q.astype(jnp.int32),
+        comp_c.astype(jnp.int32), d_real=q.shape[1], metric=metric,
+        precision=precision, sentinel=sentinel,
+    )
+
+
+# ---------------------------------------------------------------------------
+# bf16 refine + eligibility + orchestrators.
+
+
+@partial(jax.jit, static_argnames=("metric", "sentinel"))
+def refine_f32(data, best_d, best_i, metric: str, sentinel: int):
+    """Exact f32 re-distance of the surviving k-best (the bf16 regime's
+    second half): gather the k neighbors' coordinates, recompute with the
+    exact rowwise f32 ops, re-lexsort by (distance, id)."""
+    nb = jnp.clip(best_i, 0, sentinel - 1)
+    pts = data[nb]  # (rows, k, d)
+    q = data[: best_i.shape[0]]
+    dist = jax.vmap(
+        lambda qq, cc: pairwise_distance(qq[None, :], cc, metric)[0]
+    )(q, pts)
+    dist = jnp.where(best_i == sentinel, jnp.inf, dist).astype(best_d.dtype)
+    order = jnp.lexsort((best_i, dist), axis=-1)
+    return (
+        jnp.take_along_axis(dist, order, axis=-1),
+        jnp.take_along_axis(best_i, order, axis=-1),
+    )
+
+
+def fused_forest_eligible(
+    n: int, d: int, k: int, metric: str, dtype, mesh=None
+) -> bool:
+    """Static eligibility of the fused forest program.
+
+    Same policy shape as ``ops/tiled``'s fused kernel gate: supported
+    metric (no pearson — lane padding would change its feature mean),
+    lane-bounded k and d, f32 operands (x64 parity runs stay unfused),
+    single device (the sharded sweep reuses the kernel BODY per shard
+    instead), and real TPU or a small-n interpret run on CPU.
+    """
+    try:
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except RuntimeError:
+        on_tpu = False
+    return (
+        mesh is None
+        and metric in FUSED_METRICS
+        and k <= LANES
+        and d <= LANES
+        and np.dtype(dtype) == np.float32
+        and (on_tpu or n <= (1 << 14))
+    )
+
+
+def forest_knn_fused(
+    data_dev,
+    forest,
+    k: int,
+    metric: str = "euclidean",
+    precision: str = "f32",
+    trace=None,
+    recall_sample: int = 256,
+    interpret: bool = False,
+):
+    """Fused twin of ``rpforest.forest_knn`` (single device).
+
+    Per tree: the fused leaf kernel over the same leaf batches; then the
+    on-chip cross-tree merge. Emits the same ``knn_index_query`` event as
+    the unfused path (sampled recall included), so trace consumers are
+    agnostic to the backend; ``rpforest_core_distances`` adds the
+    ``knn_fused_forest`` event on top. Returns the same (n, kk) lists,
+    bitwise equal at f32.
+    """
+    from hdbscan_tpu.ops import rpforest as _rpf
+
+    t0 = time.monotonic()
+    n, lmax = forest.n, forest.max_leaf
+    num_leaves = forest.num_leaves
+    kk = min(k, lmax)
+    sentinel = n
+    form = euclid_form(lmax, lmax, forest.d)
+    batch = max(1, _rpf._LEAF_ELEM_BUDGET // (lmax * lmax))
+    per_tree_d, per_tree_i = [], []
+    for t in range(forest.trees):
+        out_d = jnp.full((n, kk), jnp.inf, data_dev.dtype)
+        out_i = jnp.full((n, kk), sentinel, jnp.int32)
+        for a in range(0, num_leaves, batch):
+            b = min(a + batch, num_leaves)
+            members = jnp.asarray(forest.members[t, a:b])
+            mask = jnp.asarray(forest.leaf_mask[a:b])
+            nd, ni = forest_leaf_topk(
+                data_dev, members, mask, kk, metric=metric, form=form,
+                precision=precision, sentinel=sentinel, interpret=interpret,
+            )
+            flat = forest.members[t, a:b].reshape(-1)
+            out_d = out_d.at[flat].set(nd.reshape(-1, kk))
+            out_i = out_i.at[flat].set(ni.reshape(-1, kk))
+        per_tree_d.append(out_d)
+        per_tree_i.append(out_i)
+    from hdbscan_tpu.utils.flops import counter as _flops
+
+    _flops.add_scan(forest.trees * num_leaves * lmax, lmax, forest.d)
+    best_d, best_i = forest_merge_pallas(
+        jnp.stack(per_tree_d), jnp.stack(per_tree_i), kk, sentinel,
+        interpret=interpret,
+    )
+    best_d.block_until_ready()
+    if trace is not None:
+        fields = dict(
+            n=n, k=kk, trees=forest.trees, candidates=forest.trees * kk
+        )
+        if recall_sample:
+            recall, rows = _rpf._sampled_recall(
+                data_dev[:n], best_i, kk, metric, recall_sample
+            )
+            fields["recall_at_k"] = recall
+            fields["recall_rows"] = rows
+        trace("knn_index_query", wall_s=time.monotonic() - t0, **fields)
+    return best_d, best_i
+
+
+@partial(
+    jax.jit,
+    static_argnames=("m", "k", "metric", "precision", "sentinel", "interpret"),
+)
+def _rescan_chunk_fused(
+    data, best_d, best_i, start, m, k, metric, precision, sentinel, interpret
+):
+    """Fused twin of ``rpforest._rescan_chunk``: same candidate expansion,
+    but the (m, k²) panel reduces on-chip to (m, k) before the XLA
+    dedup-merge against the running lists."""
+    bd = jax.lax.dynamic_slice_in_dim(best_d, start, m)
+    bi = jax.lax.dynamic_slice_in_dim(best_i, start, m)
+    q = jax.lax.dynamic_slice_in_dim(data, start, m)
+    nb = jnp.clip(bi, 0, sentinel - 1)
+    cand = best_i[nb].reshape(m, k * k)
+    cand = jnp.where(jnp.repeat(bi == sentinel, k, axis=-1), sentinel, cand)
+    cpts = data[jnp.clip(cand, 0, sentinel - 1)]
+    td, ti = forest_rescan_topk(
+        q, cpts, cand, k, metric=metric, precision=precision,
+        sentinel=sentinel, interpret=interpret,
+    )
+    all_d = jnp.concatenate([bd, td.astype(bd.dtype)], axis=1)
+    all_i = jnp.concatenate([bi, ti], axis=1)
+    nd, ni = lexmerge.dedup_lex_merge(all_d, all_i, k, sentinel)
+    improved = jnp.sum(nd[:, k - 1] < bd[:, k - 1])
+    return nd, ni, improved
+
+
+def rescan_round_fused(
+    data_dev,
+    best_d,
+    best_i,
+    k: int,
+    metric: str,
+    rnd: int,
+    rescan_rounds: int,
+    sentinel: int | None = None,
+    precision: str = "f32",
+    trace=None,
+    interpret: bool = False,
+):
+    """Fused twin of ``rpforest.rescan_round`` — same chunking, same
+    ``knn_index_rescan`` event, candidate matrices stay in VMEM."""
+    t0 = time.monotonic()
+    n_rows = best_d.shape[0]
+    d = data_dev.shape[1]
+    sentinel = data_dev.shape[0] if sentinel is None else sentinel
+    from hdbscan_tpu.ops.rpforest import _RESCAN_ELEM_BUDGET
+
+    chunk = max(64, _RESCAN_ELEM_BUDGET // max(1, k * k * d))
+    chunk = min(n_rows, chunk)
+    parts_d, parts_i, improved = [], [], 0
+    a = 0
+    while a < n_rows:
+        m = chunk if a + chunk <= n_rows else n_rows - a
+        nd, ni, imp = _rescan_chunk_fused(
+            data_dev, best_d, best_i, a, m, k, metric, precision, sentinel,
+            interpret,
+        )
+        parts_d.append(nd)
+        parts_i.append(ni)
+        improved += int(imp)
+        a += m
+    best_d = jnp.concatenate(parts_d)
+    best_i = jnp.concatenate(parts_i)
+    best_d.block_until_ready()
+    if trace is not None:
+        trace(
+            "knn_index_rescan",
+            wall_s=time.monotonic() - t0,
+            round=rnd,
+            rescan_rounds=rescan_rounds,
+            improved=improved,
+            n=sentinel,
+            k=k,
+        )
+    return best_d, best_i

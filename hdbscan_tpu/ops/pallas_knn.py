@@ -114,11 +114,11 @@ def morton_order(data: np.ndarray, max_dims: int = 21) -> np.ndarray:
 
 
 def _shift_insert(best, t: int, new_t, take):
-    """Merged slot t gets ``new_t``; where the tile won, old slots shift right."""
-    slot_iota = jax.lax.broadcasted_iota(jnp.int32, best.shape, 1)
-    shifted = jnp.concatenate([best[:, :1], best[:, :-1]], axis=1)
-    out = jnp.where((slot_iota > t) & take[:, None], shifted, best)
-    return jnp.where(slot_iota == t, new_t[:, None], out)
+    """Merged slot t gets ``new_t``; where the tile won, old slots shift
+    right. Shared contract home: ``ops/lexmerge.shift_insert``."""
+    from hdbscan_tpu.ops.lexmerge import shift_insert
+
+    return shift_insert(best, t, new_t, take)
 
 
 def _knn_kernel(
@@ -393,22 +393,13 @@ def _fused_merge_tile(outd_ref, outi_ref, dist, base, k: int):
     Empty slots carry (+inf, -1): a real inf column (masked padding) never
     displaces one because its id >= 0 loses the lex tie to -1... the other
     way around: (inf, id>=0) vs (inf, -1) keeps -1, since id < -1 is false.
+
+    The merge itself is the shared contiguous-id merge of the repo-wide
+    tie-break contract — ``ops/lexmerge.merge_tile_contiguous``.
     """
-    r, c = dist.shape
-    col_iota = jax.lax.broadcasted_iota(jnp.int32, (r, c), 1)
-    bd = outd_ref[:]
-    bi = outi_ref[:]
-    cur = dist
-    for t in range(k):
-        m = jnp.min(cur, axis=1)
-        a = jnp.argmin(cur, axis=1).astype(jnp.int32)
-        mi = base + a
-        cd = bd[:, t]
-        ci = bi[:, t]
-        take = (m < cd) | ((m == cd) & (mi < ci))
-        cur = jnp.where((col_iota == a[:, None]) & take[:, None], jnp.inf, cur)
-        bd = _shift_insert(bd, t, jnp.where(take, m, cd), take)
-        bi = _shift_insert(bi, t, jnp.where(take, mi, ci), take)
+    from hdbscan_tpu.ops.lexmerge import merge_tile_contiguous
+
+    bd, bi = merge_tile_contiguous(outd_ref[:], outi_ref[:], dist, base, k)
     outd_ref[:] = bd
     outi_ref[:] = bi
 

@@ -51,6 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from hdbscan_tpu.core.distances import METRICS, pairwise_distance
+from hdbscan_tpu.ops.lexmerge import dedup_lex_merge as _shared_dedup_lex_merge
 
 #: The ``knn_index`` config vocabulary (``HDBSCANParams.knn_index``).
 KNN_INDEXES = ("auto", "exact", "rpforest")
@@ -342,23 +343,11 @@ def _leaf_scan(data, members, mask, kk, metric, sentinel):
 
 
 def _dedup_lex_merge(all_d, all_i, k: int, sentinel: int):
-    """k-best of per-row candidate lists under (distance, id) lex order,
-    with duplicate ids collapsed to their smallest-distance copy first —
-    without the dedup, the same neighbor reached through several trees
-    occupies several of the k slots and silently caps recall."""
-    order = jnp.lexsort((all_d, all_i), axis=-1)  # by id, then distance
-    si = jnp.take_along_axis(all_i, order, axis=-1)
-    sd = jnp.take_along_axis(all_d, order, axis=-1)
-    dup = jnp.concatenate(
-        [jnp.zeros_like(si[:, :1], bool), si[:, 1:] == si[:, :-1]], axis=-1
-    )
-    sd = jnp.where(dup, jnp.inf, sd)
-    si = jnp.where(dup, sentinel, si)
-    order = jnp.lexsort((si, sd), axis=-1)  # the established lex tie-break
-    return (
-        jnp.take_along_axis(sd, order, axis=-1)[:, :k],
-        jnp.take_along_axis(si, order, axis=-1)[:, :k],
-    )
+    """k-best of per-row candidate lists under (distance, id) lex order —
+    the shared contract now lives in ``ops/lexmerge.dedup_lex_merge``;
+    this alias keeps the established import site for ``parallel/shard``
+    and ``serve/predict``."""
+    return _shared_dedup_lex_merge(all_d, all_i, k, sentinel)
 
 
 _dedup_lex_merge_jit = jax.jit(_dedup_lex_merge, static_argnames=("k", "sentinel"))
@@ -386,6 +375,9 @@ def forest_knn(
     trace=None,
     recall_sample: int = 256,
     mesh=None,
+    backend: str = "xla",
+    precision: str = "f32",
+    interpret: bool = False,
 ):
     """Approximate neighbor lists from the built forest.
 
@@ -402,11 +394,31 @@ def forest_knn(
     the forest) and the merged per-point lists live row-sharded; results
     are bitwise identical to the single-device path (all ops are per-row).
 
+    ``backend="fused"`` (single device only) routes through the fused
+    Pallas program family (``ops/pallas_forest.forest_knn_fused``): the
+    leaf scans' distance tiles + top-k extraction and the cross-tree
+    merge run on-chip — bitwise identical at ``precision="f32"``;
+    ``precision="bf16"`` computes the tiles from bf16 MXU operands with
+    f32 accumulation (callers refine the survivors via
+    ``pallas_forest.refine_f32``).
+
     Returns ``(best_d, best_i)`` padded to a device-divisible row count —
     callers slice ``[:n]`` after the rescan rounds.
     """
     if metric not in METRICS:
         raise ValueError(f"unknown metric {metric!r}; expected one of {METRICS}")
+    if backend == "fused":
+        if mesh is not None and _mesh_parts(mesh)[0] > 1:
+            raise ValueError(
+                "backend='fused' is single-device; the sharded sweep reuses "
+                "the kernel body per shard instead (parallel/shard)"
+            )
+        from hdbscan_tpu.ops.pallas_forest import forest_knn_fused
+
+        return forest_knn_fused(
+            data_dev, forest, k, metric, precision=precision, trace=trace,
+            recall_sample=recall_sample, interpret=interpret,
+        )
     t0 = time.monotonic()
     n, lmax = forest.n, forest.max_leaf
     num_leaves = forest.num_leaves
@@ -502,6 +514,9 @@ def rescan_round(
     rescan_rounds: int,
     sentinel: int | None = None,
     trace=None,
+    backend: str = "xla",
+    precision: str = "f32",
+    interpret: bool = False,
 ):
     """One neighbor-of-neighbor expansion round over all rows (chunked).
 
@@ -510,7 +525,20 @@ def rescan_round(
     pass through untouched. The only cross-row data movement is the
     per-chunk gathered candidate-coordinate panel (``cpts``), O(rows · k²
     · d) — never a full column panel.
+
+    ``backend="fused"`` reduces each chunk's (rows, k²) candidate
+    distance matrix to its k lex-best distinct ids in VMEM
+    (``pallas_forest.rescan_round_fused``) — the candidate matrix and
+    the (rows, k + k²) lexsort never reach HBM; bitwise identical at f32.
     """
+    if backend == "fused":
+        from hdbscan_tpu.ops.pallas_forest import rescan_round_fused
+
+        return rescan_round_fused(
+            data_dev, best_d, best_i, k, metric, rnd, rescan_rounds,
+            sentinel=sentinel, precision=precision, trace=trace,
+            interpret=interpret,
+        )
     t0 = time.monotonic()
     n_rows = best_d.shape[0]
     d = data_dev.shape[1]
@@ -642,6 +670,8 @@ def rpforest_core_distances(
     recall_sample: int = 256,
     mesh=None,
     forest: RPForest | None = None,
+    knn_backend: str = "auto",
+    knn_precision: str = "f32",
 ):
     """Approximate core distances via the rp-forest engine.
 
@@ -659,6 +689,25 @@ def rpforest_core_distances(
     leaf batches and the per-point lists over the devices — see
     :func:`forest_knn`; results stay bitwise identical to single-device.
     ``forest`` reuses a pre-built index (serving; bench build/query split).
+
+    ``knn_backend="fused"`` routes the leaf scans, cross-tree merge, and
+    rescan reductions through the fused Pallas program family when
+    eligible (``pallas_forest.fused_forest_eligible``: supported metric,
+    k/d within the lane bound, f32, single device, TPU or small-n
+    interpret) — bitwise identical at ``knn_precision="f32"``, and falls
+    back to the unfused XLA engine otherwise (same guarded-fallback
+    contract as ``ops/tiled``). ``knn_precision="bf16"`` applies only
+    under the fused program: bf16 MXU distance tiles with f32
+    accumulation plus one exact f32 refine of the surviving k-best after
+    the rescan rounds (euclidean only; the unfused path is always
+    f32-exact). Under bf16 the whole fused chain keeps an over-provisioned
+    ``min(2k, 128)`` survivor pool — bf16 dot error exceeds the distance
+    gaps between close neighbors, so exact-k bf16 selection drops true
+    neighbors near the boundary; the f32 refine re-ranks the 2k pool and
+    the final slice keeps the exact best k (recall gate:
+    tests/unit/test_pallas_forest.py). One ``knn_fused_forest`` trace
+    event records the fused run (leaf tiles prefetched, trees merged,
+    refine rows, precision, interpret honesty).
     """
     data = np.asarray(data)
     n = len(data)
@@ -680,22 +729,72 @@ def rpforest_core_distances(
     data_dev = jnp.asarray(data_np)
     if repl_sh is not None:
         data_dev = jax.device_put(data_dev, repl_sh)
+    from hdbscan_tpu.ops.pallas_forest import (
+        fused_forest_eligible, refine_f32,
+    )
+
+    use_fused = knn_backend == "fused" and fused_forest_eligible(
+        n, data.shape[1], k_eff, metric, dtype, mesh
+    )
+    if knn_precision == "bf16" and metric != "euclidean":
+        raise ValueError(
+            "knn_precision='bf16' supports euclidean only (bf16 MXU tiles)"
+        )
+    precision = knn_precision if use_fused else "f32"
+    try:
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except RuntimeError:
+        on_tpu = False
+    interpret = not on_tpu
+    backend = "fused" if use_fused else "xla"
+    # bf16 runs the whole fused chain at an OVER-PROVISIONED list width:
+    # quantized distance gaps between close neighbors fall below the bf16
+    # dot error, so selecting exactly k in bf16 drops true neighbors that
+    # sit just past the boundary. Keeping 2k survivors and letting the
+    # exact f32 refine re-rank them restores recall (the refine's top k of
+    # a 2k pool equals exact top-k whenever the true k-best survive).
+    k_run = k_eff
+    if use_fused and precision == "bf16":
+        k_run = min(2 * k_eff, 128, n)
+    t_fused = time.monotonic()
     best_d, best_i = forest_knn(
         data_dev,
         forest,
-        k_eff,
+        k_run,
         metric,
         trace=trace,
         recall_sample=recall_sample,
         mesh=mesh,
+        backend=backend,
+        precision=precision,
+        interpret=interpret,
     )
     for rnd in range(rescan_rounds):
         best_d, best_i = rescan_round(
-            data_dev, best_d, best_i, k_eff, metric, rnd, rescan_rounds,
-            sentinel=n, trace=trace,
+            data_dev, best_d, best_i, k_run, metric, rnd, rescan_rounds,
+            sentinel=n, trace=trace, backend=backend, precision=precision,
+            interpret=interpret,
         )
         if rows_sh is not None:
             best_d, best_i = jax.device_put((best_d, best_i), (rows_sh, rows_sh))
+    refine_rows = 0
+    if use_fused and precision == "bf16":
+        best_d, best_i = refine_f32(data_dev, best_d, best_i, metric, n)
+        best_d.block_until_ready()
+        refine_rows = int(best_d.shape[0])
+        best_d, best_i = best_d[:, :k_eff], best_i[:, :k_eff]
+    if use_fused and trace is not None:
+        trace(
+            "knn_fused_forest",
+            wall_s=time.monotonic() - t_fused,
+            n=n,
+            k=k_eff,
+            trees=forest.trees,
+            leaf_tiles=forest.trees * forest.num_leaves,
+            refine_rows=refine_rows,
+            precision=precision,
+            interpret=interpret,
+        )
     knn = np.asarray(best_d, np.float64)[:n]
     if min_pts <= 1:
         core = np.zeros(n, np.float64)
@@ -722,6 +821,8 @@ def rpforest_core_distances_rows(
     dtype=np.float32,
     trace=None,
     mesh=None,
+    knn_backend: str = "auto",
+    knn_precision: str = "f32",
 ):
     """Approximate core distances for SELECTED rows (the boundary-rescan
     contract of ``ops.tiled.knn_core_distances_rows``: (m,) float64).
@@ -745,5 +846,7 @@ def rpforest_core_distances_rows(
         trace=trace,
         recall_sample=0,
         mesh=mesh,
+        knn_backend=knn_backend,
+        knn_precision=knn_precision,
     )
     return core[row_ids]

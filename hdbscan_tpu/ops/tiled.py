@@ -262,8 +262,16 @@ def knn_core_distances(
     after ``ops.rpforest.resolve_knn_index``): "exact" (default) is this
     scan, byte-for-byte unchanged; "rpforest" delegates to the
     sub-quadratic random-projection-forest engine with ``index_opts``
-    (trees/leaf_size/rescan_rounds/seed) and ``trace`` threaded through —
-    same return contract either way.
+    (trees/leaf_size/rescan_rounds/seed, plus ``knn_backend`` /
+    ``knn_precision``) and ``trace`` threaded through — same return
+    contract either way. On the rpforest tier ``knn_backend="fused"``
+    routes the leaf scans, the cross-tree k-best merge, and the rescan
+    rounds through the fused Pallas forest program
+    (``ops/pallas_forest``: leaf gather -> MXU distance tiles -> on-chip
+    compare-exchange k-best registers), bitwise-identical at
+    ``knn_precision="f32"`` and a bf16-tile + exact-f32-refine
+    approximation at ``knn_precision="bf16"``; the ``backend`` parameter
+    below only governs the exact tier.
     """
     n = len(data)
     if index == "rpforest":

@@ -202,6 +202,7 @@ def _forest_build_sweep_fn(
     leaf_mask: np.ndarray,
     lmax: int,
     dtype,
+    precision: str = "f32",
 ):
     """Jitted shard_map program fusing the per-shard tree BUILD with the
     PANDA-style bounded k-NN panel exchange, double-buffered end to end.
@@ -222,7 +223,18 @@ def _forest_build_sweep_fn(
     before visiting the resident panel. The previous two-dispatch version
     synchronized on the fully built forest before the first byte of the
     exchange could move.
+
+    The candidate distance tile is the SHARED fused-forest kernel body
+    (``ops/pallas_forest.rows_dist``): at ``precision="f32"`` it is
+    literally the same vmapped ``pairwise_distance`` row this function
+    always computed (bitwise unchanged); ``"bf16"`` swaps in the bf16
+    MXU dot with f32 accumulation/norms. The sharded tier has no global
+    refine pass (an arbitrary cross-shard gather would replicate — same
+    reason it has no rescan), so bf16 core distances carry the bf16-dot
+    value error directly, quality-gated by the sampled ``recall_at_k``
+    counter like every other approximation on this tier.
     """
+    from hdbscan_tpu.ops.pallas_forest import rows_dist
     from hdbscan_tpu.ops.rpforest import (
         _build_geom,
         _build_one_tree,
@@ -233,7 +245,8 @@ def _forest_build_sweep_fn(
 
     key = (
         mesh, n, shard, trees, depth, k, metric,
-        leaf_mask.tobytes(), lmax, np.dtype(dtype).str, "build_sweep",
+        leaf_mask.tobytes(), lmax, np.dtype(dtype).str, precision,
+        "build_sweep",
     )
     fn = _SHARD_FOREST_CACHE.get(key)
     if fn is not None:
@@ -279,9 +292,10 @@ def _forest_build_sweep_fn(
                 mem = p_mem[t][node]            # (shard, Lmax) panel-local
                 gid = off + mem
                 cpts = p_rows[mem]              # (shard, Lmax, d)
-                cd = jax.vmap(
-                    lambda q, c: pairwise_distance(q[None, :], c, metric)[0]
-                )(rows, cpts)
+                cd = rows_dist(
+                    rows, cpts, metric,
+                    d_real=rows.shape[1], precision=precision,
+                )
                 ok = mask_j[node] & (gid < n) & valid_q[:, None]
                 cd = jnp.where(ok, cd, inf)
                 ci = jnp.where(ok, gid, sentinel)
@@ -369,6 +383,7 @@ def shard_forest_core_distances(
     mesh=None,
     trace=None,
     recall_sample: int = 256,
+    knn_precision: str = "f32",
     **_ignored,
 ):
     """Row-sharded rp-forest core distances: per-shard tree builds + the
@@ -381,8 +396,13 @@ def shard_forest_core_distances(
     arbitrary rows across shards, i.e. replicate); the cross-shard panel
     visits are the recall repair, quality-gated by the sampled
     ``recall_at_k`` counter and the e2e ARI tests. ``**_ignored`` swallows
-    replicated-tier-only index_opts (``rescan_rounds``) so call sites can
-    pass one opts dict to either engine.
+    replicated-tier-only index_opts (``rescan_rounds``, ``knn_backend``)
+    so call sites can pass one opts dict to either engine.
+
+    ``knn_precision="bf16"`` runs the per-visit candidate distance tile —
+    the shared fused-forest kernel body, ``ops/pallas_forest.rows_dist`` —
+    as bf16 MXU dots with f32 accumulation (euclidean only; no refine pass
+    exists on this tier, see ``_forest_build_sweep_fn``).
     """
     from hdbscan_tpu.ops.rpforest import (
         _heap_base,
@@ -392,6 +412,15 @@ def shard_forest_core_distances(
 
     if metric not in METRICS:
         raise ValueError(f"unknown metric {metric!r}; expected one of {METRICS}")
+    if knn_precision not in ("f32", "bf16"):
+        raise ValueError(
+            f"unknown knn_precision {knn_precision!r}: f32 | bf16"
+        )
+    if knn_precision == "bf16" and metric != "euclidean":
+        raise ValueError(
+            "knn_precision='bf16' supports euclidean only "
+            f"(got metric={metric!r})"
+        )
     data = np.asarray(data)
     n, d = data.shape
     mesh = mesh if mesh is not None else get_mesh()
@@ -442,7 +471,8 @@ def shard_forest_core_distances(
     # Each query visits T leaves in each of D shards: T·D·Lmax candidates.
     _flops.add_scan(n_pad * trees * n_dev, lmax, d)
     sweep = _forest_build_sweep_fn(
-        mesh, n, shard, trees, depth, k_eff, metric, leaf_mask, lmax, dtype
+        mesh, n, shard, trees, depth, k_eff, metric, leaf_mask, lmax, dtype,
+        precision=knn_precision,
     )
     with obs.mem_phase("shard_knn_scan"), obs.task(
         "shard_knn_scan", total=n_dev

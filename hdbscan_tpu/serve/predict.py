@@ -8,7 +8,10 @@ formulation rendered in this repo's eps-level representation:
    tiled exact scan the fit used (``ops/tiled._knn_core_scan``, or the fused
    Pallas kernel under ``predict_backend=fused``; under
    ``predict_backend=rpforest`` the artifact's stored rp-forest routes q to
-   T leaves and only their members are scanned — sub-quadratic, approximate).
+   T leaves and only their members are scanned — sub-quadratic, approximate.
+   On a real TPU that candidate scan runs the fused forest rescan program
+   (``ops/pallas_forest.forest_rescan_topk``) so the (B, T·Lmax) candidate
+   distance matrix stays in VMEM; bitwise-equal to the XLA line at f32).
 2. **Core distance**: ``core_q`` = the (minPts - 1)-th smallest training
    distance — identical to the fit's self-included semantics for training
    rows (their own row sits in the train set at distance 0).
@@ -187,7 +190,7 @@ def _predict_kernel_rpf(
     xq, normals, thresholds, members, train, core_t, labels_t, last_t, anc,
     birth, sel_anc, eps_min, eps_max, sel_ids,
     k: int, kth_col: int, metric: str, depth: int, sentinel: int,
-    with_membership: bool,
+    with_membership: bool, fused: bool = False, interpret: bool = False,
 ):
     """Sub-quadratic k-NN: route each query down the stored forest planes
     (``ops/rpforest.route_queries``, ``depth`` gather+dot steps per tree),
@@ -195,7 +198,15 @@ def _predict_kernel_rpf(
     all n train rows), and keep everything downstream of the k-NN list —
     attachment, climb, labels — identical to the exact kernels. Candidate
     count is fixed by the stored forest geometry, so every bucket still
-    compiles exactly once (the zero-steady-state-recompile property)."""
+    compiles exactly once (the zero-steady-state-recompile property).
+
+    ``fused`` routes the candidate scan through the fused forest rescan
+    program (``ops/pallas_forest.forest_rescan_topk``): a predict query
+    has no running k-best, so one tile reduction IS the dedup lex-merge —
+    the (B, T·Lmax) candidate distance matrix never leaves VMEM. Bitwise
+    equal to the XLA line at f32 (pinned by the tier-1 parity test); the
+    CPU default stays the XLA scan.
+    """
     from hdbscan_tpu.core.distances import pairwise_distance
     from hdbscan_tpu.ops.rpforest import _dedup_lex_merge, route_queries
 
@@ -206,12 +217,21 @@ def _predict_kernel_rpf(
     )(normals, thresholds)
     cand = jax.vmap(lambda mem, lv: mem[lv])(members, leaves)
     cand = jnp.moveaxis(cand, 0, 1).reshape(xq.shape[0], -1).astype(jnp.int32)
-    dm = jax.vmap(
-        lambda q, pts: pairwise_distance(q[None, :], pts, metric)[0]
-    )(xqf, train[cand])
-    knn_d, knn_i = _dedup_lex_merge(
-        dm.astype(train.dtype), cand, k, sentinel
-    )
+    if fused:
+        from hdbscan_tpu.ops.pallas_forest import forest_rescan_topk
+
+        knn_d, knn_i = forest_rescan_topk(
+            xqf, train[cand], cand, k, metric, "f32", sentinel,
+            interpret=interpret,
+        )
+        knn_d = knn_d.astype(train.dtype)
+    else:
+        dm = jax.vmap(
+            lambda q, pts: pairwise_distance(q[None, :], pts, metric)[0]
+        )(xqf, train[cand])
+        knn_d, knn_i = _dedup_lex_merge(
+            dm.astype(train.dtype), cand, k, sentinel
+        )
     return _attach(
         knn_d, knn_i, xq, train, core_t, labels_t, last_t, anc, birth,
         sel_anc, eps_min, eps_max, sel_ids, kth_col, with_membership,
@@ -253,7 +273,7 @@ def _jitted_kernel(which: str):
             _predict_kernel_rpf,
             static_argnames=(
                 "k", "kth_col", "metric", "depth", "sentinel",
-                "with_membership",
+                "with_membership", "fused", "interpret",
             ),
             donate_argnums=donate,
         )
@@ -361,6 +381,19 @@ class Predictor:
             # distance keeps them out of every argmin.
             self._row_mult = 1
             n_pad = n + 1
+            # On a real TPU the stored-plane candidate scan rides the fused
+            # forest rescan program (bitwise-equal at f32). CPU keeps the
+            # XLA line — same values, no interpreter latency; tests flip
+            # ``_rpf_fused``/``_interpret`` to pin the interpret-mode
+            # parity explicitly.
+            from hdbscan_tpu.ops.pallas_forest import fused_forest_eligible
+
+            self._rpf_fused = (
+                jax.devices()[0].platform == "tpu"
+                and fused_forest_eligible(
+                    n, model.data.shape[1], self.k, model.metric, dtype
+                )
+            )
             rpf = model.rpf
             self._train = jax.device_put(
                 jnp.asarray(_pad_rows(np.asarray(model.data, dtype), n_pad))
@@ -442,7 +475,8 @@ class Predictor:
                 self._sel_anc, self._eps_min, self._eps_max, self._sel_ids,
                 k=self.k, kth_col=self.kth_col, metric=self.model.metric,
                 depth=self._rpf_depth, sentinel=self.model.n_train,
-                with_membership=with_membership,
+                with_membership=with_membership, fused=self._rpf_fused,
+                interpret=self._interpret,
             )
         dev_rows = max(bucket, self._row_mult)
         row_tile = min(_next_pow2(max(dev_rows, 8)), self.row_tile_cap)

@@ -66,6 +66,17 @@ HEADLINE = {
     # the fit — both lower-better, same cpu_smoke caveats as above.
     "mesh_host_syncs_per_fit": "lower",
     "mesh_host_frac": "lower",
+    # Fused forest-query kernel companions (ops/pallas_forest, README
+    # "Kernel depth"): candidate-scan throughput of the fused kernel body
+    # at the 200k proxy shape, its speedup over the unfused chain on the
+    # same phase, and the modeled roofline arithmetic intensity of the
+    # fused scan — all higher-better (the fusion's whole point is more
+    # FLOPs per HBM byte; the unfused chain round-trips the candidate
+    # distance matrix). CPU-proxy rows carry cpu_smoke; the real-TPU lane
+    # re-records them with the compiled Pallas legs.
+    "fused_forest_body_gflops_s_200k": "higher",
+    "fused_forest_vs_unfused": "higher",
+    "fused_forest_ai_flops_per_byte": "higher",
 }
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
@@ -134,6 +145,12 @@ def load_round(path: str) -> dict:
             ari = rec.get("maintain_ari_vs_scratch")
             if isinstance(ari, (int, float)):
                 metrics["stream_maintain_ari_vs_scratch"] = float(ari)
+        if name == "fused_forest_body_gflops_s_200k":
+            for comp in ("fused_forest_vs_unfused",
+                         "fused_forest_ai_flops_per_byte"):
+                v = rec.get(comp)
+                if isinstance(v, (int, float)):
+                    metrics[comp] = float(v)
         if name == "mesh_scan_scaling_efficiency_8dev":
             for comp in ("mesh_peak_device_bytes_max", "mesh_comm_frac",
                          "mesh_skew", "mesh_mfu",

@@ -370,6 +370,10 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
                 # knn_index_* events each pin their geometry fields.
                 if stage in ("knn_index_build", "knn_index_query", "knn_index_rescan"):
                     errors += _check_knn_index(path, lineno, stage, ev)
+                # Fused forest program summary (ops/rpforest.py fused
+                # dispatch): geometry + precision/interpret provenance.
+                if stage == "knn_fused_forest":
+                    errors += _check_knn_fused_forest(path, lineno, ev)
                 # Device-MST invariants (core/mst_device.py): per-event schemas
                 # here; the one-sync-per-forest-build count check runs after the
                 # file is fully read (see below).
@@ -652,6 +656,44 @@ def _check_knn_index(path: str, lineno: int, stage: str, ev: dict) -> list[str]:
         improved = ev.get("improved")
         if not isinstance(improved, int) or isinstance(improved, bool) or improved < 0:
             errors.append(f"{where} improved={improved!r} not a non-negative int")
+    return errors
+
+
+def _check_knn_fused_forest(path: str, lineno: int, ev: dict) -> list[str]:
+    """One summary event per fused-forest core-distance pass
+    (ops/rpforest.py ``rpforest_core_distances`` with ``knn_backend=fused``):
+    leaf tiles prefetched (trees x leaves), trees merged, rows refined
+    (0 at f32 — the exact path needs no refine), precision knob, and the
+    interpret-mode provenance flag the benchmark honesty policy requires."""
+    errors: list[str] = []
+    where = f"{path}:{lineno}: knn_fused_forest"
+    for key in ("n", "k", "trees", "leaf_tiles"):
+        if not _pos_int(ev.get(key)):
+            errors.append(f"{where} {key}={ev.get(key)!r} not a positive int")
+    if (
+        _pos_int(ev.get("leaf_tiles"))
+        and _pos_int(ev.get("trees"))
+        and ev["leaf_tiles"] % ev["trees"] != 0
+    ):
+        errors.append(
+            f"{where} leaf_tiles={ev['leaf_tiles']} not a multiple of "
+            f"trees={ev['trees']} (leaf_tiles = trees x leaves)"
+        )
+    if not _nonneg_int(ev.get("refine_rows")):
+        errors.append(
+            f"{where} refine_rows={ev.get('refine_rows')!r} not a "
+            f"non-negative int"
+        )
+    precision = ev.get("precision")
+    if precision not in ("f32", "bf16"):
+        errors.append(f"{where} precision={precision!r} not f32|bf16")
+    elif precision == "f32" and ev.get("refine_rows", 0) != 0:
+        errors.append(
+            f"{where} refine_rows={ev.get('refine_rows')!r} nonzero at f32 "
+            f"(the exact path must not refine)"
+        )
+    if not isinstance(ev.get("interpret"), bool):
+        errors.append(f"{where} interpret={ev.get('interpret')!r} not a bool")
     return errors
 
 

@@ -234,6 +234,7 @@ def test_resolve_index_for_params():
     assert index == "rpforest"
     assert opts == {
         "trees": 3, "leaf_size": 64, "rescan_rounds": 2, "seed": 9,
+        "knn_backend": "auto", "knn_precision": "f32",
     }
 
 
@@ -343,6 +344,51 @@ def test_check_trace_flags_bad_knn_events(tmp_path):
     assert "round=3" in text
     assert "improved=-1" in text
     assert "recall_at_k=1.5" in text
+
+
+def test_check_trace_validates_fused_forest_events(tmp_path):
+    """The fused engine's ``knn_fused_forest`` summary event roundtrips
+    the validator clean; malformed geometry/precision/honesty fields are
+    flagged with the offending values."""
+    import json
+
+    from hdbscan_tpu.utils.tracing import JsonlSink
+
+    trace_path = str(tmp_path / "fused.jsonl")
+    tracer = Tracer(sinks=[JsonlSink(trace_path, static={"process": 0})])
+    rpforest_core_distances(
+        _blobs(600, 11), 5, "euclidean", 8, trees=2, leaf_size=64,
+        rescan_rounds=1, seed=3, knn_backend="fused", trace=tracer,
+    )
+    tracer.close()
+    assert [e.name for e in tracer.events].count("knn_fused_forest") == 1
+    check_trace = _load_checker("check_trace")
+    _, errors = check_trace.validate_trace(trace_path)
+    assert errors == []
+
+    bad = [
+        {"schema": "hdbscan-tpu-trace/1", "stage": "knn_fused_forest",
+         "wall_s": 0.1, "seq": 0, "process": 0, "n": 100, "k": 8,
+         "trees": 3, "leaf_tiles": 7, "refine_rows": 0,
+         "precision": "f32", "interpret": True},
+        {"schema": "hdbscan-tpu-trace/1", "stage": "knn_fused_forest",
+         "wall_s": 0.1, "seq": 1, "process": 0, "n": 100, "k": 8,
+         "trees": 2, "leaf_tiles": 4, "refine_rows": 100,
+         "precision": "f32", "interpret": "yes"},
+        {"schema": "hdbscan-tpu-trace/1", "stage": "knn_fused_forest",
+         "wall_s": 0.1, "seq": 2, "process": 0, "n": 100, "k": 8,
+         "trees": 2, "leaf_tiles": 4, "refine_rows": -1,
+         "precision": "fp8", "interpret": False},
+    ]
+    path = tmp_path / "bad_fused.jsonl"
+    path.write_text("".join(json.dumps(e) + "\n" for e in bad))
+    _, errors = check_trace.validate_trace(str(path))
+    text = "\n".join(errors)
+    assert "leaf_tiles=7 not a multiple of trees=3" in text
+    assert "refine_rows=100 nonzero at f32" in text
+    assert "interpret='yes'" in text
+    assert "refine_rows=-1" in text
+    assert "precision='fp8'" in text
 
 
 def test_check_recall_replay(tmp_path):

@@ -224,6 +224,40 @@ def test_boruvka_device_matches_host_rounds(rng):
     assert np.all(np.isinf(np.asarray(res["w"][count:])))
 
 
+def test_round_cap_raises_instead_of_partial_mst(rng):
+    """The while_loop's round cap must never silently truncate: a run
+    that hits ``max_rounds`` while still merging raises with the
+    last-rounds diagnostic; converged runs and saturated (disconnected)
+    runs pass through."""
+    import jax
+
+    from hdbscan_tpu.ops.tiled import knn_core_distances
+
+    data, _ = make_blobs(rng, n=60, d=3, centers=3)
+    core, _ = knn_core_distances(data, 4, fetch_knn=False)
+    res = jax.device_get(MD.boruvka_mst_device(data, core, max_rounds=1))
+    rounds, count = int(res["rounds"]), int(res["count"])
+    assert rounds == 1 and count < len(data) - 1  # genuinely capped
+    with pytest.raises(RuntimeError, match="round cap"):
+        MD.assert_rounds_converged(
+            rounds, count, len(data), max_rounds=1,
+            stat_comp=res["stat_comp"], stat_edges=res["stat_edges"],
+        )
+    # Converged: the default cap completes the same input and passes.
+    full = jax.device_get(MD.boruvka_mst_device(data, core))
+    assert int(full["count"]) == len(data) - 1
+    MD.assert_rounds_converged(
+        int(full["rounds"]), int(full["count"]), len(data),
+        stat_comp=full["stat_comp"], stat_edges=full["stat_edges"],
+    )
+    # Saturated: a disconnected pool stops adding edges before the cap —
+    # the zero-edge final round marks "done", not "capped mid-merge".
+    MD.assert_rounds_converged(
+        2, 5, 10, max_rounds=2,
+        stat_comp=np.array([4, 4]), stat_edges=np.array([5, 0]),
+    )
+
+
 # ---------------------------------------------------------------------------
 # e2e: the device fit path
 # ---------------------------------------------------------------------------

@@ -1,0 +1,296 @@
+"""Interpreter-mode parity tests for the fused forest-query kernel family.
+
+The contract under test (README "Kernel depth", ops/pallas_forest): at
+``precision="f32"`` every fused entry — per-leaf top-k, cross-tree merge,
+rescan candidate-panel reduction, Borůvka segment-min — is BITWISE
+identical to the unfused XLA chain it replaces, including the repo-wide
+(distance, id) lex tie-break and the sentinel conventions. bf16 is an
+approximation and is gated on recall/ARI instead.
+
+All Pallas calls run ``interpret=True`` (CPU container); shapes stay small
+because the interpreter executes grid steps sequentially.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hdbscan_tpu.ops import pallas_forest as pf
+from hdbscan_tpu.ops import rpforest as rpf
+from tests.conftest import make_blobs
+
+
+def _forest_leaf_parity(data, trees=3, leaf_size=32, kk=5, seed=0):
+    """Assert forest_leaf_topk == _leaf_scan bitwise on every leaf batch."""
+    data = np.asarray(data, np.float32)
+    forest = rpf.build_forest(data, trees=trees, leaf_size=leaf_size, seed=seed)
+    n, lmax = forest.n, forest.max_leaf
+    kk = min(kk, lmax)
+    form = pf.euclid_form(lmax, lmax, forest.d)
+    data_dev = jnp.asarray(data)
+    for t in range(forest.trees):
+        members = jnp.asarray(forest.members[t])
+        mask = jnp.asarray(forest.leaf_mask)
+        ref_d, ref_i = rpf._leaf_scan(data_dev, members, mask, kk, "euclidean", n)
+        got_d, got_i = pf.forest_leaf_topk(
+            data_dev, members, mask, kk, metric="euclidean", form=form,
+            precision="f32", sentinel=n, interpret=True,
+        )
+        np.testing.assert_array_equal(np.asarray(got_d), np.asarray(ref_d))
+        np.testing.assert_array_equal(np.asarray(got_i), np.asarray(ref_i))
+
+
+class TestLeafScanParity:
+    def test_randomized_blobs(self, rng):
+        data, _ = make_blobs(rng, n=220, d=5, centers=4)
+        _forest_leaf_parity(data, trees=3, leaf_size=32, kk=5)
+
+    def test_duplicates(self, rng):
+        """Duplicate points: exact-zero distances and id tie-breaks must
+        come out identically (the diff-form + first-hit argmin contract)."""
+        base, _ = make_blobs(rng, n=30, d=3, centers=3)
+        data = np.repeat(base, 6, axis=0)
+        _forest_leaf_parity(data, trees=2, leaf_size=24, kk=6)
+
+    def test_all_equal_distances(self, rng):
+        """Points drawn from a tiny discrete grid: almost every distance
+        ties, so ordering is decided purely by the (distance, id) lexsort."""
+        data = rng.integers(0, 2, size=(160, 4)).astype(np.float32)
+        _forest_leaf_parity(data, trees=2, leaf_size=16, kk=4)
+
+    def test_uneven_leaves(self, rng):
+        """n chosen so the balanced rank split leaves short leaves — the
+        masked tail columns must behave exactly like the unfused mask."""
+        data, _ = make_blobs(rng, n=137, d=3, centers=3)
+        _forest_leaf_parity(data, trees=2, leaf_size=20, kk=4)
+
+    def test_k_exceeds_leaf_occupancy(self, rng):
+        """kk near the leaf width: rows run out of real candidates and the
+        (+inf, sentinel) tail fill must match the unfused chain."""
+        data, _ = make_blobs(rng, n=64, d=3, centers=2)
+        forest = rpf.build_forest(data.astype(np.float32), trees=2,
+                                  leaf_size=8, seed=0)
+        _forest_leaf_parity(data, trees=2, leaf_size=8,
+                            kk=forest.max_leaf)
+
+
+class TestFusedForestKnn:
+    def test_forest_knn_fused_bitwise(self, rng):
+        """Leaf kernel + on-chip cross-tree merge == the unfused engine."""
+        data, _ = make_blobs(rng, n=300, d=4, centers=4)
+        data = data.astype(np.float32)
+        forest = rpf.build_forest(data, trees=3, leaf_size=32, seed=1)
+        data_dev = jnp.asarray(data)
+        ref_d, ref_i = rpf.forest_knn(data_dev, forest, 6, "euclidean")
+        got_d, got_i = pf.forest_knn_fused(
+            data_dev, forest, 6, "euclidean", interpret=True
+        )
+        np.testing.assert_array_equal(np.asarray(got_d), np.asarray(ref_d))
+        np.testing.assert_array_equal(np.asarray(got_i), np.asarray(ref_i))
+
+    def test_rescan_round_fused_bitwise(self, rng):
+        """One neighbor-of-neighbor rescan round, fused vs unfused."""
+        data, _ = make_blobs(rng, n=280, d=4, centers=4)
+        data = data.astype(np.float32)
+        forest = rpf.build_forest(data, trees=2, leaf_size=32, seed=2)
+        data_dev = jnp.asarray(data)
+        best_d, best_i = rpf.forest_knn(data_dev, forest, 5, "euclidean")
+        ref_d, ref_i = rpf.rescan_round(
+            data_dev, best_d, best_i, 5, "euclidean", 0, 1, sentinel=len(data)
+        )
+        got_d, got_i = rpf.rescan_round(
+            data_dev, best_d, best_i, 5, "euclidean", 0, 1,
+            sentinel=len(data), backend="fused", interpret=True,
+        )
+        np.testing.assert_array_equal(np.asarray(got_d), np.asarray(ref_d))
+        np.testing.assert_array_equal(np.asarray(got_i), np.asarray(ref_i))
+
+    def test_rescan_topk_matches_dedup_lex_merge(self, rng):
+        """The serving path's contract: one fused tile reduction over a
+        candidate panel == the unfused dedup lex-merge of its distance
+        matrix (predict has no running list, so these must agree)."""
+        import jax
+
+        from hdbscan_tpu.core.distances import pairwise_distance
+
+        data, _ = make_blobs(rng, n=200, d=4, centers=3)
+        data = np.asarray(data, np.float32)
+        n, k = len(data), 5
+        cand = rng.integers(0, n, size=(24, k * k)).astype(np.int32)
+        # Sprinkle sentinel slots + duplicate ids — the dedup cases.
+        cand[:, -3:] = n
+        cand[:, 5] = cand[:, 4]
+        data_dev, cand_dev = jnp.asarray(data), jnp.asarray(cand)
+        q = data_dev[:24]
+        cpts = data_dev[jnp.clip(cand_dev, 0, n - 1)]
+        dm = jax.vmap(
+            lambda qq, pts: pairwise_distance(qq[None, :], pts, "euclidean")[0]
+        )(q, cpts)
+        dm = jnp.where(cand_dev == n, jnp.inf, dm)
+        ref_d, ref_i = rpf._dedup_lex_merge(dm, cand_dev, k, n)
+        got_d, got_i = pf.forest_rescan_topk(
+            q, cpts, cand_dev, k, "euclidean", "f32", n, interpret=True
+        )
+        np.testing.assert_array_equal(np.asarray(got_d), np.asarray(ref_d))
+        np.testing.assert_array_equal(np.asarray(got_i), np.asarray(ref_i))
+
+    def test_e2e_core_distances_bitwise(self, rng):
+        """Public engine, fused vs unfused: core, knn and id lists equal."""
+        data, _ = make_blobs(rng, n=350, d=4, centers=4)
+        kwargs = dict(min_pts=6, trees=3, leaf_size=48, rescan_rounds=1,
+                      seed=0, return_indices=True)
+        ref = rpf.rpforest_core_distances(data, **kwargs)
+        got = rpf.rpforest_core_distances(data, knn_backend="fused", **kwargs)
+        for g, r in zip(got, ref):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+
+    def test_fused_emits_trace_event(self, rng):
+        from hdbscan_tpu.utils.tracing import Tracer
+
+        data, _ = make_blobs(rng, n=150, d=3, centers=3)
+        tracer = Tracer()
+        rpf.rpforest_core_distances(
+            data, min_pts=5, trees=2, leaf_size=32, rescan_rounds=1,
+            knn_backend="fused", trace=tracer,
+        )
+        evs = [e for e in tracer.events if e.name == "knn_fused_forest"]
+        assert len(evs) == 1
+        f = evs[0].fields
+        assert f["n"] == 150 and f["precision"] == "f32"
+        assert f["interpret"] is True and f["refine_rows"] == 0
+        assert f["leaf_tiles"] % f["trees"] == 0
+
+
+class TestSegmentMin:
+    def test_min_outgoing_matches_xla(self, rng):
+        """Borůvka candidate segment-min: Pallas vs the XLA oracle,
+        including rows with NO outgoing candidate (-> (+inf, -1))."""
+        data, _ = make_blobs(rng, n=90, d=4, centers=3)
+        data = np.asarray(data, np.float32)
+        n, c = len(data), 12
+        cand = rng.integers(0, n, size=(n, c)).astype(np.int32)
+        cand[:5, -2:] = n  # sentinel slots
+        core = rng.uniform(0.05, 0.5, size=n).astype(np.float32)
+        comp = rng.integers(0, 4, size=n).astype(np.int32)
+        comp[7] = 99  # unique component...
+        cand[7] = 7   # ...whose candidates are all itself: no outgoing
+        q = jnp.asarray(data)
+        cpts = q[jnp.clip(jnp.asarray(cand), 0, n - 1)]
+        cids = jnp.asarray(cand)
+        core_d = jnp.asarray(core)
+        core_c = core_d[jnp.clip(cids, 0, n - 1)]
+        comp_d = jnp.asarray(comp)
+        comp_c = jnp.where(cids == n, -1, comp_d[jnp.clip(cids, 0, n - 1)])
+        args = (q, cpts, cids, core_d, core_c, comp_d, comp_c)
+        ref_w, ref_j = pf.forest_min_outgoing_xla(*args, sentinel=n)
+        got_w, got_j = pf.forest_min_outgoing(*args, sentinel=n,
+                                              interpret=True)
+        np.testing.assert_array_equal(np.asarray(got_w), np.asarray(ref_w))
+        np.testing.assert_array_equal(np.asarray(got_j), np.asarray(ref_j))
+        assert np.asarray(got_j)[7] == -1 and np.isinf(np.asarray(got_w)[7])
+
+
+class TestBf16Gate:
+    def test_bf16_recall_small(self, rng):
+        """Tier-1 bf16 sanity at a small shape: the over-provisioned
+        bf16 chain + f32 refine keeps recall@k >= 0.95 vs the exact scan
+        (the full 5k acceptance gate runs in the slow lane below)."""
+        from hdbscan_tpu.core.distances import pairwise_distance
+
+        data, _ = make_blobs(rng, n=1200, d=8, centers=8, spread=0.3)
+        data = data.astype(np.float32)
+        k = 8
+        _, _, idx = rpf.rpforest_core_distances(
+            data, min_pts=k, k=k, trees=3, leaf_size=128, rescan_rounds=1,
+            knn_backend="fused", knn_precision="bf16", return_indices=True,
+        )
+        dm = np.asarray(pairwise_distance(
+            jnp.asarray(data), jnp.asarray(data), "euclidean"
+        ))
+        truth = np.argsort(dm, axis=1, kind="stable")[:, :k]
+        hits = np.mean([
+            len(set(idx[i].tolist()) & set(truth[i].tolist())) / k
+            for i in range(len(data))
+        ])
+        assert hits >= 0.95, f"bf16 recall@{k} = {hits:.4f} < 0.95"
+
+    @pytest.mark.slow
+    def test_bf16_recall_and_ari_gate(self, rng):
+        """The knn_precision=bf16 acceptance on the 5k dataset: bf16 MXU
+        tiles + one exact f32 refine must keep recall@k >= 0.95 against
+        the exact scan and ARI >= 0.99x the fused-f32 fit."""
+        from hdbscan_tpu.config import HDBSCANParams
+        from hdbscan_tpu.core.distances import pairwise_distance
+        from hdbscan_tpu.models import exact
+        from hdbscan_tpu.utils.evaluation import adjusted_rand_index
+
+        data, _ = make_blobs(rng, n=5000, d=8, centers=16, spread=0.3)
+        data = data.astype(np.float32)
+        k = 8
+        _, _, idx = rpf.rpforest_core_distances(
+            data, min_pts=k, k=k, trees=4, leaf_size=256, rescan_rounds=1,
+            knn_backend="fused", knn_precision="bf16", return_indices=True,
+        )
+        # Exact reference lists for a row sample (self included).
+        sample = rng.choice(len(data), size=256, replace=False)
+
+        dm = np.asarray(pairwise_distance(
+            jnp.asarray(data[sample]), jnp.asarray(data), "euclidean"
+        ))
+        truth = np.argsort(dm, axis=1, kind="stable")[:, :k]
+        hits = np.mean([
+            len(set(idx[s].tolist()) & set(truth[i].tolist())) / k
+            for i, s in enumerate(sample)
+        ])
+        assert hits >= 0.95, f"bf16 recall@{k} = {hits:.4f} < 0.95"
+
+        params = HDBSCANParams(
+            min_points=k, min_cluster_size=32, knn_index="rpforest",
+            rpf_trees=4, rpf_leaf_size=256, rpf_rescan_rounds=1,
+            knn_backend="fused",
+        )
+        r_f32 = exact.fit(data, params)
+        r_bf16 = exact.fit(data, params.replace(knn_precision="bf16"))
+        ari = adjusted_rand_index(r_bf16.labels, r_f32.labels)
+        assert ari >= 0.99, f"bf16 fit ARI vs fused-f32 = {ari:.4f} < 0.99"
+
+
+class TestEligibilityAndValidation:
+    def test_eligibility_gate(self):
+        ok = dict(n=1000, d=8, k=16, metric="euclidean", dtype=np.float32)
+        assert pf.fused_forest_eligible(**ok)
+        assert not pf.fused_forest_eligible(**{**ok, "metric": "pearson"})
+        assert not pf.fused_forest_eligible(**{**ok, "k": 129})
+        assert not pf.fused_forest_eligible(**{**ok, "d": 200})
+        assert not pf.fused_forest_eligible(**{**ok, "dtype": np.float64})
+        # Off-TPU the interpreter gate bounds n.
+        assert not pf.fused_forest_eligible(**{**ok, "n": (1 << 14) + 1})
+
+    def test_ineligible_falls_back_bitwise(self, rng):
+        """knn_backend='fused' on an ineligible config (manhattan is fine,
+        pearson is not) silently runs the unfused engine — same results."""
+        data, _ = make_blobs(rng, n=180, d=3, centers=3)
+        kwargs = dict(min_pts=5, trees=2, leaf_size=32, rescan_rounds=0,
+                      metric="pearson", seed=0)
+        ref = rpf.rpforest_core_distances(data, **kwargs)
+        got = rpf.rpforest_core_distances(data, knn_backend="fused", **kwargs)
+        np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(ref[0]))
+        np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(ref[1]))
+
+    def test_bf16_requires_euclidean(self, rng):
+        data, _ = make_blobs(rng, n=100, d=3, centers=2)
+        with pytest.raises(ValueError, match="euclidean"):
+            rpf.rpforest_core_distances(
+                data, min_pts=4, metric="manhattan",
+                knn_backend="fused", knn_precision="bf16",
+            )
+
+    def test_config_knob_validates(self):
+        from hdbscan_tpu.config import HDBSCANParams
+
+        with pytest.raises(ValueError, match="knn_precision"):
+            HDBSCANParams(knn_precision="fp8")
+        p = HDBSCANParams.from_args(
+            ["knn_precision=bf16", "knn_backend=fused"]
+        )
+        assert p.knn_precision == "bf16" and p.knn_backend == "fused"

@@ -426,6 +426,29 @@ def test_rpf_zero_recompiles_after_warmup(fitted_rpf):
     assert counter() - before == 0
 
 
+def test_rpf_fused_kernel_predict_bitwise(fitted_rpf):
+    """The fused forest-query program behind the rpforest backend
+    (ops/pallas_forest.forest_rescan_topk, README "Kernel depth") must
+    reproduce the XLA candidate-scan bitwise at f32. Off-TPU the
+    Predictor auto-selects the XLA line, so flip the routing flags to pin
+    the interpret-mode parity the TPU path relies on."""
+    data, params, result, model = fitted_rpf
+    rng = np.random.default_rng(41)
+    queries = data[rng.integers(0, len(data), 48)] + rng.normal(
+        0, 0.05, size=(48, data.shape[1])
+    )
+    base = Predictor(model, backend="rpforest")
+    assert base._rpf_fused is False  # CPU container: XLA line by default
+    lab_x, prob_x, score_x = base.predict(queries)
+    fused = Predictor(model, backend="rpforest")
+    fused._rpf_fused = True
+    fused._interpret = True
+    lab_f, prob_f, score_f = fused.predict(queries)
+    np.testing.assert_array_equal(lab_f, lab_x)
+    np.testing.assert_array_equal(prob_f, prob_x)
+    np.testing.assert_array_equal(score_f, score_x)
+
+
 # -- blue/green swap (serve/server.py) --------------------------------------
 
 
