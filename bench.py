@@ -361,7 +361,191 @@ def _slo(argv: list[str]) -> None:
                 )
     finally:
         shutil.rmtree(fleet_dir, ignore_errors=True)
+
+    # --- control-plane leg (README "Fleet control plane"): 64 tenants, ----
+    # ramp arrival profile with autoscaler churn, the zero-copy artifact
+    # ledger (private loads vs the digest-keyed mmap store, same artifacts,
+    # same run), and a WAL-safe respawn — ROADMAP item 3's acceptance
+    # topology in one pass. Elasticity is capped at 2 replicas: on a smoke
+    # host the point is observing the scale-up AND scale-down decisions,
+    # not throughput.
+    import signal as _signal
+
+    from hdbscan_tpu.fleet import Autoscaler
+
+    cp_tenants = [f"t{i:02d}" for i in range(64)]
+    cp_duration = max(6.0, duration)
+    # The ramp peak must exceed one replica's closed-loop capacity on any
+    # host timing profile, or the queue-depth votes never accumulate and
+    # the churn clause turns into a coin flip: at ~12ms/request a single
+    # replica absorbs ~80 rps, so offer well past that and let the
+    # concurrency cap peg in-flight during the hold phase.
+    cp_rate = 160.0
+    cp_dir = tempfile.mkdtemp(prefix="hdbscan-slo-cp-")
+
+    def _vm_rss_kb(pid: int) -> int:
+        with open(f"/proc/{pid}/status", encoding="ascii") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+        return 0
+
+    cp_rss: dict = {}
+    cp_hit: dict = {}
+    try:
+        cp_model = os.path.join(cp_dir, "model.npz")
+        model.save(cp_model, compress=False)  # spool-ready (mmap) bytes
+        cp_tdir = os.path.join(cp_dir, "tenants")
+        os.makedirs(cp_tdir)
+        for t in cp_tenants:
+            shutil.copy(cp_model, os.path.join(cp_tdir, f"{t}.npz"))
+
+        # (a) zero-copy ledger: one replica warms all 64 tenants with
+        # private npz loads, then again through the shared store; the
+        # VmRSS delta from spawned to all-tenants-warm is the per-host
+        # artifact bill under each policy.
+        cp_scrape = ""
+        for store_mode in ("off", "shared"):
+            r1 = FleetRouter(
+                cp_model, replicas=1, tenants_dir=cp_tdir,
+                health_interval_s=0.5,
+                replica_args=[f"artifact_store={store_mode}",
+                              "tenant_lru=64", "predict_batch=64"],
+                tracer=tracer,
+            )
+            with r1:
+                pid = r1.replicas[0].proc.pid
+                base_kb = _vm_rss_kb(pid)
+                submit1 = loadgen.http_predict_submitter(
+                    f"http://{r1.host}:{r1.port}", sampler, timeout=60,
+                )
+                for t in cp_tenants:
+                    submit1(16, t)
+                cp_rss[store_mode] = _vm_rss_kb(pid) - base_kb
+                if store_mode == "shared":
+                    with urllib.request.urlopen(
+                        f"http://{r1.host}:{r1.port}/metrics", timeout=30
+                    ) as resp:
+                        cp_scrape = resp.read().decode()
+        cp_parsed, cp_merrs = check_metrics.validate_exposition(
+            cp_scrape, "controlplane"
+        )
+        for err in cp_merrs:
+            print(f"[bench] slo controlplane metrics FAIL: {err}",
+                  file=sys.stderr)
+        for (mname, labels), v in cp_parsed["samples"].items():
+            if mname == "hdbscan_tpu_artifact_loads_total":
+                out_label = dict(labels)["outcome"]
+                cp_hit[out_label] = cp_hit.get(out_label, 0.0) + v
+
+        # (b) elasticity + durability on one router: acked ingest rows
+        # land in replica 0's WAL, the ramp drives the autoscaler up at
+        # peak and back down at the idle tail, then a SIGKILL respawn
+        # must replay every acked row.
+        scaler = None
+        acked = 0
+        router = FleetRouter(
+            cp_model, replicas=1, policy="least_loaded",
+            health_interval_s=0.4, tenants_dir=cp_tdir, ingest=True,
+            wal_root=os.path.join(cp_dir, "wal"),
+            compile_cache=os.path.join(cp_dir, "xla-cache"),
+            replica_args=["artifact_store=shared", "tenant_lru=64",
+                          "predict_batch=64"],
+            tracer=tracer,
+        )
+        with router:
+            cp_base = f"http://{router.host}:{router.port}"
+            for _ in range(4):
+                body = json.dumps({
+                    "points": [list(map(float, row)) for row in sampler(16)]
+                }).encode()
+                req = urllib.request.Request(
+                    cp_base + "/ingest", body,
+                    {"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    acked += json.loads(resp.read())["rows"]
+            scaler = Autoscaler(
+                router, min_replicas=1, max_replicas=2,
+                high_load=1.0, low_load=0.2, high_p99_s=0.3,
+                up_after=2, down_after=4, interval_s=0.25, cooldown_s=1.0,
+            ).start()
+            submit = loadgen.http_predict_submitter(
+                cp_base, sampler, timeout=60,
+            )
+            # concurrency bounds the saturated-queue tail: p99 tops out
+            # near cap x per-request service time, and the standby spawn
+            # competes for the same core(s) mid-peak — keep the cap low
+            # enough that a churning 1-core host stays inside the SLO.
+            ramp = loadgen.run_load(
+                submit, mode="ramp", concurrency=4, rate_rps=cp_rate,
+                batch_mix=((8, 0.5), (16, 0.5)), duration_s=cp_duration,
+                warmup_s=0.5, tenants=cp_tenants,
+            )
+            # idle tail: down votes accumulate and retire the standby
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline and (
+                len(router.replicas) > 1 or scaler.scaled_down < 1
+            ):
+                time.sleep(0.25)
+            scaler.stop()
+
+            # WAL-safe respawn: SIGKILL the anchor, zero acked-row loss
+            os.kill(router.replicas[0].proc.pid, _signal.SIGKILL)
+            deadline = time.monotonic() + 150.0
+            while time.monotonic() < deadline:
+                h = router.health()["replicas"]["0"]
+                if h["restarts"] >= 1 and h["up"]:
+                    break
+                time.sleep(0.25)
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{router.replicas[0].port}/healthz",
+                timeout=30,
+            ) as resp:
+                h0 = json.loads(resp.read())
+            recovered = (h0.get("stream", {}).get("wal", {})
+                         .get("last_recover") or {}).get("rows", -1)
+    finally:
+        shutil.rmtree(cp_dir, ignore_errors=True)
     tracer.close()
+
+    cp_pct = ramp.percentiles()
+    cp_verdict = telemetry.slo_verdict(
+        {
+            "p50_s": cp_pct["p50_s"],
+            "p99_s": cp_pct["p99_s"],
+            "error_rate": ramp.errors / max(ramp.offered, 1),
+        },
+        {k: SLO_TARGETS[k] for k in ("p50_s", "p99_s", "error_rate")},
+    )
+    cp_loads = sum(cp_hit.values())
+    cp_hit_rate = (cp_hit.get("hit", 0.0) / cp_loads) if cp_loads else 0.0
+    cp_fields = {
+        "cp_tenants": len(cp_tenants),
+        "cp_rate_peak_rps": cp_rate,
+        "cp_duration_s": cp_duration,
+        "cp_requests": ramp.requests,
+        "cp_errors": ramp.errors,
+        "cp_p50_ms": round((cp_pct["p50_s"] or 0) * 1e3, 3),
+        "cp_p99_ms": round((cp_pct["p99_s"] or 0) * 1e3, 3),
+        "cp_slo_ok": cp_verdict["ok"],
+        "cp_scale_ups": scaler.scaled_up if scaler else 0,
+        "cp_scale_downs": scaler.scaled_down if scaler else 0,
+        "cp_churn_ok": bool(
+            scaler and scaler.scaled_up >= 1 and scaler.scaled_down >= 1
+        ),
+        "cp_rss_private_kb": cp_rss.get("off"),
+        "cp_rss_shared_kb": cp_rss.get("shared"),
+        "cp_rss_sublinear_ok": (
+            cp_rss.get("shared", 1 << 30) < cp_rss.get("off", 0)
+        ),
+        "cp_artifact_loads": int(cp_loads),
+        "cp_artifact_hit_rate": round(cp_hit_rate, 4),
+        "cp_wal_acked_rows": acked,
+        "cp_wal_recovered_rows": recovered,
+        "cp_wal_ok": recovered == acked,
+        "cp_metrics_scrape_errors": len(cp_merrs),
+    }
 
     parsed1, errs1 = check_metrics.validate_exposition(scrape1, "scrape1")
     parsed2, errs2 = check_metrics.validate_exposition(scrape2, "scrape2")
@@ -439,6 +623,20 @@ def _slo(argv: list[str]) -> None:
         file=sys.stderr,
     )
     print(
+        f"[bench] slo controlplane: {len(cp_tenants)} tenants ramp "
+        f"p99={cp_fields['cp_p99_ms']}ms slo_ok={cp_fields['cp_slo_ok']} "
+        f"churn up={cp_fields['cp_scale_ups']} "
+        f"down={cp_fields['cp_scale_downs']} "
+        f"rss shared={cp_fields['cp_rss_shared_kb']}kB vs "
+        f"private={cp_fields['cp_rss_private_kb']}kB "
+        f"(sublinear_ok={cp_fields['cp_rss_sublinear_ok']}) "
+        f"hit_rate={cp_fields['cp_artifact_hit_rate']} "
+        f"wal {cp_fields['cp_wal_recovered_rows']}/"
+        f"{cp_fields['cp_wal_acked_rows']} rows "
+        f"(ok={cp_fields['cp_wal_ok']})",
+        file=sys.stderr,
+    )
+    print(
         json.dumps(
             {
                 "metric": "serve_slo_p99_ms_synthetic_5k",
@@ -468,6 +666,24 @@ def _slo(argv: list[str]) -> None:
                 "mem_watermarks": telemetry.json_sanitize(
                     auditor.watermark_table()
                 ),
+                "platform": jax.devices()[0].platform,
+                "cpu_smoke": jax.devices()[0].platform != "tpu",
+            }
+        )
+    )
+    # Second record: the control-plane headline (bench_compare lifts the
+    # rss-per-tenant and hit-rate companions into their own series).
+    print(
+        json.dumps(
+            {
+                "metric": "fleet_controlplane_p99_ms_ramp_64t",
+                "value": cp_fields["cp_p99_ms"],
+                "unit": "ms",
+                "fleet_rss_per_tenant_kb": round(
+                    (cp_rss.get("shared") or 0) / len(cp_tenants), 1
+                ),
+                "fleet_artifact_hit_rate": cp_fields["cp_artifact_hit_rate"],
+                **cp_fields,
                 "platform": jax.devices()[0].platform,
                 "cpu_smoke": jax.devices()[0].platform != "tpu",
             }

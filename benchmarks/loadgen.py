@@ -14,6 +14,14 @@ Drives a ``submit(k) -> rows`` callable — typically
     Latency is measured from the *scheduled arrival time*, not dispatch —
     so queueing delay caused by a slow server counts against it
     (coordinated-omission-aware, the classic closed-loop blind spot).
+``ramp``
+    Open-loop arrivals whose instantaneous rate follows a tenant-churn
+    profile: linear ramp from ~0 to ``rate_rps`` over the first
+    ``ramp_up_frac`` of the window, hold at peak for ``ramp_hold_frac``,
+    then drop to ``ramp_idle_rps`` (default 2% of peak, floor 0.5 rps)
+    for the remainder. This is the fleet autoscaler's acceptance
+    stimulus: the ramp forces scale-up under load, the idle tail forces
+    scale-down, in one run.
 
 Warmup exclusion: samples taken during the first ``warmup_s`` seconds (or
 the first ``warmup_requests`` requests, whichever bound is given) are
@@ -215,6 +223,9 @@ def run_load(
     warmup_s: float = 0.0,
     warmup_requests: int = 0,
     rate_rps: float | None = None,
+    ramp_up_frac: float = 0.35,
+    ramp_hold_frac: float = 0.3,
+    ramp_idle_rps: float | None = None,
     seed: int = 0,
     expect_shedding: bool = False,
     tenants=0,
@@ -233,12 +244,23 @@ def run_load(
     ``submit(batch_size, tenant)`` and the result carries per-tenant
     latency accounting in :attr:`LoadResult.per_tenant`.
     """
-    if mode not in ("closed", "open"):
-        raise ValueError(f"mode must be 'closed' or 'open', got {mode!r}")
+    if mode not in ("closed", "open", "ramp"):
+        raise ValueError(
+            f"mode must be 'closed', 'open', or 'ramp', got {mode!r}"
+        )
     if duration_s is None and requests is None:
         raise ValueError("one of duration_s / requests is required")
-    if mode == "open" and not rate_rps:
-        raise ValueError("open mode requires rate_rps")
+    if mode in ("open", "ramp") and not rate_rps:
+        raise ValueError(f"{mode} mode requires rate_rps")
+    if mode == "ramp":
+        if duration_s is None:
+            raise ValueError("ramp mode requires duration_s")
+        if not (0.0 < ramp_up_frac and 0.0 <= ramp_hold_frac
+                and ramp_up_frac + ramp_hold_frac <= 1.0):
+            raise ValueError(
+                f"ramp fractions must satisfy 0 < up and up + hold <= 1, "
+                f"got up={ramp_up_frac!r} hold={ramp_hold_frac!r}"
+            )
     if concurrency < 1:
         raise ValueError(f"concurrency must be >= 1, got {concurrency!r}")
     if isinstance(tenants, int):
@@ -350,9 +372,26 @@ def run_load(
         for t in threads:
             t.join()
     else:
-        # Open loop: Poisson arrivals at rate_rps; latency runs from the
-        # SCHEDULED arrival, so server-induced queueing delay is charged to
-        # the server even when the dispatch pool briefly backs up.
+        # Open loop: Poisson arrivals; latency runs from the SCHEDULED
+        # arrival, so server-induced queueing delay is charged to the
+        # server even when the dispatch pool briefly backs up. "ramp"
+        # shapes the instantaneous rate along the churn profile.
+        peak = float(rate_rps)
+        idle = (
+            float(ramp_idle_rps) if ramp_idle_rps is not None
+            else max(0.5, 0.02 * peak)
+        )
+
+        def rate_at(t: float) -> float:
+            if mode == "open":
+                return peak
+            frac = min(1.0, max(0.0, (t - warmup_until) / float(duration_s)))
+            if frac < ramp_up_frac:
+                return max(idle, peak * frac / ramp_up_frac)
+            if frac < ramp_up_frac + ramp_hold_frac:
+                return peak
+            return idle
+
         arrival_rng = random.Random(seed + 1)
         with ThreadPoolExecutor(max_workers=concurrency) as pool:
             next_at = time.perf_counter()
@@ -364,7 +403,7 @@ def run_load(
                 if not budget_take():
                     break
                 futures.append(pool.submit(one_request, next_at))
-                next_at += arrival_rng.expovariate(float(rate_rps))
+                next_at += arrival_rng.expovariate(rate_at(next_at))
             for f in futures:
                 f.result()
 
@@ -431,11 +470,17 @@ def main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("base_url")
-    ap.add_argument("--mode", choices=("closed", "open"), default="closed")
+    ap.add_argument("--mode", choices=("closed", "open", "ramp"), default="closed")
     ap.add_argument("--concurrency", type=int, default=4)
     ap.add_argument("--duration", type=float, default=5.0)
     ap.add_argument("--warmup", type=float, default=1.0)
-    ap.add_argument("--rate", type=float, default=50.0, help="open-loop rps")
+    ap.add_argument("--rate", type=float, default=50.0, help="open/ramp peak rps")
+    ap.add_argument("--ramp-up-frac", type=float, default=0.35)
+    ap.add_argument("--ramp-hold-frac", type=float, default=0.3)
+    ap.add_argument(
+        "--ramp-idle-rps", type=float, default=None,
+        help="tail rate after the hold window (default 2%% of peak)",
+    )
     ap.add_argument("--mix", type=_parse_mix, default=DEFAULT_MIX)
     ap.add_argument("--dim", type=int, default=3)
     ap.add_argument("--seed", type=int, default=0)
@@ -462,7 +507,10 @@ def main(argv=None) -> int:
         batch_mix=args.mix,
         duration_s=args.duration,
         warmup_s=args.warmup,
-        rate_rps=args.rate if args.mode == "open" else None,
+        rate_rps=args.rate if args.mode in ("open", "ramp") else None,
+        ramp_up_frac=args.ramp_up_frac,
+        ramp_hold_frac=args.ramp_hold_frac,
+        ramp_idle_rps=args.ramp_idle_rps,
         seed=args.seed,
         expect_shedding=args.expect_shedding,
         tenants=args.tenants,

@@ -124,6 +124,9 @@ invocation still means ``fit`` (the reference-compatible form above)::
         [fleet_replicas=N] \
         [fleet_policy={consistent_hash,least_loaded}] \
         [fleet_health_interval=F] [fleet_drain=F] \
+        [autoscale={true,false}] [fleet_min=N] [fleet_max=N] \
+        [scale_high_load=F] [scale_low_load=F] [scale_p99=F] \
+        [scale_cooldown=F] [artifact_store={shared,off}] \
         [<replica serve knobs, forwarded verbatim>]
 
 ``fit --model-out`` persists the fitted clustering as one atomic
@@ -198,6 +201,23 @@ generations and a ``tenant_quota`` req/s token bucket (exceed = 429 +
 Retry-After); ``POST /predict`` bodies gain an optional ``"tenant"`` field.
 ``serve --port-file PATH`` writes the bound port to PATH after the socket
 binds (how the fleet router discovers each replica's ephemeral port).
+Control plane (README "Fleet" / control-plane subsections):
+``autoscale=true`` runs the hysteresis autoscaler
+(``fleet/controlplane.py``) over the router's queue-depth/p99 signals,
+scaling between ``fleet_min`` and ``fleet_max`` replicas — scale-up spawns
+a standby, warms it against the shared persistent XLA compile cache
+(every replica env carries the same ``JAX_COMPILATION_CACHE_DIR``, per the
+``compile_cache`` knob), and admits it to the ring only when healthy;
+scale-down drains the victim before the WAL-safe SIGTERM. Thresholds:
+``scale_high_load``/``scale_low_load`` (in-flight per up replica),
+``scale_p99`` (seconds, 0 = off), ``scale_cooldown`` (hold after a scale
+op). Every operation traces as ``scale_event`` and counts in
+``hdbscan_tpu_scale_events_total``. ``artifact_store=shared`` loads tenant
+artifacts through the per-host digest-keyed mmap spool
+(``fleet/artifacts.py``) so T tenants cost one resident copy per HOST
+instead of per replica; fit-as-a-service jobs (``fleet/jobs.py``,
+``fit_workers``/``fit_queue_bound``/``fit_quota`` knobs) publish new
+generations through the per-tenant blue/green swap.
 ``fleet --replica-trace-dir DIR`` gives every replica its own
 ``--trace-out`` file under DIR; the router stamps ``X-Request-Id`` on every
 proxied request and emits a ``router_span`` per request, so
@@ -777,6 +797,15 @@ def _main_serve(argv: list[str], argv_full: list[str]) -> int:
 
     from hdbscan_tpu.serve.artifact import ClusterModel
     from hdbscan_tpu.serve.server import ClusterServer
+    from hdbscan_tpu.utils.cache import enable_persistent_compilation_cache
+
+    # Same persistent-cache policy as ``fit``: honor the ``compile_cache``
+    # knob and drop jax's min-compile-time floor to zero, else the fleet
+    # router's injected JAX_COMPILATION_CACHE_DIR looks enabled but never
+    # persists sub-second (CPU-sized) warmup compiles — and a scaled-up
+    # standby could not report the warm-spawn ``jit_compiles == 0`` the
+    # control plane asserts.
+    enable_persistent_compilation_cache(params.compile_cache)
 
     tracer = _serving_tracer(trace_out, report_out, params.trace_max_events)
     try:
@@ -876,6 +905,7 @@ def _main_fleet(argv: list[str], argv_full: list[str]) -> int:
             tracer=tracer,
             replica_trace_dir=replica_trace_dir,
             verbose=True,
+            compile_cache=params.compile_cache,
         )
         try:
             router.start()
@@ -888,7 +918,30 @@ def _main_fleet(argv: list[str], argv_full: list[str]) -> int:
             f"routing, model {model_path})",
             file=sys.stderr,
         )
-        rc = router.serve_forever()
+        scaler = None
+        if params.fleet_autoscale:
+            from hdbscan_tpu.fleet.controlplane import Autoscaler
+
+            scaler = Autoscaler(
+                router,
+                min_replicas=params.fleet_min_replicas,
+                max_replicas=params.fleet_max_replicas,
+                high_load=params.fleet_scale_high_load,
+                low_load=params.fleet_scale_low_load,
+                high_p99_s=params.fleet_scale_p99_s,
+                cooldown_s=params.fleet_scale_cooldown_s,
+            ).start()
+            print(
+                f"hdbscan-tpu fleet: autoscaler on "
+                f"[{params.fleet_min_replicas}, "
+                f"{params.fleet_max_replicas}] replicas",
+                file=sys.stderr,
+            )
+        try:
+            rc = router.serve_forever()
+        finally:
+            if scaler is not None:
+                scaler.stop()
     finally:
         tracer.close()
     if report_out is not None:

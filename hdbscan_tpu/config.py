@@ -401,6 +401,42 @@ class HDBSCANParams:
     #: burst = max(1, quota)); an over-quota request is refused with HTTP
     #: 429 + Retry-After. 0 = unlimited.
     tenant_quota_rps: float = 0.0
+    #: Fleet autoscaler (``fleet/controlplane.py``): when enabled the
+    #: ``fleet`` CLI runs the hysteresis loop over the router's queue-depth
+    #: and p99 signals, scaling between the min/max bounds with
+    #: warm-standby adds and drain-first removes.
+    fleet_autoscale: bool = False
+    #: Autoscaler lower bound on the replica set (>= 1).
+    fleet_min_replicas: int = 1
+    #: Autoscaler upper bound on the replica set (>= min).
+    fleet_max_replicas: int = 4
+    #: Per-up-replica in-flight requests above which an autoscaler tick
+    #: votes scale-up (hysteresis: 2 consecutive votes scale).
+    fleet_scale_high_load: float = 4.0
+    #: Per-up-replica in-flight requests below which a tick votes
+    #: scale-down (5 consecutive idle votes scale; must be < high).
+    fleet_scale_low_load: float = 0.5
+    #: Rolling fleet p99 (seconds) above which a tick votes scale-up and
+    #: vetoes scale-down. 0 disables the latency signal.
+    fleet_scale_p99_s: float = 0.0
+    #: Hold after any scale operation before the next decision, so the
+    #: fleet re-equilibrates on the new topology.
+    fleet_scale_cooldown_s: float = 2.0
+    #: Per-host zero-copy artifact store (``fleet/artifacts.py``):
+    #: "shared" loads tenant artifacts through the digest-keyed mmap spool
+    #: (one resident copy per host, shared across replicas); "off"
+    #: (default) keeps private per-registry loads.
+    fleet_artifact_store: str = "off"
+    #: Fit-as-a-service worker pool size (``fleet/jobs.py``): concurrent
+    #: background fits a scheduler runs.
+    fit_job_workers: int = 2
+    #: Bound on queued-but-not-running fit jobs; an overflowing submit is
+    #: refused with HTTP 503 semantics.
+    fit_job_queue_bound: int = 16
+    #: Sustained per-tenant fit-job rate (token bucket, burst 1); an
+    #: over-quota submit is refused with HTTP 429 + Retry-After.
+    #: 0 = unlimited.
+    fit_job_quota_rps: float = 0.0
     #: Minimum spacing between emitted ``heartbeat`` trace events per
     #: progress task (``hdbscan_tpu/obs`` — Borůvka rounds, ring panel
     #: sweeps, rpforest tree builds, refits). Beats arriving faster are
@@ -616,6 +652,50 @@ class HDBSCANParams:
                 "tenant_quota_rps must be >= 0 (0 = unlimited), "
                 f"got {self.tenant_quota_rps!r}"
             )
+        if self.fleet_min_replicas < 1:
+            raise ValueError(
+                f"fleet_min_replicas must be >= 1, got {self.fleet_min_replicas!r}"
+            )
+        if self.fleet_max_replicas < self.fleet_min_replicas:
+            raise ValueError(
+                "fleet_max_replicas must be >= fleet_min_replicas "
+                f"({self.fleet_min_replicas}), got {self.fleet_max_replicas!r}"
+            )
+        if not self.fleet_scale_high_load > self.fleet_scale_low_load:
+            raise ValueError(
+                "fleet_scale_high_load must exceed fleet_scale_low_load, "
+                f"got {self.fleet_scale_high_load!r} <= "
+                f"{self.fleet_scale_low_load!r}"
+            )
+        if self.fleet_scale_p99_s < 0:
+            raise ValueError(
+                "fleet_scale_p99_s must be >= 0 (0 = latency signal off), "
+                f"got {self.fleet_scale_p99_s!r}"
+            )
+        if self.fleet_scale_cooldown_s < 0:
+            raise ValueError(
+                "fleet_scale_cooldown_s must be >= 0, "
+                f"got {self.fleet_scale_cooldown_s!r}"
+            )
+        if self.fleet_artifact_store not in ("shared", "off"):
+            raise ValueError(
+                "fleet_artifact_store must be 'shared' or 'off', "
+                f"got {self.fleet_artifact_store!r}"
+            )
+        if self.fit_job_workers < 1:
+            raise ValueError(
+                f"fit_job_workers must be >= 1, got {self.fit_job_workers!r}"
+            )
+        if self.fit_job_queue_bound < 1:
+            raise ValueError(
+                "fit_job_queue_bound must be >= 1, "
+                f"got {self.fit_job_queue_bound!r}"
+            )
+        if self.fit_job_quota_rps < 0:
+            raise ValueError(
+                "fit_job_quota_rps must be >= 0 (0 = unlimited), "
+                f"got {self.fit_job_quota_rps!r}"
+            )
         if not self.heartbeat_s > 0:
             raise ValueError(
                 f"heartbeat_s must be > 0, got {self.heartbeat_s!r}"
@@ -757,6 +837,17 @@ FLAG_FIELDS = {
     "fleet_drain": ("fleet_drain_s", float),
     "tenant_lru": ("tenant_lru_size", int),
     "tenant_quota": ("tenant_quota_rps", float),
+    "autoscale": ("fleet_autoscale", _bool),
+    "fleet_min": ("fleet_min_replicas", int),
+    "fleet_max": ("fleet_max_replicas", int),
+    "scale_high_load": ("fleet_scale_high_load", float),
+    "scale_low_load": ("fleet_scale_low_load", float),
+    "scale_p99": ("fleet_scale_p99_s", float),
+    "scale_cooldown": ("fleet_scale_cooldown_s", float),
+    "artifact_store": ("fleet_artifact_store", str),
+    "fit_workers": ("fit_job_workers", int),
+    "fit_queue_bound": ("fit_job_queue_bound", int),
+    "fit_quota": ("fit_job_quota_rps", float),
     "heartbeat": ("heartbeat_s", float),
     "watchdog": ("watchdog_s", float),
     "trace_max_events": ("trace_max_events", int),

@@ -703,7 +703,10 @@ def assert_rounds_converged(
     if rounds < max_rounds or count >= max(n - 1, 0):
         return
     last_added = None
+    survivors = None
     tail = ""
+    if stat_comp is not None:
+        survivors = int(np.asarray(stat_comp)[max_rounds - 1])
     if stat_edges is not None:
         stat_edges = np.asarray(stat_edges)
         last_added = int(stat_edges[max_rounds - 1])
@@ -719,14 +722,22 @@ def assert_rounds_converged(
             f"; last {show} rounds: components={comps}, "
             f"edges_added={stat_edges[-show:].tolist()}"
         )
+    surviving = (
+        f"{survivors} components still unmerged"
+        if survivors is not None
+        # Without per-round stats the edge count still bounds the survivor
+        # count exactly: a forest with `count` edges over n vertices has
+        # n - count components.
+        else f"{max(n - count, 1)} components still unmerged (from edge count)"
+    )
     raise RuntimeError(
         f"{where}: Borůvka round cap hit without convergence — "
         f"{rounds} rounds (max_rounds={max_rounds}) emitted {count} of "
-        f"{max(n - 1, 0)} spanning edges and the loop was still merging"
-        f"{tail}. Borůvka halves components every round, so a capped exit "
-        f"indicates a contraction/scan defect (or NaN edge weights), not "
-        f"input size; rerun with a larger max_rounds only to gather "
-        f"diagnostics."
+        f"{max(n - 1, 0)} spanning edges with {surviving} and the loop "
+        f"was still merging{tail}. Borůvka halves components every round, "
+        f"so a capped exit indicates a contraction/scan defect (or NaN "
+        f"edge weights), not input size; rerun with a larger max_rounds "
+        f"only to gather diagnostics."
     )
 
 

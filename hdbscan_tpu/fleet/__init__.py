@@ -1,20 +1,34 @@
-"""Serving fleet: multi-replica router + multi-tenant model registry.
+"""Serving fleet: router, tenant registry, and the control plane.
 
-``FleetRouter`` (``fleet/router.py``) fronts N replica subprocesses on one
-asyncio accept loop with health-tracked consistent-hash / least-loaded
-routing and aggregated ``/metrics``; ``TenantRegistry``
+``FleetRouter`` (``fleet/router.py``) fronts a DYNAMIC set of replica
+subprocesses on one asyncio accept loop with health-tracked
+consistent-hash / least-loaded routing, aggregated ``/metrics``, and
+warm-standby scale-up / drain-first scale-down; ``TenantRegistry``
 (``fleet/tenants.py``) serves many models per replica behind an LRU of
 AOT-warmed Predictors with per-tenant generations, quotas, and SLO
-verdicts. See the README "Fleet" section for topology and the failure
-matrix.
+verdicts. The control plane rides on top: ``Autoscaler``
+(``fleet/controlplane.py``) drives the router's scale ops off its
+queue-depth/p99 signals, ``ArtifactStore`` (``fleet/artifacts.py``) maps
+each distinct artifact digest once per host, and ``FitScheduler``
+(``fleet/jobs.py``) runs fit-as-a-service jobs that publish through the
+per-tenant blue/green swap. See the README "Fleet" section for topology
+and the failure matrix.
 """
 
+from hdbscan_tpu.fleet.artifacts import ArtifactStore, default_store
+from hdbscan_tpu.fleet.controlplane import Autoscaler
+from hdbscan_tpu.fleet.jobs import FitJob, FitScheduler
 from hdbscan_tpu.fleet.router import POLICIES, FleetRouter
 from hdbscan_tpu.fleet.tenants import DEFAULT_TENANT_SLO, TenantRegistry
 
 __all__ = [
+    "ArtifactStore",
+    "Autoscaler",
+    "FitJob",
+    "FitScheduler",
     "FleetRouter",
     "TenantRegistry",
     "POLICIES",
     "DEFAULT_TENANT_SLO",
+    "default_store",
 ]

@@ -36,7 +36,18 @@ adds only placement and failure handling:
   the router's own instruments through ``MetricsRegistry.merge()``.
 
 Trace events: ``fleet_route`` per proxied request, ``replica_health`` per
-probe — both validated by ``scripts/check_trace.py``.
+probe, ``scale_event`` per scale operation — all validated by
+``scripts/check_trace.py``.
+
+Elasticity: the replica set is dynamic. :meth:`FleetRouter.scale_up`
+spawns a STANDBY replica — port reported, health probe green, AOT warmup
+done (warm-started from the shared persistent compile cache every replica
+env points at) — before the ring is rebuilt to include it, so the first
+routed request is full-speed. :meth:`FleetRouter.scale_down` removes the
+replica from the ring first, drains its in-flight requests, then runs the
+WAL-safe SIGTERM shutdown; a later scale-up reuses the lowest free rid and
+thus the departed replica's WAL directory. The decision loop driving these
+lives in ``fleet/controlplane.Autoscaler``, reading :meth:`signals`.
 
 Device pinning: on multi-chip hosts pass ``devices=N`` — replica ``i``
 gets ``TPU_VISIBLE_CHIPS``/``CUDA_VISIBLE_DEVICES`` set to ``i % N``
@@ -51,6 +62,7 @@ import bisect
 import hashlib
 import itertools
 import json
+import math
 import os
 import re
 import signal
@@ -59,6 +71,7 @@ import sys
 import tempfile
 import threading
 import time
+from collections import deque
 
 _TENANT_RE = re.compile(rb'"tenant"\s*:\s*(?:"((?:[^"\\]|\\.)*)"|(-?\d+))')
 
@@ -95,6 +108,7 @@ class _Replica:
         self.port_file = ""
         self.log_path = ""
         self.up = False
+        self.retired = False  # scaled down: never respawn
         self.failures = 0  # consecutive
         self.in_flight = 0
         self.restarts = 0
@@ -140,7 +154,8 @@ class FleetRouter:
                  devices: int | None = None, restart: bool = True,
                  startup_timeout_s: float = 180.0, proxy_timeout_s: float = 30.0,
                  run_dir: str | None = None, tracer=None, metrics=None,
-                 replica_trace_dir: str | None = None, verbose: bool = False):
+                 replica_trace_dir: str | None = None, verbose: bool = False,
+                 compile_cache: str | None = "auto"):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas!r}")
         if policy not in POLICIES:
@@ -183,13 +198,20 @@ class FleetRouter:
         # pid-qualified so several routers (tests) never collide in a trace.
         self._rids = itertools.count(1)
         self.verbose = bool(verbose)
+        # Every replica inherits the SAME persistent XLA compile cache dir
+        # (resolve_cache_dir honors the compile_cache knob / env / opt-out),
+        # so a respawned or scaled-up replica warm-starts: its AOT warmup
+        # replays compiles its siblings already paid for and reports
+        # jit_compiles == 0.
+        from hdbscan_tpu.utils.cache import resolve_cache_dir
+
+        self.compile_cache_dir = resolve_cache_dir(compile_cache)
         self.replicas = [_Replica(str(i)) for i in range(self.n_replicas)]
-        self._ring = sorted(
-            (_h(f"{r.rid}#{v}"), r.rid)
-            for r in self.replicas for v in range(_VNODES)
-        )
-        self._ring_keys = [h for h, _ in self._ring]
-        self._by_rid = {r.rid: r for r in self.replicas}
+        self._rebuild_ring()
+        # Rolling window of proxied-request walls — the p99 signal the
+        # autoscaler (fleet/controlplane.py) reads alongside queue depth.
+        self._lat = deque(maxlen=2048)
+        self._scaling = False  # one scale op at a time (loop-serialized)
         self._loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
         self._server: asyncio.AbstractServer | None = None
@@ -236,8 +258,29 @@ class FleetRouter:
             "Requests currently proxied to the replica.",
             ("replica",),
         )
+        self._m_scale = metrics.counter(
+            "hdbscan_tpu_scale_events_total",
+            "Fleet scale operations by direction and outcome.",
+            ("direction", "ok"),
+        )
+        self._m_replicas = metrics.gauge(
+            "hdbscan_tpu_fleet_replicas",
+            "Replicas currently in the routing set.",
+        )
+        self._m_replicas.set(float(len(self.replicas)))
 
     # -- replica lifecycle -------------------------------------------------
+
+    def _rebuild_ring(self) -> None:
+        """Recompute the consistent-hash ring and rid index from the
+        current replica set. Runs on the router's event loop (scale ops)
+        or before it exists (__init__), never concurrently with routing."""
+        self._ring = sorted(
+            (_h(f"{r.rid}#{v}"), r.rid)
+            for r in self.replicas for v in range(_VNODES)
+        )
+        self._ring_keys = [h for h, _ in self._ring]
+        self._by_rid = {r.rid: r for r in self.replicas}
 
     def _replica_cmd(self, r: _Replica) -> list:
         cmd = [
@@ -268,6 +311,8 @@ class FleetRouter:
         env = dict(os.environ)
         env.update(self.replica_env)
         env["HDBSCAN_TPU_REPLICA_ID"] = r.rid
+        if self.compile_cache_dir and "JAX_COMPILATION_CACHE_DIR" not in env:
+            env["JAX_COMPILATION_CACHE_DIR"] = self.compile_cache_dir
         if self.devices:
             ordinal = str(int(r.rid) % int(self.devices))
             platform = env.get("JAX_PLATFORMS", "")
@@ -491,6 +536,8 @@ class FleetRouter:
                     t0: float, req_id: str | None = None,
                     queue_s: float = 0.0, replied: bool = False) -> None:
         wall = time.perf_counter() - t0
+        if replied:
+            self._lat.append(wall)
         self._m_requests.inc(replica=rid, route=route, status=str(status))
         if self.tracer is not None:
             self.tracer(
@@ -509,6 +556,150 @@ class FleetRouter:
                     attempts=int(attempts), queue_s=round(queue_s, 9),
                     wall_s=round(wall, 9), replied=bool(replied),
                 )
+
+    # -- scaling -----------------------------------------------------------
+
+    def signals(self) -> dict:
+        """The autoscaler's inputs, from state the router already tracks:
+        total in-flight proxied requests (queue depth), the same per up
+        replica, and p50/p99 over the recent replied-request window."""
+        replicas = self.replicas
+        up = sum(1 for r in replicas if r.up)
+        in_flight = sum(r.in_flight for r in replicas)
+        lats = sorted(self._lat)
+        out = {
+            "replicas": len(replicas), "up": up,
+            "in_flight": in_flight,
+            "in_flight_per_up": in_flight / up if up else float(in_flight),
+            "window": len(lats),
+        }
+        for q, name in ((0.5, "p50_s"), (0.99, "p99_s")):
+            if lats:
+                rank = max(1, math.ceil(q * len(lats)))
+                out[name] = lats[rank - 1]
+        return out
+
+    def _free_rid(self) -> str:
+        """Lowest non-negative integer rid not in the routing set — a
+        scale-up after a scale-down reuses the departed replica's rid and
+        therefore its ``wal_root/r<id>`` directory, so acked writes that
+        replica WAL'd before draining replay into its successor."""
+        used = {int(r.rid) for r in self.replicas if r.rid.isdigit()}
+        rid = 0
+        while rid in used:
+            rid += 1
+        return str(rid)
+
+    def _emit_scale(self, direction: str, rid: str, ok: bool, reason: str,
+                    t0: float, error: str | None = None) -> None:
+        self._m_scale.inc(direction=direction, ok=str(ok).lower())
+        self._m_replicas.set(float(len(self.replicas)))
+        if self.tracer is not None:
+            fields = dict(
+                direction=direction, replica=str(rid),
+                replicas=len(self.replicas), reason=str(reason),
+                ok=bool(ok), wall_s=round(time.perf_counter() - t0, 6),
+            )
+            if error:
+                fields["error"] = str(error)[:300]
+            self.tracer("scale_event", **fields)
+
+    async def _scale_up_async(self, reason: str = "manual") -> str | None:
+        """Spawn one replica, warm it as a STANDBY (port + healthy probe —
+        its AOT warmup has completed before any traffic can route to it),
+        then add it to the ring. Returns the new rid, or None on failure
+        (the failed standby is killed; the routing set is unchanged)."""
+        if self._scaling:
+            return None
+        self._scaling = True
+        t0 = time.perf_counter()
+        r = _Replica(self._free_rid())
+        try:
+            self._spawn(r)
+            deadline = time.monotonic() + self.startup_timeout_s
+            await self._await_port(r, deadline)
+            while not r.up:
+                await self._check_one(r)
+                if r.up:
+                    break
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"standby replica {r.rid} not healthy within "
+                        f"{self.startup_timeout_s:.0f}s"
+                    )
+                await asyncio.sleep(0.1)
+            self.replicas = self.replicas + [r]
+            self._rebuild_ring()
+        except Exception as exc:
+            if r.proc is not None and r.alive():
+                r.proc.kill()
+            self._emit_scale("up", r.rid, False, reason, t0, error=str(exc))
+            return None
+        finally:
+            self._scaling = False
+        self._emit_scale("up", r.rid, True, reason, t0)
+        return r.rid
+
+    async def _scale_down_async(self, rid: str | None = None,
+                                reason: str = "manual") -> bool:
+        """Remove one replica: out of the ring first (no new dispatch),
+        drain its in-flight requests, then the WAL-safe SIGTERM shutdown.
+        Defaults to the highest-numbered replica (rid 0 is never chosen
+        implicitly, keeping the fleet's anchor stable). Refuses to drop
+        the last replica."""
+        if self._scaling or len(self.replicas) <= 1:
+            return False
+        self._scaling = True
+        t0 = time.perf_counter()
+        try:
+            if rid is None:
+                r = max(
+                    self.replicas,
+                    key=lambda x: int(x.rid) if x.rid.isdigit() else -1,
+                )
+            else:
+                r = self._by_rid.get(str(rid))
+                if r is None:
+                    return False
+            r.retired = True
+            self.replicas = [x for x in self.replicas if x is not r]
+            self._rebuild_ring()
+            deadline = time.monotonic() + self.drain_s
+            while r.in_flight > 0 and time.monotonic() < deadline:
+                await asyncio.sleep(0.02)
+            if r.alive():
+                r.proc.send_signal(signal.SIGTERM)
+            while r.alive() and time.monotonic() < deadline:
+                await asyncio.sleep(0.02)
+            ok = not r.alive()
+            if not ok:
+                r.proc.kill()
+            self._mark(r, False)
+            self._emit_scale("down", r.rid, ok, reason, t0,
+                             error=None if ok else "drain timeout; SIGKILLed")
+            return ok
+        finally:
+            self._scaling = False
+
+    def scale_up(self, reason: str = "manual",
+                 timeout: float | None = None) -> str | None:
+        """Thread-safe scale-up (see :meth:`_scale_up_async`)."""
+        if self._loop is None or self._shutdown.is_set():
+            return None
+        fut = asyncio.run_coroutine_threadsafe(
+            self._scale_up_async(reason), self._loop
+        )
+        return fut.result(timeout or self.startup_timeout_s + 10.0)
+
+    def scale_down(self, rid: str | None = None, reason: str = "manual",
+                   timeout: float | None = None) -> bool:
+        """Thread-safe scale-down (see :meth:`_scale_down_async`)."""
+        if self._loop is None or self._shutdown.is_set():
+            return False
+        fut = asyncio.run_coroutine_threadsafe(
+            self._scale_down_async(rid, reason), self._loop
+        )
+        return fut.result(timeout or self.drain_s + 10.0)
 
     # -- health ------------------------------------------------------------
 
@@ -547,7 +738,8 @@ class FleetRouter:
                 "replica_health", replica=r.rid, ok=bool(ok),
                 failures=int(r.failures), restarts=int(r.restarts),
             )
-        if not ok and not r.alive() and self.restart and not self._shutdown.is_set():
+        if (not ok and not r.alive() and self.restart and not r.retired
+                and not self._shutdown.is_set()):
             try:
                 await self._respawn(r)
             except RuntimeError:
@@ -579,6 +771,7 @@ class FleetRouter:
             },
             "requests": dict(self._requests),
             "health_interval_s": self.health_interval_s,
+            "signals": self.signals(),
         }
 
     # -- metrics aggregation ----------------------------------------------

@@ -98,12 +98,16 @@ class TenantRegistry:
         latency histogram register here.
       tracer: optional ``utils.tracing.Tracer`` for ``tenant_load`` /
         ``tenant_evict`` events (and each Predictor's ``predict_batch``).
+      artifact_store: optional ``fleet.artifacts.ArtifactStore`` — tenant
+        artifacts then load through the per-host digest-keyed store (one
+        mmap'd copy per host, re-warms after eviction are free) instead of
+        a private ``ClusterModel.load`` per registry.
     """
 
     def __init__(self, paths: dict | None = None, *, backend: str = "auto",
                  max_batch: int = 256, dtype=None, lru_size: int = 8,
                  quota_rps: float = 0.0, metrics=None, tracer=None,
-                 clock=time.monotonic):
+                 artifact_store=None, clock=time.monotonic):
         if lru_size < 1:
             raise ValueError(f"lru_size must be >= 1, got {lru_size!r}")
         if quota_rps < 0.0 or not math.isfinite(quota_rps):
@@ -115,6 +119,7 @@ class TenantRegistry:
         self.quota_rps = float(quota_rps)
         self.metrics = metrics
         self.tracer = tracer
+        self.artifact_store = artifact_store
         self._clock = clock
         self._lock = threading.RLock()
         self._paths: dict = dict(paths or {})
@@ -214,7 +219,10 @@ class TenantRegistry:
         from hdbscan_tpu.serve.predict import Predictor
 
         t0 = time.perf_counter()
-        model = ClusterModel.load(path)
+        if self.artifact_store is not None:
+            model = self.artifact_store.load(path)
+        else:
+            model = ClusterModel.load(path)
         kw = {} if self.dtype is None else {"dtype": self.dtype}
         predictor = Predictor(
             model, backend=self.backend, max_batch=self.max_batch,

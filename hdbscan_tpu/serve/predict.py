@@ -43,6 +43,7 @@ pattern).
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from functools import lru_cache
@@ -63,6 +64,9 @@ from hdbscan_tpu.ops.tiled import (
 #: (the scan's minimum row tile is 8 sublanes anyway, so a 1-row program
 #: would compute 8 rows regardless).
 _MIN_BUCKET = 8
+
+#: Process-unique predictor ids for predict_batch trace attribution.
+_PRED_IDS = itertools.count(1)
 
 #: Largest query row tile; buckets above it loop row tiles inside the scan.
 _MAX_ROW_TILE = 128
@@ -357,8 +361,11 @@ class Predictor:
         self._batch_seq = 0
         # Distinguishes predictors sharing one trace file (blue/green swaps
         # build a fresh Predictor per model generation): check_trace
-        # enforces monotonic batch_seq per (process, predictor).
-        self._pred_id = f"{id(self) & 0xFFFFFF:06x}"
+        # enforces monotonic batch_seq per (process, predictor). A counter,
+        # not id(self) — the allocator reuses a freed predictor's address
+        # under swap/eviction churn, which would alias two generations'
+        # batch_seq streams into one false regression.
+        self._pred_id = f"{next(_PRED_IDS):06x}"
 
         c1 = len(model.parent)
         anc = _ancestor_table(model.parent)
@@ -557,13 +564,23 @@ class Predictor:
     def warmup(self, with_membership: bool = False) -> dict:
         """AOT-compile every bucket (zeros through each shape, blocking), so
         steady-state serving never compiles. Returns ``{"buckets": [...],
-        "wall_s": float, "jit_compiles": int}`` — the compile count uses
-        ``utils/telemetry.compile_counter`` deltas (0 on a warm jit cache).
+        "wall_s": float, "jit_compiles": int, "cache_hits": int}``.
+
+        ``jit_compiles`` counts compiles this warmup actually PAID:
+        backend-compile events (``utils/telemetry.compile_counter``) minus
+        persistent-compile-cache hits (``cache_hit_counter``) — jax still
+        fires a backend-compile duration event when it deserializes a
+        cached executable, so the raw delta alone would make a warm spawn
+        look cold. A replica spawned by the fleet router with its siblings'
+        ``JAX_COMPILATION_CACHE_DIR`` reports ``jit_compiles == 0`` and
+        ``cache_hits > 0`` here (the scale-up warm-standby contract).
         """
-        from hdbscan_tpu.utils.telemetry import compile_counter
+        from hdbscan_tpu.utils.telemetry import cache_hit_counter, compile_counter
 
         counter = compile_counter()
+        hits = cache_hit_counter()
         before = counter()
+        hits_before = hits()
         t0 = time.perf_counter()
         d = self.model.data.shape[1]
         with self._lock:
@@ -574,10 +591,12 @@ class Predictor:
                     staged = self._stage(np.zeros((1, d)), bucket)
                     jax.block_until_ready(self._dispatch(staged, bucket, True))
         wall = time.perf_counter() - t0
+        cache_hits = hits() - hits_before
         info = {
             "buckets": list(self.buckets),
             "wall_s": round(wall, 6),
-            "jit_compiles": counter() - before,
+            "jit_compiles": max(0, counter() - before - cache_hits),
+            "cache_hits": cache_hits,
         }
         if self.tracer is not None:
             self.tracer("predict_warmup", **{**info, "wall_s": info["wall_s"]})

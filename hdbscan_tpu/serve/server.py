@@ -476,6 +476,14 @@ class ClusterServer:
             if isinstance(tenants, str):
                 from hdbscan_tpu.fleet.tenants import TenantRegistry
 
+                # Per-host zero-copy artifact store: with the knob on,
+                # tenant artifacts map through the digest-keyed spool
+                # (fleet/artifacts.py) shared by every replica on the host.
+                store = None
+                if str(knob("fleet_artifact_store", "off")) == "shared":
+                    from hdbscan_tpu.fleet.artifacts import default_store
+
+                    store = default_store(tracer=tracer, metrics=self.metrics)
                 self.tenants = TenantRegistry.from_dir(
                     tenants,
                     backend=self._backend_req,
@@ -484,6 +492,7 @@ class ClusterServer:
                     quota_rps=float(knob("tenant_quota_rps", 0.0)),
                     metrics=self.metrics,
                     tracer=tracer,
+                    artifact_store=store,
                 )
             else:
                 self.tenants = tenants

@@ -38,7 +38,49 @@ import time
 from hdbscan_tpu.fault import inject
 from hdbscan_tpu.fault.policy import backoff_s, retry_call
 
-__all__ = ["Refitter"]
+__all__ = ["Refitter", "fit_and_publish"]
+
+
+def fit_and_publish(points, params, path, *, fit_fn=None, tracer=None,
+                    seed: int = 0, compress: bool = True,
+                    fault_site: str = "refit_fit",
+                    publish_name: str = "refit_publish"):
+    """Fit ``points``, distill to a ClusterModel, and publish it atomically
+    at ``path`` — the shared core of :class:`Refitter` and the fleet's
+    fit-as-a-service workers (``fleet/jobs.py``).
+
+    The fit runs under the standard obs phases (``model_refit`` memory
+    phase + progress task); the save is wrapped in a bounded retry so a
+    transient publish error (an injected ``artifact_save`` fault, a busy
+    filesystem) doesn't waste minutes of fit wall. ``compress=False``
+    publishes an uncompressed artifact the per-host
+    ``fleet.artifacts.ArtifactStore`` can spool and mmap without a
+    decompression copy. Raises on failure; returns the published model.
+    """
+    from hdbscan_tpu import obs
+
+    if inject.maybe_fire(fault_site) is not None:
+        raise inject.InjectedFault(f"injected {fault_site} crash")
+    with obs.mem_phase("model_refit"), obs.task("model_refit", total=1):
+        if fit_fn is not None:
+            result = fit_fn(points, params)
+        else:
+            from hdbscan_tpu.models import hdbscan
+
+            result = hdbscan.fit(points, params)
+        model = result.to_cluster_model(points, params)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    # Only name the kwarg when deviating from the default: save-compatible
+    # duck types (test fakes, older model classes) predate ``compress``.
+    save = (lambda: model.save(path)) if compress else (
+        lambda: model.save(path, compress=False))
+    retry_call(
+        save,
+        attempts=3, base_s=0.05, cap_s=0.5, seed=seed,
+        retry_on=(OSError, inject.InjectedFault),
+        tracer=tracer, name=publish_name,
+    )
+    return model
 
 
 class Refitter:
@@ -158,29 +200,12 @@ class Refitter:
             self._m_failures.inc()
 
     def _worker(self, points, reason: str, seq: int) -> None:
-        from hdbscan_tpu import obs
-
         t0 = time.perf_counter()
         try:
-            if inject.maybe_fire("refit_fit") is not None:
-                raise inject.InjectedFault("injected refit_fit crash")
-            with obs.mem_phase("model_refit"), obs.task("model_refit", total=1):
-                if self.fit_fn is not None:
-                    result = self.fit_fn(points, self.params)
-                else:
-                    from hdbscan_tpu.models import hdbscan
-
-                    result = hdbscan.fit(points, self.params)
-                model = result.to_cluster_model(points, self.params)
-            os.makedirs(self.model_dir, exist_ok=True)
             path = os.path.join(self.model_dir, f"model_gen{seq:04d}.npz")
-            # The fit is minutes of work; don't discard it over a transient
-            # publish error (e.g. an injected artifact_save fault).
-            retry_call(
-                lambda: model.save(path),
-                attempts=3, base_s=0.05, cap_s=0.5, seed=seq,
-                retry_on=(OSError, inject.InjectedFault),
-                tracer=self.tracer, name="refit_publish",
+            model = fit_and_publish(
+                points, self.params, path,
+                fit_fn=self.fit_fn, tracer=self.tracer, seed=seq,
             )
         except Exception as exc:  # never let a bad refit kill serving
             self._record_failure(exc)

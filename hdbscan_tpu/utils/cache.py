@@ -18,6 +18,22 @@ import os
 _DEFAULT_DIR = os.path.expanduser("~/.cache/hdbscan_tpu_xla")
 
 
+def resolve_cache_dir(path: str | None = None) -> str | None:
+    """The on-disk cache directory the ``compile_cache`` knob resolves to,
+    or None when the cache is disabled — without importing jax or touching
+    its config. The fleet router uses this to point every replica's
+    ``JAX_COMPILATION_CACHE_DIR`` at the same directory, so a respawned or
+    scaled-up replica warm-starts from the compiles its siblings (and the
+    previous incarnation of itself) already paid for."""
+    if os.environ.get("HDBSCAN_TPU_NO_CACHE"):
+        return None
+    if path == "off":
+        return None
+    if path == "auto":
+        path = None
+    return path or os.environ.get("JAX_COMPILATION_CACHE_DIR") or _DEFAULT_DIR
+
+
 def enable_persistent_compilation_cache(path: str | None = None) -> str | None:
     """Enable jax's on-disk compile cache (idempotent). Returns the dir, or
     None when disabled.
@@ -27,15 +43,11 @@ def enable_persistent_compilation_cache(path: str | None = None) -> str | None:
     ``"auto"``/``None`` resolves JAX_COMPILATION_CACHE_DIR then the
     per-user default, and anything else is taken as the cache directory
     itself (created if missing)."""
-    if os.environ.get("HDBSCAN_TPU_NO_CACHE"):
+    path = resolve_cache_dir(path)
+    if path is None:
         return None
-    if path == "off":
-        return None
-    if path == "auto":
-        path = None
     import jax
 
-    path = path or os.environ.get("JAX_COMPILATION_CACHE_DIR") or _DEFAULT_DIR
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     # jax only persists compiles slower than ~1 s by default, which silently

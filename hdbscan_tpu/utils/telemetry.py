@@ -332,6 +332,9 @@ def build_report(
     spans = request_span_section(tracer)
     if spans is not None:
         report["request_spans"] = spans
+    controlplane = controlplane_section(tracer)
+    if controlplane is not None:
+        report["controlplane"] = controlplane
     watermarks = memory_watermark_section(tracer)
     if memory is not None or watermarks is not None:
         mem = dict(memory) if memory is not None else {}
@@ -681,6 +684,81 @@ def predict_latency_section(tracer: Tracer) -> dict | None:
     section["rows"] = rows
     if wall > 0:
         section["rows_per_s"] = round(rows / wall, 1)
+    return section
+
+
+def controlplane_section(tracer: Tracer) -> dict | None:
+    """The run report's ``controlplane`` section: fleet elasticity and
+    fit-as-a-service aggregates over ``scale_event`` / ``fit_job`` /
+    ``artifact_map`` events. ``scaling`` counts ups/downs (and failures)
+    by reason; ``fit_jobs`` counts terminal outcomes per tenant plus the
+    mean queue wait; ``artifacts`` reports load hit rate and the LAST
+    event's resident footprint (the store only grows within a process,
+    so last == high-water). None when the run had no control plane."""
+    scale = [e for e in tracer.events if e.name == "scale_event"]
+    jobs = [e for e in tracer.events if e.name == "fit_job"]
+    art = [e for e in tracer.events if e.name == "artifact_map"]
+    if not (scale or jobs or art):
+        return None
+    section: dict = {}
+    if scale:
+        reasons: dict = {}
+        for e in scale:
+            key = str(e.fields.get("reason", "unknown"))
+            reasons[key] = reasons.get(key, 0) + 1
+        section["scaling"] = {
+            "events": len(scale),
+            "up": sum(1 for e in scale if e.fields.get("direction") == "up"),
+            "down": sum(
+                1 for e in scale if e.fields.get("direction") == "down"
+            ),
+            "failed": sum(1 for e in scale if not e.fields.get("ok", True)),
+            "reasons": reasons,
+            "mean_wall_s": round(
+                sum(e.wall_s for e in scale) / len(scale), 6
+            ),
+        }
+    if jobs:
+        per_tenant: dict = {}
+        for e in jobs:
+            state = str(e.fields.get("state", ""))
+            if state not in ("published", "failed"):
+                continue
+            tenant = str(e.fields.get("tenant", "?"))
+            per_tenant.setdefault(tenant, {"published": 0, "failed": 0})
+            per_tenant[tenant][state] += 1
+        queued = [
+            float(e.fields["queued_s"]) for e in jobs if "queued_s" in e.fields
+        ]
+        section["fit_jobs"] = {
+            "events": len(jobs),
+            "published": sum(
+                1 for e in jobs if e.fields.get("state") == "published"
+            ),
+            "failed": sum(
+                1 for e in jobs if e.fields.get("state") == "failed"
+            ),
+            "tenants": per_tenant,
+        }
+        if queued:
+            section["fit_jobs"]["mean_queued_s"] = round(
+                sum(queued) / len(queued), 6
+            )
+    if art:
+        hits = sum(1 for e in art if e.fields.get("hit"))
+        per_digest = {}  # bytes is per-digest; total = sum over digests
+        for e in art:
+            per_digest[str(e.fields.get("digest", "?"))] = int(
+                e.fields.get("bytes", 0)
+            )
+        section["artifacts"] = {
+            "loads": len(art),
+            "hits": hits,
+            "misses": len(art) - hits,
+            "spooled": sum(1 for e in art if e.fields.get("spooled")),
+            "resident": int(art[-1].fields.get("resident", 0)),
+            "resident_bytes": sum(per_digest.values()),
+        }
     return section
 
 

@@ -77,6 +77,16 @@ HEADLINE = {
     "fused_forest_body_gflops_s_200k": "higher",
     "fused_forest_vs_unfused": "higher",
     "fused_forest_ai_flops_per_byte": "higher",
+    # Fleet control-plane leg (fleet/controlplane.py, README "Fleet
+    # control plane"): served p99 at 64 tenants under the ramp arrival
+    # profile with autoscaler churn, the per-tenant resident-set cost of
+    # the shared artifact store (the zero-copy story in one number:
+    # host RSS divided by tenant count, lower-better), and the store's
+    # load hit rate (higher-better — misses re-spool). Same cpu_smoke
+    # noise caveats as the other serving legs.
+    "fleet_controlplane_p99_ms_ramp_64t": "lower",
+    "fleet_rss_per_tenant_kb": "lower",
+    "fleet_artifact_hit_rate": "higher",
 }
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
@@ -148,6 +158,12 @@ def load_round(path: str) -> dict:
         if name == "fused_forest_body_gflops_s_200k":
             for comp in ("fused_forest_vs_unfused",
                          "fused_forest_ai_flops_per_byte"):
+                v = rec.get(comp)
+                if isinstance(v, (int, float)):
+                    metrics[comp] = float(v)
+        if name == "fleet_controlplane_p99_ms_ramp_64t":
+            for comp in ("fleet_rss_per_tenant_kb",
+                         "fleet_artifact_hit_rate"):
                 v = rec.get(comp)
                 if isinstance(v, (int, float)):
                     metrics[comp] = float(v)

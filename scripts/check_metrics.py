@@ -28,6 +28,14 @@ carry their required labels with integral non-negative values,
 ``hdbscan_tpu_replica_up`` is a per-replica 0/1 gauge, the
 in-flight/resident gauges never go negative, and
 ``hdbscan_tpu_tenant_predict_seconds`` is a histogram labelled by tenant.
+The control-plane families (README "Fleet control plane") ride the same
+table: ``hdbscan_tpu_scale_events_total`` is a counter labelled
+``direction``/``ok``, ``hdbscan_tpu_fit_jobs_total`` a counter labelled
+``tenant``/``state``, ``hdbscan_tpu_artifact_loads_total`` a counter
+labelled ``outcome``, and the fleet-size / artifact-residency / fit-job
+queue gauges (``hdbscan_tpu_fleet_replicas``,
+``hdbscan_tpu_artifact_resident[_bytes]``,
+``hdbscan_tpu_fit_jobs_queued``/``_running``) never go negative.
 The deep-observability families (README "Observability"):
 ``hdbscan_tpu_watchdog_stalls_total`` must be an integral non-negative
 counter, ``hdbscan_tpu_straggler_flags_total`` an integral non-negative
@@ -320,15 +328,20 @@ _FLEET_COUNTERS = {
     "hdbscan_tpu_tenant_requests_total": ("tenant", "outcome"),
     "hdbscan_tpu_tenant_evictions_total": ("tenant",),
     "hdbscan_tpu_tenant_loads_total": ("tenant",),
+    "hdbscan_tpu_scale_events_total": ("direction", "ok"),
+    "hdbscan_tpu_fit_jobs_total": ("tenant", "state"),
+    "hdbscan_tpu_artifact_loads_total": ("outcome",),
 }
 
 
 def _check_fleet_metrics(parsed, where: str) -> list:
-    """Fleet/tenant family contracts (fleet/router.py, fleet/tenants.py):
-    routing/health/tenant counters carry their required labels with
-    integral non-negative values, ``replica_up`` is a 0/1 gauge keyed by
-    replica, the in-flight/resident gauges never go negative, and the
-    per-tenant latency histogram carries a ``tenant`` label."""
+    """Fleet/tenant/control-plane family contracts (fleet/router.py,
+    fleet/tenants.py, fleet/artifacts.py, fleet/jobs.py): routing/health/
+    tenant/scaling/fit-job/artifact counters carry their required labels
+    with integral non-negative values, ``replica_up`` is a 0/1 gauge keyed
+    by replica, the in-flight/resident/fleet-size/queue gauges never go
+    negative, and the per-tenant latency histogram carries a ``tenant``
+    label."""
     errors: list = []
     types, samples = parsed["types"], parsed["samples"]
     for fam, want_labels in _FLEET_COUNTERS.items():
@@ -355,6 +368,11 @@ def _check_fleet_metrics(parsed, where: str) -> list:
         ("hdbscan_tpu_replica_up", True),
         ("hdbscan_tpu_replica_in_flight", False),
         ("hdbscan_tpu_tenant_resident", False),
+        ("hdbscan_tpu_fleet_replicas", False),
+        ("hdbscan_tpu_artifact_resident", False),
+        ("hdbscan_tpu_artifact_resident_bytes", False),
+        ("hdbscan_tpu_fit_jobs_queued", False),
+        ("hdbscan_tpu_fit_jobs_running", False),
     ):
         if fam in types and types[fam] != "gauge":
             errors.append(f"{where}: {fam} declared {types[fam]!r}, want gauge")

@@ -108,6 +108,25 @@ re-warm against a warmed bucket ladder — the zero-steady-state-recompile
 contract across evictions); ``tenant_evict`` a non-empty string
 ``tenant``, positive ``generation`` and non-negative
 ``resident``/``requests``.
+Control-plane events (``hdbscan_tpu/fleet`` controlplane/artifacts/jobs,
+README "Fleet control plane") add three schemas: ``scale_event`` must
+carry a ``direction`` in ``{up, down}``, a non-empty string ``replica``
+and ``reason``, a positive ``replicas`` (the routing-set size AFTER the
+operation) and a boolean ``ok`` (a failed scale-up leaves the set
+unchanged and reports its ``error``); ``artifact_map`` a non-empty string
+``digest``/``path``, boolean ``hit``/``spooled``, positive
+``resident``/``refs`` (the described digest is itself resident and
+referenced when its event fires), non-negative ``bytes``, and a ``hit``
+history per (process, digest) that is MISS-THEN-HITS — the first touch of
+a digest is always ``hit: false`` and every later touch ``hit: true``,
+because store entries live for the process lifetime and are never
+re-mapped; ``fit_job`` a non-empty string ``job``/``tenant``/``reason``,
+a ``state`` in ``{queued, running, published, failed}`` forming a state
+MACHINE per (process, job) — queued → running → published|failed, each
+visited exactly once, nothing after a terminal state — plus a positive
+``generation`` when present (published jobs), a finite non-negative
+``queued_s`` when present (running events), and a non-empty ``error`` on
+every failure.
 Deep-observability events (``hdbscan_tpu/obs``, README "Observability")
 add eight schemas: ``mem_sample`` must carry a non-empty string ``phase``,
 a ``source`` in ``{memory_stats, live_arrays}`` and non-negative integer
@@ -243,6 +262,8 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
     hb_progress: dict = {}  # per-(process, phase, task) heartbeat progress
     last_shard_round: dict = {}  # per-process (round, n_comp) Borůvka state
     last_tl_round: dict = {}  # per-(process, device, phase) timeline round
+    fit_job_state: dict = {}  # per-(process, job) fit_job state machine
+    artifact_seen: dict = {}  # per-process set of artifact_map digests
     # Rotated sets (``JsonlSink`` ``rotate_bytes``): when ``<path>.1``
     # exists, the pair is ONE logical trace — read the rotated file first,
     # then the live file, sharing every cross-event tracker so seq order,
@@ -489,6 +510,54 @@ def validate_trace(path: str) -> tuple[list[dict], list[str]]:
                 if stage in ("fleet_route", "replica_health", "tenant_load",
                              "tenant_evict"):
                     errors += _check_fleet(path, lineno, stage, ev)
+                # Control-plane invariants (fleet/controlplane.py,
+                # fleet/artifacts.py, fleet/jobs.py): per-event schemas in the
+                # helper; the fit-job state machine and the artifact
+                # first-touch-is-a-miss contract need cross-event state so
+                # they live in this loop.
+                if stage in ("scale_event", "artifact_map", "fit_job"):
+                    errors += _check_controlplane(path, lineno, stage, ev)
+                    if stage == "fit_job":
+                        state = ev.get("state")
+                        if state in ("queued", "running", "published",
+                                     "failed"):
+                            key = (proc, ev.get("job"))
+                            prev = fit_job_state.get(key)
+                            allowed = {
+                                None: ("queued",),
+                                "queued": ("running",),
+                                "running": ("published", "failed"),
+                                "published": (),
+                                "failed": (),
+                            }[prev]
+                            if state not in allowed:
+                                errors.append(
+                                    f"{path}:{lineno}: fit_job {ev.get('job')!r} "
+                                    f"state {state!r} illegal after {prev!r} — "
+                                    f"jobs run queued → running → "
+                                    f"published|failed exactly once"
+                                )
+                            fit_job_state[key] = state
+                    elif stage == "artifact_map":
+                        digest = ev.get("digest")
+                        if isinstance(digest, str) and digest:
+                            seen = artifact_seen.setdefault(proc, set())
+                            first = digest not in seen
+                            seen.add(digest)
+                            if first and ev.get("hit") is True:
+                                errors.append(
+                                    f"{path}:{lineno}: artifact_map digest "
+                                    f"{digest[:12]}… first touch claims hit — "
+                                    f"a process's first load of a digest is "
+                                    f"always a miss"
+                                )
+                            elif not first and ev.get("hit") is False:
+                                errors.append(
+                                    f"{path}:{lineno}: artifact_map digest "
+                                    f"{digest[:12]}… re-load claims miss — "
+                                    f"store entries live for the process "
+                                    f"lifetime, never re-mapped"
+                                )
                 # Sharded-fit invariants (parallel/shard.py): per-event schemas
                 # in the helper; the round-contiguity and component-contraction
                 # checks need cross-event state so they live in this loop.
@@ -1046,6 +1115,82 @@ def _check_fleet(path: str, lineno: int, stage: str, ev: dict) -> list[str]:
                         f"{where} {key}={ev.get(key)!r} not a "
                         f"non-negative int"
                     )
+    return errors
+
+
+def _check_controlplane(path: str, lineno: int, stage: str,
+                        ev: dict) -> list[str]:
+    """The three control-plane event schemas (fleet/router.py scaling,
+    fleet/artifacts.py, fleet/jobs.py)."""
+    errors: list[str] = []
+    where = f"{path}:{lineno}: {stage}"
+    if stage == "scale_event":
+        if ev.get("direction") not in ("up", "down"):
+            errors.append(
+                f"{where} direction={ev.get('direction')!r} not in (up, down)"
+            )
+        if not isinstance(ev.get("replica"), str) or not ev.get("replica"):
+            errors.append(f"{where} lacks a non-empty string 'replica'")
+        if not _pos_int(ev.get("replicas")):
+            errors.append(
+                f"{where} replicas={ev.get('replicas')!r} not a positive int"
+            )
+        if not isinstance(ev.get("reason"), str) or not ev.get("reason"):
+            errors.append(f"{where} lacks a non-empty string 'reason'")
+        if not isinstance(ev.get("ok"), bool):
+            errors.append(f"{where} ok={ev.get('ok')!r} not a bool")
+        if "error" in ev and (
+            not isinstance(ev.get("error"), str) or not ev.get("error")
+        ):
+            errors.append(f"{where} error={ev.get('error')!r} not a string")
+    elif stage == "artifact_map":
+        for key in ("digest", "path"):
+            if not isinstance(ev.get(key), str) or not ev.get(key):
+                errors.append(f"{where} lacks a non-empty string {key!r}")
+        for key in ("hit", "spooled"):
+            if not isinstance(ev.get(key), bool):
+                errors.append(f"{where} {key}={ev.get(key)!r} not a bool")
+        # The digest this event describes is resident (and referenced)
+        # when the event fires, on every path — hit, race loser, publish.
+        for key in ("resident", "refs"):
+            if not _pos_int(ev.get(key)):
+                errors.append(
+                    f"{where} {key}={ev.get(key)!r} not a positive int"
+                )
+        if not _nonneg_int(ev.get("bytes")):
+            errors.append(
+                f"{where} bytes={ev.get('bytes')!r} not a non-negative int"
+            )
+    else:  # fit_job
+        for key in ("job", "tenant", "reason"):
+            if not isinstance(ev.get(key), str) or not ev.get(key):
+                errors.append(f"{where} lacks a non-empty string {key!r}")
+        if ev.get("state") not in ("queued", "running", "published", "failed"):
+            errors.append(
+                f"{where} state={ev.get('state')!r} not in "
+                f"(queued, running, published, failed)"
+            )
+        if "generation" in ev and not _pos_int(ev.get("generation")):
+            errors.append(
+                f"{where} generation={ev.get('generation')!r} not a "
+                f"positive int"
+            )
+        queued_s = ev.get("queued_s")
+        if queued_s is not None and (
+            not isinstance(queued_s, (int, float))
+            or isinstance(queued_s, bool)
+            or not (queued_s >= 0.0 and math.isfinite(float(queued_s)))
+        ):
+            errors.append(
+                f"{where} queued_s={queued_s!r} not a finite non-negative "
+                f"number"
+            )
+        if ev.get("state") == "failed" and not (
+            isinstance(ev.get("error"), str) and ev.get("error")
+        ):
+            errors.append(
+                f"{where} failed without a non-empty string 'error'"
+            )
     return errors
 
 

@@ -16,6 +16,17 @@ Three legs:
   (faster than one health interval), the replica respawns and replays its
   WAL with zero acked-ingest loss, no request hangs, and
   served+shed+failed reconciles with offered.
+- **Elasticity** (README "Fleet control plane"): scale up from one
+  replica — the standby AOT-warms from the shared persistent compile
+  cache BEFORE ring admission (``warmup.jit_compiles == 0``,
+  ``cache_hits > 0`` on its /healthz), serves bitwise-identical answers,
+  then drains back down — with ``scale_event`` up+down in the trace and
+  the scale counters in /metrics.
+- **Fit-as-a-service**: four tenants served concurrently (through the
+  shared zero-copy ArtifactStore) while a FitScheduler runs REAL fits
+  and publishes generations through the registry's blue/green swap — a
+  poisoned job fails in its worker without touching serving, zero
+  request errors, no tenant ever observes a generation regression.
 """
 
 import json
@@ -316,3 +327,217 @@ def test_fleet_chaos_sigkill_reroutes_and_replays_wal(fleet_model, tmp_path):
     probes = [e for e in events if e["stage"] == "replica_health"]
     assert any(not e["ok"] for e in probes)  # the probe saw the corpse
     assert any(e["restarts"] >= 1 for e in probes)
+
+
+def test_fleet_scale_up_warm_spawn_and_scale_down(fleet_model, tmp_path):
+    """Elasticity round trip over real subprocesses: the scaled-up standby
+    replays replica 0's compiles from the router-injected persistent cache
+    (warm-spawn ``jit_compiles == 0`` — the control plane's AOT-warm
+    contract), is admitted to the ring only after a healthy probe, serves
+    the same bits, and scale-down drains it without dropping requests."""
+    model_path, pts = fleet_model
+    trace = str(tmp_path / "scale.jsonl")
+    tracer = Tracer(sinks=[JsonlSink(trace)])
+    cache_dir = str(tmp_path / "xla-cache")
+    X = pts[:16].tolist()
+    router = FleetRouter(
+        model_path, replicas=1, policy="least_loaded",
+        health_interval_s=0.3, compile_cache=cache_dir,
+        replica_args=["predict_batch=64"], tracer=tracer,
+    )
+    with router:
+        base = f"http://{router.host}:{router.port}"
+        status, _, want = _post(base, "/predict", {"points": X})
+        assert status == 200
+
+        # replica 0 spawned cold: its AOT warmup PAID compiles and seeded
+        # the shared cache directory every later spawn inherits
+        h0 = json.loads(_get(
+            f"http://127.0.0.1:{router.replicas[0].port}", "/healthz"
+        ))
+        assert h0["warmup"]["jit_compiles"] > 0, h0["warmup"]
+        assert os.listdir(cache_dir), "replica 0 never seeded the cache"
+
+        rid = router.scale_up(reason="manual", timeout=180)
+        assert rid == "1"
+        assert [r.rid for r in router.replicas] == ["0", "1"]
+        assert router.health()["replicas"]["1"]["up"] is True
+
+        # the warm-spawn contract: the standby's warmup was served
+        # entirely from the persistent cache — zero compiles paid
+        h1 = json.loads(_get(
+            f"http://127.0.0.1:{router.replicas[1].port}", "/healthz"
+        ))
+        assert h1["warmup"]["jit_compiles"] == 0, h1["warmup"]
+        assert h1["warmup"]["cache_hits"] > 0, h1["warmup"]
+        assert h1["warmup"]["buckets"] == h0["warmup"]["buckets"]
+
+        # the admitted standby answers bitwise the same as the anchor
+        status, _, out = _post(
+            f"http://127.0.0.1:{router.replicas[1].port}", "/predict",
+            {"points": X},
+        )
+        assert status == 200
+        for k in ("labels", "probabilities", "outlier_scores"):
+            assert out[k] == want[k], f"standby diverged on {k}"
+
+        # under concurrent load, least_loaded spills onto the new replica
+        # (sequential idle requests always tie-break to rid 0)
+        served_by = set()
+        burst_errors = []
+        lock = threading.Lock()
+
+        def one_shot():
+            status, headers, out = _post(base, "/predict", {"points": X})
+            with lock:
+                if status != 200 or out["labels"] != want["labels"]:
+                    burst_errors.append((status, out))
+                else:
+                    served_by.add(headers["x-replica"])
+
+        burst = [threading.Thread(target=one_shot) for _ in range(16)]
+        for th in burst:
+            th.start()
+        for th in burst:
+            th.join(timeout=60)
+        assert burst_errors == [], burst_errors[:3]
+        assert served_by == {"0", "1"}, served_by
+
+        # scale counters surfaced through the aggregated scrape
+        scrape = _get(base, "/metrics")
+        parsed, errors = check_metrics.validate_exposition(scrape, "fleet")
+        assert errors == [], errors
+        ups = sum(
+            v for (name, labels), v in parsed["samples"].items()
+            if name == "hdbscan_tpu_scale_events_total"
+            and dict(labels) == {"direction": "up", "ok": "true"}
+        )
+        assert ups == 1
+
+        assert router.scale_down(rid, reason="manual", timeout=60) is True
+        assert [r.rid for r in router.replicas] == ["0"]
+        status, headers, out = _post(base, "/predict", {"points": X})
+        assert status == 200 and headers["x-replica"] == "0"
+        # the last replica is an anchor: scale-down refuses to drop it
+        assert router.scale_down(reason="anchor", timeout=60) is False
+    assert router.drain_ok is True
+    tracer.close()
+
+    events, errors = check_trace.validate_trace(trace)
+    assert not errors, errors
+    scales = [e for e in events if e["stage"] == "scale_event"]
+    ups = [e for e in scales if e["direction"] == "up"]
+    downs = [e for e in scales if e["direction"] == "down"]
+    assert len(ups) == 1 and ups[0]["ok"] and ups[0]["replicas"] == 2
+    assert len(downs) == 1 and downs[0]["ok"] and downs[0]["replicas"] == 1
+    assert ups[0]["replica"] == downs[0]["replica"] == "1"
+
+
+def test_fit_as_a_service_four_tenants_no_mixed_generations(
+        fleet_model, tmp_path):
+    """Fit-as-a-service over a live multi-tenant registry: REAL fits
+    publish new generations through the blue/green swap while four
+    tenants are hammered concurrently — zero request errors, per-thread
+    generation observations never regress, and a poisoned job fails in
+    its worker without touching its tenant's serving path."""
+    from hdbscan_tpu.fleet import ArtifactStore, FitScheduler, TenantRegistry
+    from hdbscan_tpu.fleet.jobs import ShedRequest  # noqa: F401 (contract)
+
+    model_path, pts = fleet_model
+    trace = str(tmp_path / "fitsvc.jsonl")
+    tracer = Tracer(sinks=[JsonlSink(trace)])
+    tenants = [f"t{i}" for i in range(4)]
+    params = HDBSCANParams(
+        min_points=5, min_cluster_size=25, processing_units=512,
+    )
+    store = ArtifactStore(spool_dir=str(tmp_path / "spool"), tracer=tracer)
+    reg = TenantRegistry(
+        {t: model_path for t in tenants}, max_batch=64, lru_size=4,
+        tracer=tracer, artifact_store=store,
+    )
+    sched = FitScheduler(
+        str(tmp_path / "models"), params=params,
+        publish=lambda tenant, path, model: reg.swap(tenant, path),
+        workers=2, tracer=tracer,
+    )
+    X = pts[:16]
+    for t in tenants:
+        reg.predict(t, X)  # warm every tenant before the churn
+
+    errors_seen = []
+    seen_gens = {(t, w): [] for t in tenants for w in range(3)}
+    stop = threading.Event()
+
+    def hammer(w):
+        rng = np.random.default_rng(w)
+        while not stop.is_set():
+            t = tenants[rng.integers(len(tenants))]
+            try:
+                out, info = reg.predict(t, X)
+                assert info["tenant"] == t and len(out[0]) == 16
+                seen_gens[(t, w)].append(info["generation"])
+            except Exception as exc:  # noqa: BLE001 — the assertion target
+                errors_seen.append(f"{t}: {type(exc).__name__}: {exc}")
+            time.sleep(0.002)
+
+    threads = [
+        threading.Thread(target=hammer, args=(w,), daemon=True)
+        for w in range(3)
+    ]
+    for th in threads:
+        th.start()
+    try:
+        # one real refit per tenant, concurrent with serving
+        rng = np.random.default_rng(29)
+        jobs = []
+        for t in tenants:
+            fresh = CENTERS[np.arange(360) % 3] + rng.normal(0, 0.25, (360, 3))
+            jobs.append(sched.submit(t, fresh, reason="drift"))
+        # the poison: un-fittable rows crash INSIDE the worker
+        poison = sched.submit("t0", np.array([["nope"] * 3] * 360))
+        assert sched.join(timeout=600) is True
+        time.sleep(0.3)  # let the hammers observe the published generations
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(timeout=30)
+        sched.close()
+    assert not any(th.is_alive() for th in threads), "a predict hung"
+    assert errors_seen == [], errors_seen[:5]
+
+    # every good job published; the poison failed without collateral
+    for j in jobs:
+        assert j.state == "published" and j.generation == 2, (
+            j.job_id, j.state, j.error,
+        )
+    assert poison.state == "failed" and poison.error
+    assert sched.stats()["published"] == 4 and sched.stats()["failed"] == 1
+    # t0 still serves, on the GOOD generation the poison never displaced
+    out, info = reg.predict("t0", X)
+    assert info["generation"] == reg.generation("t0") >= 2
+    assert len(out[0]) == 16
+
+    # no thread ever saw a tenant's generation move backwards, and every
+    # tenant's final observation is the post-swap generation
+    for (t, w), gens in seen_gens.items():
+        assert gens == sorted(gens), f"{t} regressed in thread {w}: {gens}"
+    for t in tenants:
+        finals = [gens[-1] for (tt, _), gens in seen_gens.items()
+                  if tt == t and gens]
+        assert finals and max(finals) >= 2, (t, finals)
+    tracer.close()
+
+    events, errors = check_trace.validate_trace(trace)
+    assert not errors, errors
+    fit_events = [e for e in events if e["stage"] == "fit_job"]
+    published = [e for e in fit_events if e["state"] == "published"]
+    failed = [e for e in fit_events if e["state"] == "failed"]
+    assert len(published) == 4
+    assert {e["tenant"] for e in published} == set(tenants)
+    assert all(e["generation"] == 2 for e in published)
+    assert len(failed) == 1 and failed[0]["tenant"] == "t0"
+    # the shared store absorbed the tenant fan-out: the seed artifact
+    # loaded once (miss) and every other tenant's first touch was a hit
+    arts = [e for e in events if e["stage"] == "artifact_map"]
+    assert sum(1 for e in arts if not e["hit"]) >= 1
+    assert sum(1 for e in arts if e["hit"]) >= 3

@@ -1,0 +1,485 @@
+"""Fleet control plane (``hdbscan_tpu/fleet/``, README "Fleet control
+plane") — the autoscaler's decision discipline, the zero-copy artifact
+store, and the fit-as-a-service scheduler, tested without real replicas:
+
+- ``Autoscaler.decide`` is a pure hysteresis machine: ``up_after``
+  consecutive hot ticks to grow, ``down_after`` idle ticks to shrink,
+  any contrary tick resets the streak, and min/max bounds veto;
+- ``Autoscaler.tick`` holds through the cooldown window and counts what
+  it actually attempted against a fake router;
+- ``ArtifactStore`` keys by content digest (two byte-identical artifacts
+  share one mapping), returns the same object on re-hit, survives a
+  corrupted spool by falling back to ``ClusterModel.load``, and emits
+  the miss-then-hits ``artifact_map`` history ``check_trace.py`` pins;
+- ``FitScheduler`` walks queued → running → published|failed exactly
+  once per job, publishes through the caller's callback, sheds over-quota
+  (429) and over-bound (503) submits, and isolates a failed fit from both
+  serving and its worker thread;
+- the router's scaling seams: ``signals()`` reflects in-flight and the
+  latency window, ``_free_rid`` reuses the lowest departed rid (WAL
+  continuity), and ``_replica_environ`` injects the persistent compile
+  cache dir for warm standby spawns.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hdbscan_tpu import HDBSCANParams
+from hdbscan_tpu.fault.policy import ShedRequest
+from hdbscan_tpu.fleet import ArtifactStore, Autoscaler, FitScheduler, FleetRouter
+from hdbscan_tpu.fleet.artifacts import file_digest
+
+
+class _ListTracer:
+    def __init__(self):
+        self.events = []
+
+    def __call__(self, stage, **fields):
+        self.events.append({"stage": stage, **fields})
+
+
+# -- Autoscaler.decide ---------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        {"min_replicas": 0},
+        {"min_replicas": 3, "max_replicas": 2},
+        {"high_load": 1.0, "low_load": 1.0},
+        {"up_after": 0},
+        {"interval_s": 0.0},
+        {"cooldown_s": -1.0},
+    ],
+)
+def test_autoscaler_rejects_bad_knobs(kw):
+    with pytest.raises(ValueError):
+        Autoscaler(router=None, **kw)
+
+
+def _scaler(**kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("high_load", 4.0)
+    kw.setdefault("low_load", 0.5)
+    kw.setdefault("up_after", 2)
+    kw.setdefault("down_after", 3)
+    return Autoscaler(router=None, **kw)
+
+
+def _sig(replicas=2, load=1.0, p99=None):
+    out = {"replicas": replicas, "in_flight_per_up": load}
+    if p99 is not None:
+        out["p99_s"] = p99
+    return out
+
+
+def test_decide_needs_consecutive_hot_ticks():
+    s = _scaler(up_after=3)
+    assert s.decide(_sig(load=9.0)) is None
+    assert s.decide(_sig(load=9.0)) is None
+    assert s.decide(_sig(load=9.0)) == ("up", "queue_depth")
+    # streak resets after firing
+    assert s.decide(_sig(load=9.0)) is None
+
+
+def test_decide_contrary_tick_resets_streak():
+    s = _scaler(up_after=2)
+    assert s.decide(_sig(load=9.0)) is None
+    assert s.decide(_sig(load=1.0)) is None  # cool tick wipes the vote
+    assert s.decide(_sig(load=9.0)) is None
+    assert s.decide(_sig(load=9.0)) == ("up", "queue_depth")
+
+
+def test_decide_down_is_slower_and_bounded():
+    s = _scaler(down_after=3)
+    assert s.decide(_sig(load=0.0)) is None
+    assert s.decide(_sig(load=0.0)) is None
+    assert s.decide(_sig(load=0.0)) == ("down", "idle")
+    # at min_replicas the idle fleet never shrinks further
+    for _ in range(10):
+        assert s.decide(_sig(replicas=1, load=0.0)) is None
+
+
+def test_decide_respects_max_replicas():
+    s = _scaler(up_after=1)
+    for _ in range(5):
+        assert s.decide(_sig(replicas=4, load=9.0)) is None
+
+
+def test_decide_p99_signal_votes_up_and_vetoes_down():
+    s = _scaler(up_after=2, high_p99_s=0.2)
+    assert s.decide(_sig(load=1.0, p99=0.5)) is None
+    assert s.decide(_sig(load=1.0, p99=0.5)) == ("up", "p99")
+    # a hot p99 vetoes the idle vote even when load is low
+    s2 = _scaler(down_after=1, high_p99_s=0.2)
+    assert s2.decide(_sig(load=0.0, p99=0.5)) is None  # up-vote instead
+    s3 = _scaler(down_after=1, high_p99_s=0.0)  # latency signal disabled
+    assert s3.decide(_sig(load=0.0, p99=0.5)) == ("down", "idle")
+
+
+class _FakeScalingRouter:
+    def __init__(self, replicas=2, load=9.0):
+        self.replicas = list(range(replicas))
+        self.load = load
+        self.ups = 0
+        self.downs = 0
+
+    def signals(self):
+        return {"replicas": len(self.replicas),
+                "in_flight_per_up": self.load}
+
+    def scale_up(self, reason="manual", timeout=None):
+        self.ups += 1
+        self.replicas.append(len(self.replicas))
+        return str(len(self.replicas) - 1)
+
+    def scale_down(self, rid=None, reason="manual", timeout=None):
+        self.downs += 1
+        self.replicas.pop()
+        return True
+
+
+def test_tick_scales_and_holds_through_cooldown():
+    router = _FakeScalingRouter(replicas=2, load=9.0)
+    s = Autoscaler(router, up_after=1, cooldown_s=30.0)
+    assert s.tick(now=0.0) == ("up", "queue_depth")
+    assert router.ups == 1 and s.scaled_up == 1
+    # cooldown: the very next tick is a no-op even though load is hot
+    assert s.tick(now=0.0) is None
+    assert router.ups == 1
+    # after the hold expires, ticks act again
+    s._hold_until = 0.0
+    assert s.tick(now=0.0) == ("up", "queue_depth")
+    assert router.ups == 2
+
+
+def test_tick_scales_down_idle_fleet():
+    router = _FakeScalingRouter(replicas=3, load=0.0)
+    s = Autoscaler(router, down_after=2, cooldown_s=0.0)
+    assert s.tick(now=0.0) is None
+    assert s.tick(now=0.0) == ("down", "idle")
+    assert router.downs == 1 and s.scaled_down == 1
+
+
+def test_autoscaler_loop_grows_to_min_replicas():
+    router = _FakeScalingRouter(replicas=1, load=1.0)
+    s = Autoscaler(router, min_replicas=3, interval_s=0.05)
+    s.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while len(router.replicas) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        s.stop()
+    assert len(router.replicas) == 3
+    assert s.stats()["scaled_up"] == 2
+    assert s.stats()["running"] is False
+
+
+# -- ArtifactStore -------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    """One real fit, saved twice (byte-identical) for digest-dedup tests."""
+    from hdbscan_tpu.models import hdbscan
+    from hdbscan_tpu.serve.artifact import ClusterModel
+
+    rng = np.random.default_rng(7)
+    data = np.vstack(
+        [rng.normal(c, 0.2, size=(60, 3)) for c in (0.0, 3.0, 6.0)]
+    )
+    params = HDBSCANParams(min_points=8, min_cluster_size=8)
+    result = hdbscan.fit(data, params)
+    model = ClusterModel.from_fit_result(result, data, params)
+    root = tmp_path_factory.mktemp("artifacts")
+    a = model.save(str(root / "acme.npz"), compress=False)
+    b = model.save(str(root / "globex.npz"), compress=False)
+    return data, params, model, a, b
+
+
+def test_store_dedups_byte_identical_artifacts(saved_model, tmp_path):
+    data, _, _, a, b = saved_model
+    assert file_digest(a) == file_digest(b)
+    tracer = _ListTracer()
+    store = ArtifactStore(spool_dir=str(tmp_path / "spool"), tracer=tracer)
+    m1 = store.load(a)
+    m2 = store.load(b)  # same digest, different path: shared entry
+    m3 = store.load(a)
+    assert m1 is m2 and m1 is m3
+    np.testing.assert_allclose(np.asarray(m1.data), data)
+    # the miss-then-hits contract check_trace.py pins
+    hits = [e["hit"] for e in tracer.events if e["stage"] == "artifact_map"]
+    assert hits == [False, True, True]
+    assert tracer.events[0]["spooled"] is True
+    stats = store.stats()
+    assert stats["resident"] == 1 and stats["resident_bytes"] > 0
+    assert stats["refs"][file_digest(a)] == 3
+
+
+def test_store_mmaps_spooled_members(saved_model, tmp_path):
+    *_, a, _ = saved_model
+    store = ArtifactStore(spool_dir=str(tmp_path / "spool"))
+    model = store.load(a)
+    arr = np.asarray(model.data)
+    assert isinstance(arr, np.memmap) or isinstance(arr.base, np.memmap)
+    # mmap=False materializes but still caches per digest
+    store2 = ArtifactStore(spool_dir=str(tmp_path / "spool2"), mmap=False)
+    arr2 = np.asarray(store2.load(a).data)
+    assert not isinstance(arr2, np.memmap)
+
+
+def test_store_second_instance_reuses_spool(saved_model, tmp_path):
+    """A sibling replica process (modelled by a second store over the same
+    spool_dir) maps the published spool instead of re-parsing the .npz."""
+    *_, a, _ = saved_model
+    spool = str(tmp_path / "spool")
+    first = ArtifactStore(spool_dir=spool)
+    first.load(a)
+    tracer = _ListTracer()
+    second = ArtifactStore(spool_dir=spool, tracer=tracer)
+    model = second.load(a)
+    ev = tracer.events[0]
+    assert ev["hit"] is False  # its own process cache was cold
+    assert ev["spooled"] is False  # but the host spool already existed
+    assert model.summary()["n_train"] == 180
+
+
+def test_store_corrupt_spool_falls_back_to_npz(saved_model, tmp_path):
+    *_, a, _ = saved_model
+    spool = str(tmp_path / "spool")
+    ArtifactStore(spool_dir=spool).load(a)
+    # mangle one spooled member; a fresh store must not serve it
+    member = os.path.join(spool, file_digest(a), "data.npy")
+    with open(member, "wb") as f:
+        f.write(b"not a npy file")
+    tracer = _ListTracer()
+    store = ArtifactStore(spool_dir=spool, tracer=tracer)
+    model = store.load(a)  # falls back to ClusterModel.load, no raise
+    np.testing.assert_allclose(
+        np.asarray(model.data), np.asarray(saved_model[0])
+    )
+    assert tracer.events[0]["hit"] is False
+
+
+def test_store_raises_on_missing_artifact(tmp_path):
+    store = ArtifactStore(spool_dir=str(tmp_path / "spool"))
+    with pytest.raises(OSError):
+        store.load(str(tmp_path / "missing.npz"))
+
+
+# -- FitScheduler --------------------------------------------------------------
+
+
+class _FakeFitModel:
+    """Stands in for ClusterModel: save() writes a real file."""
+
+    generation = None
+
+    def save(self, path, compress=True):
+        with open(path, "wb") as f:
+            f.write(b"model-bytes")
+        return path
+
+
+class _FakeFitResult:
+    def to_cluster_model(self, points, params):
+        return _FakeFitModel()
+
+
+def _fake_fit(points, params, trace=None):
+    if points is None:
+        raise ValueError("no points")
+    return _FakeFitResult()
+
+
+@pytest.mark.parametrize(
+    "kw", [{"workers": 0}, {"queue_bound": 0}, {"quota_rps": -1.0}]
+)
+def test_scheduler_rejects_bad_knobs(tmp_path, kw):
+    with pytest.raises(ValueError):
+        FitScheduler(str(tmp_path), fit_fn=_fake_fit, **kw)
+
+
+def test_scheduler_publishes_through_callback(tmp_path):
+    tracer = _ListTracer()
+    published = []
+
+    class _Entry:
+        generation = 7
+
+    sched = FitScheduler(
+        str(tmp_path), fit_fn=_fake_fit, tracer=tracer,
+        publish=lambda t, p, m: published.append((t, p)) or _Entry(),
+    )
+    try:
+        job = sched.submit("acme", np.zeros((4, 3)), reason="drift")
+        assert job.wait(30.0)
+        assert job.state == "published"
+        assert job.generation == 7
+        assert job.path.endswith("acme_gen0001.npz")
+        assert os.path.exists(job.path)
+        assert published == [("acme", job.path)]
+        states = [e["state"] for e in tracer.events
+                  if e["stage"] == "fit_job" and e["job"] == job.job_id]
+        assert states == ["queued", "running", "published"]
+        run_ev = [e for e in tracer.events if e.get("state") == "running"][0]
+        assert run_ev["queued_s"] >= 0.0
+        # a second job for the same tenant gets the next generation
+        job2 = sched.submit("acme", np.zeros((4, 3)))
+        assert job2.wait(30.0)
+        assert job2.path.endswith("acme_gen0002.npz")
+        assert sched.stats()["published"] == 2
+    finally:
+        sched.close()
+
+
+def test_scheduler_failed_fit_is_isolated(tmp_path):
+    tracer = _ListTracer()
+    results = []
+    sched = FitScheduler(
+        str(tmp_path), fit_fn=_fake_fit, tracer=tracer,
+        on_result=lambda ok, err: results.append((ok, err)),
+    )
+    try:
+        bad = sched.submit("acme", None)  # _fake_fit raises on None
+        assert bad.wait(30.0)
+        assert bad.state == "failed"
+        assert "no points" in bad.error
+        assert results == [(False, bad.error)]
+        fail_ev = [e for e in tracer.events if e.get("state") == "failed"][0]
+        assert fail_ev["error"] == bad.error
+        # the worker survived: a good job still completes
+        good = sched.submit("acme", np.zeros((2, 3)))
+        assert good.wait(30.0) and good.state == "published"
+        assert results[-1] == (True, None)
+        assert sched.stats()["failed"] == 1
+    finally:
+        sched.close()
+
+
+def test_scheduler_quota_sheds_429(tmp_path):
+    clock = [100.0]
+    sched = FitScheduler(
+        str(tmp_path), fit_fn=_fake_fit, quota_rps=1.0,
+        clock=lambda: clock[0],
+    )
+    try:
+        sched.submit("acme", np.zeros((2, 3)))  # burst token spent
+        with pytest.raises(ShedRequest) as exc:
+            sched.submit("acme", np.zeros((2, 3)))
+        assert exc.value.status == 429
+        assert exc.value.reason == "fit_quota"
+        assert exc.value.retry_after_s > 0.0
+        sched.submit("globex", np.zeros((2, 3)))  # per-tenant: unaffected
+        clock[0] += 1.0  # refill buys the next job
+        sched.submit("acme", np.zeros((2, 3)))
+        assert sched.stats()["shed"] == 1
+    finally:
+        sched.close()
+
+
+def test_scheduler_full_queue_sheds_503(tmp_path):
+    release = threading.Event()
+
+    def _slow_fit(points, params, trace=None):
+        release.wait(30.0)
+        return _FakeFitResult()
+
+    sched = FitScheduler(
+        str(tmp_path), fit_fn=_slow_fit, workers=1, queue_bound=1,
+    )
+    try:
+        first = sched.submit("t0", np.zeros((2, 3)))  # occupies the worker
+        deadline = time.monotonic() + 5.0
+        while first.state == "queued" and time.monotonic() < deadline:
+            time.sleep(0.01)
+        sched.submit("t1", np.zeros((2, 3)))  # fills the queue
+        with pytest.raises(ShedRequest) as exc:
+            sched.submit("t2", np.zeros((2, 3)))
+        assert exc.value.status == 503
+        assert exc.value.reason == "fit_queue_full"
+    finally:
+        release.set()
+        sched.close()
+    assert sched.join(0.0) or True  # close drained; no hang
+
+
+def test_scheduler_close_rejects_submit(tmp_path):
+    sched = FitScheduler(str(tmp_path), fit_fn=_fake_fit)
+    sched.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        sched.submit("t0", np.zeros((2, 3)))
+
+
+# -- router scaling seams ------------------------------------------------------
+
+
+def _router(**kw):
+    kw.setdefault("replicas", 2)
+    return FleetRouter("/nonexistent/model.npz", **kw)
+
+
+def test_router_signals_reflect_inflight_and_latency_window():
+    router = _router(replicas=2)
+    r0, r1 = router.replicas
+    router._mark(r0, True)
+    sig = router.signals()
+    assert sig["replicas"] == 2 and sig["up"] == 1
+    assert sig["window"] == 0 and "p99_s" not in sig
+    r0.in_flight = 3
+    router._lat.extend([0.01] * 98 + [0.5, 0.5])
+    sig = router.signals()
+    assert sig["in_flight"] == 3 and sig["in_flight_per_up"] == 3.0
+    assert sig["p99_s"] == 0.5 and sig["p50_s"] == 0.01
+
+
+def test_router_free_rid_reuses_lowest_gap():
+    router = _router(replicas=3)
+    assert router._free_rid() == "3"
+    # drop r1: the next scale-up reuses its rid (and thus its WAL dir)
+    router.replicas = [r for r in router.replicas if r.rid != "1"]
+    assert router._free_rid() == "1"
+
+
+def test_router_scale_ops_require_running_loop():
+    router = _router()
+    assert router.scale_up() is None  # no asyncio loop yet
+    assert router.scale_down() is False
+
+
+def test_replica_environ_injects_compile_cache(tmp_path):
+    cache = str(tmp_path / "xla-cache")
+    router = _router(compile_cache=cache)
+    env = router._replica_environ(router.replicas[0])
+    assert env["JAX_COMPILATION_CACHE_DIR"] == cache
+    # an explicit env wins over the knob
+    router2 = _router(
+        compile_cache=cache,
+        replica_env={"JAX_COMPILATION_CACHE_DIR": "/elsewhere"},
+    )
+    env2 = router2._replica_environ(router2.replicas[0])
+    assert env2["JAX_COMPILATION_CACHE_DIR"] == "/elsewhere"
+    router3 = _router(compile_cache="off")
+    assert "JAX_COMPILATION_CACHE_DIR" not in router3._replica_environ(
+        router3.replicas[0]
+    )
+
+
+def test_rebuild_ring_tracks_membership():
+    router = _router(replicas=3, policy="consistent_hash")
+    import json
+
+    body = json.dumps({"tenant": "acme", "points": []}).encode()
+    before = {router._route_order("/predict", body)[0].rid}
+    router.replicas = router.replicas[:2]
+    router._rebuild_ring()
+    order = router._route_order("/predict", body)
+    assert len(order) == 2
+    assert all(r.rid in ("0", "1") for r in order)
+    assert before  # ring was usable before and after

@@ -162,3 +162,49 @@ class TestShardMSTParity:
         pts = rng.integers(0, 20, size=(60, 3)).astype(np.float64)
         core = rng.integers(0, 5, size=60).astype(np.float64)
         _assert_bitwise(pts, core, mesh, metric="manhattan")
+
+
+def test_sharded_round_cap_raises_with_surviving_components():
+    """The sharded twin of the round-cap contract
+    (``core/mst_device.assert_rounds_converged``): a ``max_rounds`` that
+    caps the while_loop mid-merge must raise after the fetch — naming the
+    surviving component count from the per-round stats — never hand the
+    short edge buffers to the forest scan. Exercised at a multi-shard
+    shape (n=129 spans two 128-row shards on the 8-device CPU mesh)."""
+    import jax
+
+    from hdbscan_tpu.core.mst_device import assert_rounds_converged
+
+    mesh = get_mesh()
+    rng = np.random.default_rng(11)
+    n = 129
+    pts = rng.integers(0, 50, size=(n, 2)).astype(np.float64)
+    core = rng.integers(0, 8, size=n).astype(np.float64)
+    res, holds = shard_boruvka_mst(pts, core, mesh=mesh, max_rounds=1)
+    fetched = jax.device_get(res)
+    for arr in (*res.values(), *holds):
+        arr.delete()
+    rounds, count = int(fetched["rounds"]), int(fetched["count"])
+    assert rounds == 1 and count < n - 1  # genuinely capped mid-merge
+    with pytest.raises(RuntimeError, match="round cap") as exc:
+        assert_rounds_converged(
+            rounds, count, n, max_rounds=1,
+            stat_comp=fetched["stat_comp"], stat_edges=fetched["stat_edges"],
+            where="shard_boruvka_mst",
+        )
+    msg = str(exc.value)
+    survivors = int(np.asarray(fetched["stat_comp"])[0])
+    assert survivors > 1
+    assert f"{survivors} components still unmerged" in msg
+    assert "shard_boruvka_mst" in msg
+    # The default cap converges the same input and passes the check.
+    full, holds = shard_boruvka_mst(pts, core, mesh=mesh)
+    ffull = jax.device_get(full)
+    for arr in (*full.values(), *holds):
+        arr.delete()
+    assert int(ffull["count"]) == n - 1
+    assert_rounds_converged(
+        int(ffull["rounds"]), int(ffull["count"]), n,
+        stat_comp=ffull["stat_comp"], stat_edges=ffull["stat_edges"],
+        where="shard_boruvka_mst",
+    )
